@@ -1,0 +1,127 @@
+// redpanda_tpu native runtime helpers.
+//
+// TPU-native equivalent of the reference's native byte-plane: CRC32C
+// (hardware-accelerated, mirroring its use of google/crc32c), xxhash-free
+// framing helpers, and the hot host-side loop that packs variable-length
+// records into fixed-shape [P, B, R] device staging buffers (and unpacks
+// them back), which feeds the XLA data plane through the bridge.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+#if defined(__x86_64__)
+#include <nmmintrin.h>
+#define HAVE_SSE42 1
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------- crc32c
+static uint32_t crc_table[8][256];
+static bool crc_table_init_done = false;
+
+static void crc_table_init() {
+  if (crc_table_init_done) return;
+  const uint32_t poly = 0x82F63B78u;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c >> 1) ^ ((c & 1) ? poly : 0);
+    crc_table[0][i] = c;
+  }
+  for (int k = 1; k < 8; k++)
+    for (uint32_t i = 0; i < 256; i++)
+      crc_table[k][i] = crc_table[0][crc_table[k - 1][i] & 0xFF] ^
+                        (crc_table[k - 1][i] >> 8);
+  crc_table_init_done = true;
+}
+
+static uint32_t crc32c_sw(uint32_t crc, const uint8_t* p, size_t n) {
+  crc_table_init();
+  while (n >= 8) {
+    crc ^= (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+           ((uint32_t)p[3] << 24);
+    crc = crc_table[7][crc & 0xFF] ^ crc_table[6][(crc >> 8) & 0xFF] ^
+          crc_table[5][(crc >> 16) & 0xFF] ^ crc_table[4][(crc >> 24) & 0xFF] ^
+          crc_table[3][p[4]] ^ crc_table[2][p[5]] ^ crc_table[1][p[6]] ^
+          crc_table[0][p[7]];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = crc_table[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return crc;
+}
+
+// crc is internal state (pre-inverted). Returns new internal state.
+uint32_t rp_crc32c_update(uint32_t crc, const uint8_t* data, size_t len) {
+#if HAVE_SSE42
+  const uint8_t* p = data;
+  size_t n = len;
+  uint64_t c = crc;
+  while (n && ((uintptr_t)p & 7)) { c = _mm_crc32_u8((uint32_t)c, *p++); n--; }
+  while (n >= 8) {
+    c = _mm_crc32_u64(c, *(const uint64_t*)p);
+    p += 8;
+    n -= 8;
+  }
+  while (n--) c = _mm_crc32_u8((uint32_t)c, *p++);
+  return (uint32_t)c;
+#else
+  return crc32c_sw(crc, data, len);
+#endif
+}
+
+// Final-value convenience: init 0xFFFFFFFF, xorout 0xFFFFFFFF.
+uint32_t rp_crc32c(const uint8_t* data, size_t len) {
+  return rp_crc32c_update(0xFFFFFFFFu, data, len) ^ 0xFFFFFFFFu;
+}
+
+// CRC N padded rows in one call: data is [n_rows, row_stride] row-major,
+// lengths[i] gives the valid prefix of row i; out[i] = final CRC value.
+void rp_crc32c_many(const uint8_t* data, size_t row_stride, size_t n_rows,
+                    const int32_t* lengths, uint32_t* out) {
+  for (size_t i = 0; i < n_rows; i++) {
+    const uint8_t* row = data + i * row_stride;
+    size_t len = lengths[i] < 0 ? 0 : (size_t)lengths[i];
+    if (len > row_stride) len = row_stride;
+    out[i] = rp_crc32c_update(0xFFFFFFFFu, row, len) ^ 0xFFFFFFFFu;
+  }
+}
+
+// ---------------------------------------------------------------- packing
+// Scatter n variable-length records (concatenated in `src` at `offsets`,
+// sizes `sizes`) into a zero-padded [n, row_stride] staging buffer.
+// Returns number of records whose size exceeded row_stride (truncated).
+int32_t rp_pack_rows(const uint8_t* src, const int64_t* offsets,
+                     const int32_t* sizes, size_t n, uint8_t* dst,
+                     size_t row_stride) {
+  int32_t truncated = 0;
+  for (size_t i = 0; i < n; i++) {
+    size_t sz = sizes[i] < 0 ? 0 : (size_t)sizes[i];
+    if (sz > row_stride) {
+      sz = row_stride;
+      truncated++;
+    }
+    uint8_t* row = dst + i * row_stride;
+    std::memcpy(row, src + offsets[i], sz);
+    if (sz < row_stride) std::memset(row + sz, 0, row_stride - sz);
+  }
+  return truncated;
+}
+
+// Gather rows back out into a contiguous buffer; returns total bytes.
+int64_t rp_unpack_rows(const uint8_t* src, size_t row_stride,
+                       const int32_t* sizes, size_t n, uint8_t* dst) {
+  int64_t total = 0;
+  for (size_t i = 0; i < n; i++) {
+    size_t sz = sizes[i] < 0 ? 0 : (size_t)sizes[i];
+    if (sz > row_stride) sz = row_stride;
+    std::memcpy(dst + total, src + i * row_stride, sz);
+    total += (int64_t)sz;
+  }
+  return total;
+}
+
+}  // extern "C"

@@ -1,0 +1,8 @@
+from redpanda_tpu.compression.registry import (
+    compress,
+    uncompress,
+    register_backend,
+    active_backend,
+)
+
+__all__ = ["compress", "uncompress", "register_backend", "active_backend"]

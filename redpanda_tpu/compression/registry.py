@@ -1,0 +1,79 @@
+"""Codec registry (parity with compression/compression.h:21, compression.cc:18-54).
+
+Static dispatch over ``models.record.Compression`` with a pluggable backend
+boundary: the default ``host`` backend runs native codecs (zlib, zstd via the
+zstandard package, lz4-frame and snappy via ctypes on the system libraries);
+a ``tpu`` backend can be registered to route batch payload (de)compression
+through the device bridge (the plugin seam the north star requires — the CPU
+path stays intact).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from redpanda_tpu.models.record import Compression
+from redpanda_tpu.compression import codecs as _codecs
+
+
+class CompressionError(Exception):
+    pass
+
+
+class _Backend:
+    def __init__(self, name: str, table: dict[Compression, tuple[Callable, Callable]]):
+        self.name = name
+        self.table = table
+
+    def compress(self, data: bytes, codec: Compression) -> bytes:
+        if codec == Compression.none:
+            return data
+        try:
+            fn = self.table[codec][0]
+        except KeyError:
+            raise CompressionError(f"codec {codec.name} unsupported by backend {self.name}")
+        return fn(data)
+
+    def uncompress(self, data: bytes, codec: Compression) -> bytes:
+        if codec == Compression.none:
+            return data
+        try:
+            fn = self.table[codec][1]
+        except KeyError:
+            raise CompressionError(f"codec {codec.name} unsupported by backend {self.name}")
+        return fn(data)
+
+
+_HOST = _Backend(
+    "host",
+    {
+        Compression.gzip: (_codecs.gzip_compress, _codecs.gzip_uncompress),
+        Compression.zstd: (_codecs.zstd_compress, _codecs.zstd_uncompress),
+        Compression.lz4: (_codecs.lz4_compress, _codecs.lz4_uncompress),
+        Compression.snappy: (_codecs.snappy_compress, _codecs.snappy_uncompress),
+    },
+)
+
+_backends: dict[str, _Backend] = {"host": _HOST}
+_active = _HOST
+
+
+def register_backend(name: str, table: dict[Compression, tuple[Callable, Callable]], *, activate: bool = False):
+    global _active
+    backend = _Backend(name, table)
+    _backends[name] = backend
+    if activate:
+        _active = backend
+    return backend
+
+
+def active_backend() -> str:
+    return _active.name
+
+
+def compress(data: bytes, codec: Compression | int) -> bytes:
+    return _active.compress(bytes(data), Compression(codec))
+
+
+def uncompress(data: bytes, codec: Compression | int) -> bytes:
+    return _active.uncompress(bytes(data), Compression(codec))

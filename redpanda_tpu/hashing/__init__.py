@@ -1,0 +1,13 @@
+from redpanda_tpu.hashing.crc32c import crc32c, crc32c_extend, Crc32c, crc32c_many
+from redpanda_tpu.hashing.xx import xxhash64, xxhash32
+from redpanda_tpu.hashing.jump import jump_consistent_hash
+
+__all__ = [
+    "crc32c",
+    "crc32c_extend",
+    "Crc32c",
+    "crc32c_many",
+    "xxhash64",
+    "xxhash32",
+    "jump_consistent_hash",
+]

@@ -1,0 +1,21 @@
+"""xxHash wrappers (parity with hashing/xx.h).
+
+Used for: RPC method ids (xor of service/method name hashes), coproc script
+checksums, RPC payload checksums.
+"""
+
+from __future__ import annotations
+
+import xxhash as _xx
+
+
+def xxhash64(data, seed: int = 0) -> int:
+    if isinstance(data, str):
+        data = data.encode()
+    return _xx.xxh64_intdigest(bytes(data), seed)
+
+
+def xxhash32(data, seed: int = 0) -> int:
+    if isinstance(data, str):
+        data = data.encode()
+    return _xx.xxh32_intdigest(bytes(data), seed)

@@ -1,0 +1,31 @@
+from redpanda_tpu.models.fundamental import NTP, MaterializedNTP, Offset, Term, NodeId
+from redpanda_tpu.models.record import (
+    Record,
+    RecordHeader,
+    RecordBatch,
+    RecordBatchHeader,
+    RecordBatchType,
+    Compression,
+    TimestampType,
+    INTERNAL_HEADER_SIZE,
+)
+from redpanda_tpu.models.reader import RecordBatchReader, make_memory_reader, make_generator_reader
+
+__all__ = [
+    "NTP",
+    "MaterializedNTP",
+    "Offset",
+    "Term",
+    "NodeId",
+    "Record",
+    "RecordHeader",
+    "RecordBatch",
+    "RecordBatchHeader",
+    "RecordBatchType",
+    "Compression",
+    "TimestampType",
+    "INTERNAL_HEADER_SIZE",
+    "RecordBatchReader",
+    "make_memory_reader",
+    "make_generator_reader",
+]

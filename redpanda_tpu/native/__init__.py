@@ -1,0 +1,102 @@
+"""ctypes loader for the native runtime helpers (native/redpanda_native.cc).
+
+Builds on demand with `make` the first time it is imported; all callers must
+tolerate `lib is None` (pure numpy fallbacks exist for every entry point).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO = os.path.join(_NATIVE_DIR, "libredpanda_native.so")
+
+
+class _NativeLib:
+    def __init__(self, dll: ctypes.CDLL):
+        self._dll = dll
+        dll.rp_crc32c_update.restype = ctypes.c_uint32
+        dll.rp_crc32c_update.argtypes = [ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
+        dll.rp_crc32c.restype = ctypes.c_uint32
+        dll.rp_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        dll.rp_crc32c_many.restype = None
+        dll.rp_crc32c_many.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        dll.rp_pack_rows.restype = ctypes.c_int32
+        dll.rp_pack_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_size_t, ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        dll.rp_unpack_rows.restype = ctypes.c_int64
+        dll.rp_unpack_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p,
+            ctypes.c_size_t, ctypes.c_void_p,
+        ]
+
+    def crc32c_update(self, state: int, data: bytes) -> int:
+        return self._dll.rp_crc32c_update(state & 0xFFFFFFFF, data, len(data))
+
+    def crc32c(self, data: bytes) -> int:
+        return self._dll.rp_crc32c(data, len(data))
+
+    def crc32c_many(self, rows: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        rows = np.ascontiguousarray(rows, dtype=np.uint8)
+        lengths = np.ascontiguousarray(lengths, dtype=np.int32)
+        n, stride = rows.shape
+        out = np.empty(n, dtype=np.uint32)
+        self._dll.rp_crc32c_many(
+            rows.ctypes.data, stride, n, lengths.ctypes.data, out.ctypes.data
+        )
+        return out
+
+    def pack_rows(self, src: bytes, offsets: np.ndarray, sizes: np.ndarray, row_stride: int) -> tuple[np.ndarray, int]:
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+        n = len(sizes)
+        dst = np.empty((n, row_stride), dtype=np.uint8)
+        src_arr = np.frombuffer(src, dtype=np.uint8)
+        truncated = self._dll.rp_pack_rows(
+            src_arr.ctypes.data, offsets.ctypes.data, sizes.ctypes.data,
+            n, dst.ctypes.data, row_stride,
+        )
+        return dst, truncated
+
+    def unpack_rows(self, rows: np.ndarray, sizes: np.ndarray) -> bytes:
+        rows = np.ascontiguousarray(rows, dtype=np.uint8)
+        sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+        n, stride = rows.shape
+        total = int(np.minimum(sizes, stride).clip(0).sum())
+        dst = np.empty(total, dtype=np.uint8)
+        self._dll.rp_unpack_rows(rows.ctypes.data, stride, sizes.ctypes.data, n, dst.ctypes.data)
+        return dst.tobytes()
+
+
+def _build_and_load():
+    src = os.path.join(_NATIVE_DIR, "redpanda_native.cc")
+    if os.path.exists(src):
+        # Let make's own dependency rule decide staleness (cheap no-op when
+        # the .so is current); fall back to an existing .so if make fails.
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            pass
+    if not os.path.exists(_SO):
+        return None
+    try:
+        return _NativeLib(ctypes.CDLL(_SO))
+    except OSError:
+        return None
+
+
+lib = _build_and_load()
