@@ -1,0 +1,37 @@
+"""Device data plane: batched XLA/Pallas kernels over [partition, batch, record].
+
+This is the TPU-first heart of the framework. The reference runs CRC32c,
+(de)compression, and user transforms as scalar C++/JS per record batch; here
+they are batched kernels over fixed-shape device arrays:
+
+- ``packing``    — variable-length records <-> padded [P, B, R] staging arrays
+- ``gf2``        — GF(2) linear algebra for carry-less CRC math (host precompute)
+- ``crc32c_device`` — CRC-32C of N records as two MXU matmuls + an unwind
+- ``transforms`` — the user map/filter transform DSL compiled to jitted fns
+- ``pipeline``   — fused validate -> transform -> reseal coproc pipeline
+"""
+
+from redpanda_tpu.ops.packing import pack_rows, unpack_rows, pack_batches_prefixed
+from redpanda_tpu.ops.crc32c_device import crc32c_device, make_crc_fn
+from redpanda_tpu.ops.transforms import (
+    TransformSpec,
+    identity,
+    filter_contains,
+    filter_field_eq,
+    map_project,
+    compile_transform,
+)
+
+__all__ = [
+    "pack_rows",
+    "unpack_rows",
+    "pack_batches_prefixed",
+    "crc32c_device",
+    "make_crc_fn",
+    "TransformSpec",
+    "identity",
+    "filter_contains",
+    "filter_field_eq",
+    "map_project",
+    "compile_transform",
+]

@@ -1,0 +1,94 @@
+"""GF(2) linear algebra over 32-bit CRC states (host-side precompute).
+
+A CRC update by one byte is the affine map  s' = A(s) ^ T[b]  where
+``A(s) = T[s & 0xFF] ^ (s >> 8)`` and T is the (linear) CRC table. Every
+multi-byte update is therefore a GF(2) matrix acting on the 32-bit state,
+which is what lets the device kernel express CRC-32C of thousands of records
+as two 0/1 matmuls on the MXU (see crc32c_device.py).
+
+Matrices are represented column-wise: ``M`` is a uint32[32] array with
+``M[j] = M(e_j)`` (image of basis bit j). Applying M to a state XORs the
+columns selected by the state's set bits.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from redpanda_tpu.hashing.crc32c import TABLE
+
+
+def identity_mat() -> np.ndarray:
+    return (np.uint32(1) << np.arange(32, dtype=np.uint32)).astype(np.uint32)
+
+
+def mat_apply(m: np.ndarray, x: int) -> int:
+    out = np.uint32(0)
+    x = int(x)
+    for j in range(32):
+        if (x >> j) & 1:
+            out ^= m[j]
+    return int(out)
+
+
+def mat_mul(m2: np.ndarray, m1: np.ndarray) -> np.ndarray:
+    """(m2 @ m1): first apply m1, then m2."""
+    return np.array([mat_apply(m2, int(c)) for c in m1], dtype=np.uint32)
+
+
+def mat_pow(m: np.ndarray, k: int) -> np.ndarray:
+    result = identity_mat()
+    base = m.copy()
+    while k:
+        if k & 1:
+            result = mat_mul(base, result)
+        base = mat_mul(base, base)
+        k >>= 1
+    return result
+
+
+def mat_inv(m: np.ndarray) -> np.ndarray:
+    """Invert a 32x32 GF(2) matrix (columns representation) by Gaussian
+    elimination on [M | I] expressed as 32 column bitmasks."""
+    # Convert to row-major bit matrix: rows[i] bit j = bit i of column j.
+    rows = np.zeros(32, dtype=np.uint64)  # each row: 64 bits = [M row | I row]
+    for i in range(32):
+        r = 0
+        for j in range(32):
+            if (int(m[j]) >> i) & 1:
+                r |= 1 << j
+        r |= 1 << (32 + i)  # identity part
+        rows[i] = r
+    # Forward elimination
+    for col in range(32):
+        pivot = None
+        for r in range(col, 32):
+            if (int(rows[r]) >> col) & 1:
+                pivot = r
+                break
+        if pivot is None:
+            raise ValueError("matrix not invertible")
+        rows[col], rows[pivot] = rows[pivot], rows[col]
+        for r in range(32):
+            if r != col and (int(rows[r]) >> col) & 1:
+                rows[r] ^= rows[col]
+    # Extract inverse columns: inv rows are the right half.
+    inv = np.zeros(32, dtype=np.uint32)
+    for j in range(32):
+        col = 0
+        for i in range(32):
+            if (int(rows[i]) >> (32 + j)) & 1:
+                col |= 1 << i
+        inv[j] = col
+    return inv
+
+
+def byte_matrix() -> np.ndarray:
+    """A: the one-(zero-)byte state advance  A(s) = T[s & 0xFF] ^ (s >> 8)."""
+    cols = np.zeros(32, dtype=np.uint32)
+    for j in range(32):
+        e = np.uint32(1) << np.uint32(j)
+        cols[j] = TABLE[int(e) & 0xFF] ^ (e >> np.uint32(8))
+    return cols
+
+

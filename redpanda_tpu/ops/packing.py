@@ -1,0 +1,81 @@
+"""Variable-length records <-> fixed-shape device staging arrays.
+
+The device data plane operates on padded uint8 arrays of shape
+``[partitions, batch, record_bytes]`` plus an int32 length array. Packing is
+the host-side hot loop (native C when available, numpy fallback): scatter
+record payloads into zero-padded rows; unpack gathers them back out.
+
+``pack_batches_prefixed`` packs whole record batches as
+``kafka_crc_prefix(40B) + payload`` rows so that a device CRC over the valid
+prefix equals the batch's Kafka CRC-32C — the produce-path validation kernel
+(the reference verifies this CRC per batch in kafka_batch_adapter.cc:93-121;
+here it is one batched kernel over all partitions).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from redpanda_tpu.models.record import RecordBatch
+
+
+def _native():
+    try:
+        from redpanda_tpu.native import lib
+
+        return lib
+    except Exception:
+        return None
+
+
+def pack_rows(payloads: list[bytes], row_stride: int) -> tuple[np.ndarray, np.ndarray]:
+    """Pack N byte strings into a zero-padded uint8 [N, row_stride] + lengths.
+
+    Oversized payloads are truncated (callers bucket by size to avoid this;
+    the coproc frontend enforces max record size upstream).
+    """
+    n = len(payloads)
+    lengths = np.array([min(len(p), row_stride) for p in payloads], dtype=np.int32)
+    lib = _native()
+    if lib is not None and n:
+        src = b"".join(payloads)
+        sizes = np.array([len(p) for p in payloads], dtype=np.int64)
+        offsets = np.zeros(n, dtype=np.int64)
+        offsets[1:] = np.cumsum(sizes[:-1])
+        rows, _ = lib.pack_rows(src, offsets, sizes.astype(np.int32), row_stride)
+        return rows, lengths
+    rows = np.zeros((n, row_stride), dtype=np.uint8)
+    for i, p in enumerate(payloads):
+        m = min(len(p), row_stride)
+        rows[i, :m] = np.frombuffer(p[:m], dtype=np.uint8)
+    return rows, lengths
+
+
+def unpack_rows(rows: np.ndarray, lengths: np.ndarray) -> list[bytes]:
+    lib = _native()
+    rows = np.asarray(rows, dtype=np.uint8)
+    lengths = np.asarray(lengths, dtype=np.int32)
+    if lib is not None and len(lengths):
+        blob = lib.unpack_rows(rows, lengths)
+        out, pos = [], 0
+        for n in lengths:
+            n = int(min(max(n, 0), rows.shape[1]))
+            out.append(blob[pos : pos + n])
+            pos += n
+        return out
+    return [rows[i, : int(lengths[i])].tobytes() for i in range(len(lengths))]
+
+
+def pack_batches_prefixed(
+    batches: list[RecordBatch], row_stride: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack batches as (kafka-CRC-covered bytes) rows.
+
+    Returns (rows uint8 [N, row_stride], lengths int32 [N], claimed_crcs
+    uint32 [N]). crc32c_device(rows, lengths) == claimed_crcs iff every
+    batch is intact.
+    """
+    payloads = [b.header.kafka_header_crc_prefix() + b.payload for b in batches]
+    rows, lengths = pack_rows(payloads, row_stride)
+    crcs = np.array([b.header.crc for b in batches], dtype=np.uint32)
+    return rows, lengths, crcs

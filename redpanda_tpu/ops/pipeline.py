@@ -1,0 +1,61 @@
+"""Fused coproc data-plane pipelines.
+
+Two device programs cover the engine's steady-state loop (SURVEY §3.4):
+
+1. ``make_batch_validator(r)`` — batch-level Kafka-CRC validation over
+   ``[N, r]`` prefixed batch rows (replaces the reference's per-batch
+   record_batch_crc_checker, record.h:699-721).
+2. ``make_record_pipeline(spec, r_in)`` — CRC-agnostic record-value
+   transform: filters + map fused into one XLA program, plus CRC-32C of the
+   transformed values so the host can reseal output batches without
+   re-scanning payload bytes.
+
+Both are shape-specialized and cached; the bridge calls them with
+``[P*B, R]`` staging arrays and overlaps H2D/compute/D2H via JAX async
+dispatch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from redpanda_tpu.ops.crc32c_device import make_crc_fn
+from redpanda_tpu.ops.transforms import TransformSpec, compile_transform, transform_out_width
+
+
+@functools.lru_cache(maxsize=16)
+def make_batch_validator(r: int):
+    """fn(rows uint8 [N, r], lens int32 [N], claimed uint32 [N]) -> ok bool [N]."""
+    crc = make_crc_fn(r)
+
+    @jax.jit
+    def validate(rows, lens, claimed):
+        got = crc(rows, lens)
+        return (got == claimed) & (lens > 0)
+
+    return validate
+
+
+@functools.lru_cache(maxsize=64)
+def _record_pipeline_cached(spec_json: str, r_in: int):
+    spec = TransformSpec.from_json(spec_json)
+    tfn = compile_transform(spec, r_in)
+    r_out = transform_out_width(spec, r_in)
+    out_crc_fn = make_crc_fn(r_out)
+
+    @jax.jit
+    def run(data, lengths):
+        out, out_len, keep = tfn(data, lengths)
+        masked_len = jnp.where(keep, out_len, 0)
+        out_crc = out_crc_fn(out, masked_len)
+        return out, masked_len, keep, out_crc
+
+    return run, r_out
+
+
+def make_record_pipeline(spec: TransformSpec, r_in: int):
+    """fn(data uint8 [N, r_in], lens [N]) -> (out [N, r_out], out_len, keep, out_crc)."""
+    return _record_pipeline_cached(spec.to_json(), int(r_in))

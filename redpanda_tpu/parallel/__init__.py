@@ -1,0 +1,16 @@
+from redpanda_tpu.parallel.mesh import (
+    partition_mesh,
+    partition_sharding,
+    shard_to_mesh,
+    sharded_jit,
+)
+from redpanda_tpu.parallel.collectives import make_vote_aggregator, make_sharded_crc_check
+
+__all__ = [
+    "partition_mesh",
+    "partition_sharding",
+    "shard_to_mesh",
+    "sharded_jit",
+    "make_vote_aggregator",
+    "make_sharded_crc_check",
+]

@@ -1,0 +1,70 @@
+"""Cross-partition collectives (ICI) for the host consensus plane.
+
+The reference aggregates per-group raft votes and heartbeat responses in
+host code, one message at a time (heartbeat_manager.cc:155-204 batches them
+per destination node). Here the batched analogues run as mesh collectives:
+
+- ``make_vote_aggregator``: each device holds vote bits for the raft groups
+  whose partitions it owns, laid out [n_dev, groups_per_dev] over the 'p'
+  axis; one ``psum``-style all-gather yields the per-group tally on every
+  device so the host reads a single array instead of n messages (BASELINE
+  config 5's vote-aggregation kernel).
+- ``make_sharded_crc_check``: the per-shard batched CRC over all partitions
+  (config 5's first half): CRC every batch of every partition in one
+  sharded launch and reduce per-partition validity counts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from redpanda_tpu.parallel.mesh import PARTITION_AXIS
+from redpanda_tpu.ops.crc32c_device import make_crc_fn
+
+
+def make_vote_aggregator(mesh):
+    """Returns fn(votes uint8 [D, G]) -> int32 [G]: total votes per group.
+
+    votes is sharded over 'p' on the leading device axis; the reduction is a
+    psum over the mesh so every shard (and the host) sees the full tally.
+    """
+
+    def _local(votes):
+        # votes block: [1, G] on each device -> psum over 'p'
+        return jax.lax.psum(votes.astype(jnp.int32).sum(axis=0), PARTITION_AXIS)
+
+    fn = shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=P(PARTITION_AXIS, None),
+        out_specs=P(),
+    )
+    return jax.jit(fn)
+
+
+def make_sharded_crc_check(mesh, r: int):
+    """Returns fn(rows uint8 [P, B, r], lens int32 [P, B], claimed uint32
+    [P, B]) -> (ok bool [P, B], bad_per_partition int32 [P]).
+
+    Rows shard over 'p'; the CRC matmul runs per shard with no cross-device
+    traffic; only the scalar summary is replicated.
+    """
+    crc = make_crc_fn(r)
+
+    def _local(rows, lens, claimed):
+        p, b, _ = rows.shape
+        got = crc(rows.reshape(p * b, r), lens.reshape(p * b)).reshape(p, b)
+        ok = (got == claimed) & (lens > 0)
+        bad = jnp.sum((~ok) & (lens > 0), axis=1).astype(jnp.int32)
+        return ok, bad
+
+    fn = shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(PARTITION_AXIS, None, None), P(PARTITION_AXIS, None), P(PARTITION_AXIS, None)),
+        out_specs=(P(PARTITION_AXIS, None), P(PARTITION_AXIS)),
+    )
+    return jax.jit(fn)

@@ -1,0 +1,63 @@
+"""Device mesh + sharding layout for the partition axis.
+
+The reference scales by spreading partitions over cores/nodes (shard-per-core
+SMP + the cluster partition allocator — SURVEY §2.3). The TPU-native analogue
+is a 1-D ``jax.sharding.Mesh`` whose ``'p'`` axis carries the partition
+dimension of every data-plane array: ``[P, B, R]`` shards as ``P('p',)`` so
+each chip owns P/n partitions, XLA inserts ICI collectives only where a
+kernel genuinely crosses partitions (e.g. vote aggregation), and the host
+bridge feeds each shard locally.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PARTITION_AXIS = "p"
+
+
+def partition_mesh(n_devices: int | None = None, devices=None, backend: str | None = None) -> Mesh:
+    """1-D mesh over the partition axis.
+
+    Tests pass backend='cpu' for the virtual 8-device mesh; on hardware the
+    default backend's chips are used.
+    """
+    if devices is None:
+        devices = jax.local_devices(backend=backend) if backend else jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devices), (PARTITION_AXIS,))
+
+
+def partition_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard the leading (partition) dim over 'p'; replicate the rest."""
+    return NamedSharding(mesh, P(PARTITION_AXIS, *([None] * (ndim - 1))))
+
+
+def shard_to_mesh(mesh: Mesh, *arrays):
+    """device_put each array with its partition-leading sharding."""
+    out = tuple(
+        jax.device_put(a, partition_sharding(mesh, a.ndim)) for a in arrays
+    )
+    return out if len(out) != 1 else out[0]
+
+
+def sharded_jit(fn, mesh: Mesh, in_ndims: tuple[int, ...], out_ndims: tuple[int, ...]):
+    """jit `fn` with partition-leading shardings on every input and output.
+
+    in_ndims/out_ndims give the rank of each positional argument / result;
+    each gets P('p', None, ...) over its leading dim.
+    """
+    if not out_ndims:
+        raise ValueError("out_ndims must name at least one output")
+    spec = lambda nd: NamedSharding(mesh, P(PARTITION_AXIS, *([None] * (nd - 1))))
+    in_shardings = tuple(spec(nd) for nd in in_ndims)
+    out_shardings = tuple(spec(nd) for nd in out_ndims)
+    return jax.jit(
+        fn,
+        in_shardings=in_shardings if len(in_shardings) > 1 else in_shardings[0],
+        out_shardings=out_shardings if len(out_shardings) > 1 else out_shardings[0],
+    )

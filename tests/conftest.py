@@ -14,13 +14,32 @@ if _FLAG not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# The image's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon (the TPU tunnel), so the env vars above are too late —
+# and the axon plugin can hang backend init when its tunnel is unhealthy,
+# even for CPU-only use. Tests only ever touch the virtual CPU mesh, so pin
+# the platform list on the live config and drop the axon factory outright.
+try:
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def eight_devices():
+    # NOTE: in the axon environment the TPU plugin registers even when
+    # JAX_PLATFORMS=cpu, so jax.devices() may show the real chip; the
+    # virtual 8-device mesh must be requested from the cpu backend
+    # explicitly.
     import jax
 
-    devs = jax.devices()
-    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    devs = jax.local_devices(backend="cpu")
+    assert len(devs) >= 8, f"expected >=8 virtual cpu devices, got {len(devs)}"
     return devs[:8]

@@ -1,0 +1,221 @@
+"""Device data-plane tests: packing, CRC kernel, transforms, pipeline, sharding."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from redpanda_tpu.hashing import crc32c
+from redpanda_tpu.models import Record, RecordBatch
+from redpanda_tpu.ops.packing import pack_rows, unpack_rows, pack_batches_prefixed
+from redpanda_tpu.ops.crc32c_device import crc32c_device
+from redpanda_tpu.ops.pipeline import make_batch_validator, make_record_pipeline
+from redpanda_tpu.ops.transforms import (
+    Int,
+    Str,
+    TransformSpec,
+    compile_transform,
+    filter_contains,
+    filter_field_eq,
+    identity,
+    map_project,
+    map_uppercase,
+    transform_out_width,
+)
+
+
+# ------------------------------------------------------------------ packing
+def test_pack_unpack_roundtrip():
+    payloads = [b"alpha", b"", b"x" * 64, b"beta-beta"]
+    rows, lens = pack_rows(payloads, 64)
+    assert rows.shape == (4, 64)
+    assert list(lens) == [5, 0, 64, 9]
+    assert unpack_rows(rows, lens) == payloads
+    # padding is zeroed
+    assert rows[0, 5:].sum() == 0
+
+
+def test_pack_truncates_oversize():
+    rows, lens = pack_rows([b"y" * 100], 64)
+    assert lens[0] == 64
+    assert rows[0].tobytes() == b"y" * 64
+
+
+# ------------------------------------------------------------------ device CRC
+def test_device_crc_bit_exact_random():
+    rng = np.random.default_rng(42)
+    r = 512
+    sizes = [0, 1, 7, 8, 9, 63, 64, 65, 100, 511, 512] + list(rng.integers(1, r, 20))
+    msgs = [rng.integers(0, 256, size=s, dtype=np.uint8).tobytes() for s in sizes]
+    rows, lens = pack_rows(msgs, r)
+    got = np.asarray(crc32c_device(rows, lens))
+    want = np.array([crc32c(m) for m in msgs], dtype=np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_device_crc_leading_shape():
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(4, 8, 128), dtype=np.uint8)
+    lens = rng.integers(0, 129, size=(4, 8)).astype(np.int32)
+    got = np.asarray(crc32c_device(data, lens))
+    assert got.shape == (4, 8)
+    flat = data.reshape(-1, 128)
+    flens = lens.reshape(-1)
+    want = np.array(
+        [crc32c(flat[i, : flens[i]].tobytes()) for i in range(len(flens))], np.uint32
+    ).reshape(4, 8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_batch_validator_detects_corruption():
+    batches = [
+        RecordBatch.build([Record(offset_delta=i, value=f"v{i}".encode()) for i in range(3)], base_offset=o)
+        for o in (0, 10, 20)
+    ]
+    rows, lens, crcs = pack_batches_prefixed(batches, 256)
+    validate = make_batch_validator(256)
+    ok = np.asarray(validate(rows, lens, crcs))
+    assert ok.all()
+    # corrupt one payload byte
+    rows[1, 50] ^= 0xFF
+    ok = np.asarray(validate(rows, lens, crcs))
+    assert list(ok) == [True, False, True]
+
+
+# ------------------------------------------------------------------ transforms
+JSON_RECORDS = [
+    b'{"level":"error","code":42,"msg":"disk failed"}',
+    b'{"level":"info","code":7,"msg":"ok"}',
+    b'{"level":"error","code":-13,"msg":"net down"}',
+    b'{"code":1}',
+    b'{"level":"error","code":9000000,"msg":""}',
+]
+
+
+def _packed(r=128):
+    return pack_rows(JSON_RECORDS, r)
+
+
+def test_filter_field_eq():
+    data, lens = _packed()
+    fn = compile_transform(filter_field_eq("level", "error"), 128)
+    out, olen, keep = fn(data, lens)
+    assert list(np.asarray(keep)) == [True, False, True, False, True]
+    # identity map passes data through
+    np.testing.assert_array_equal(np.asarray(out), data)
+
+
+def test_filter_negate_and_chain():
+    data, lens = _packed()
+    spec = filter_field_eq("level", "error") | filter_contains(b"disk", negate=True)
+    fn = compile_transform(spec, 128)
+    _, _, keep = fn(data, lens)
+    assert list(np.asarray(keep)) == [False, False, True, False, True]
+
+
+def test_map_project_int_and_str():
+    data, lens = _packed()
+    spec = filter_field_eq("level", "error") | map_project(Int("code"), Str("msg", 16))
+    fn = compile_transform(spec, 128)
+    out, olen, keep = map(np.asarray, fn(data, lens))
+    assert list(keep) == [True, False, True, False, True]
+    assert transform_out_width(spec, 128) == 4 + 2 + 16
+    for i, want_code, want_msg in [(0, 42, b"disk failed"), (2, -13, b"net down"), (4, 9000000, b"")]:
+        row = out[i].tobytes()
+        code = struct.unpack_from("<i", row, 0)[0]
+        slen = struct.unpack_from("<H", row, 4)[0]
+        assert code == want_code
+        assert row[6 : 6 + slen] == want_msg
+        assert olen[i] == 22
+
+
+def test_map_project_missing_field_drops():
+    data, lens = pack_rows([b'{"a":1}', b'{"code":5,"msg":"hi"}'], 64)
+    fn = compile_transform(map_project(Int("code"), Str("msg", 8)), 64)
+    _, _, keep = map(np.asarray, fn(data, lens))
+    assert list(keep) == [False, True]
+
+
+def test_filter_field_eq_numeric_no_prefix_match():
+    data, lens = pack_rows(
+        [b'{"code":42,"x":1}', b'{"code":420}', b'{"code":42}', b'{"code":42.5}', b'{"code":4}'],
+        64,
+    )
+    fn = compile_transform(filter_field_eq("code", 42), 64)
+    _, _, keep = fn(data, lens)
+    assert list(np.asarray(keep)) == [True, False, True, False, False]
+
+
+def test_map_project_int_overflow_rejected():
+    data, lens = pack_rows(
+        [b'{"ts":1722268800000000}', b'{"ts":999999999}', b'{"ts":1000000000}'],
+        64,
+    )
+    fn = compile_transform(map_project(Int("ts")), 64)
+    out, _, keep = map(np.asarray, fn(data, lens))
+    # 16-digit and 10-digit values are rejected rather than silently wrapped
+    assert list(keep) == [False, True, False]
+    assert struct.unpack_from("<i", out[1].tobytes())[0] == 999999999
+
+
+def test_map_uppercase():
+    data, lens = pack_rows([b"Hello, World-123!"], 32)
+    fn = compile_transform(map_uppercase(), 32)
+    out, olen, keep = map(np.asarray, fn(data, lens))
+    assert out[0, : olen[0]].tobytes() == b"HELLO, WORLD-123!"
+
+
+def test_spec_json_roundtrip():
+    spec = filter_field_eq("level", "error") | filter_contains(b"x", negate=True) | map_project(Int("a"), Str("b", 32))
+    spec2 = TransformSpec.from_json(spec.to_json())
+    assert spec2.to_json() == spec.to_json()
+
+
+def test_record_pipeline_out_crc():
+    data, lens = _packed()
+    spec = filter_field_eq("level", "error") | map_project(Int("code"), Str("msg", 16))
+    run, r_out = make_record_pipeline(spec, 128)
+    out, out_len, keep, out_crc = map(np.asarray, run(data, lens))
+    assert r_out == 22
+    for i in range(len(JSON_RECORDS)):
+        if keep[i]:
+            assert out_crc[i] == crc32c(out[i, : out_len[i]].tobytes())
+
+
+# ------------------------------------------------------------------ sharding
+def test_sharded_crc_check(eight_devices):
+    from redpanda_tpu.parallel import partition_mesh, make_sharded_crc_check, shard_to_mesh
+
+    mesh = partition_mesh(devices=eight_devices)
+    p, b, r = 8, 4, 256
+    rng = np.random.default_rng(3)
+    batches = [
+        RecordBatch.build([Record(offset_delta=j, value=rng.bytes(40)) for j in range(2)], base_offset=i)
+        for i in range(p * b)
+    ]
+    rows, lens, crcs = pack_batches_prefixed(batches, r)
+    rows = rows.reshape(p, b, r)
+    lens = lens.reshape(p, b)
+    crcs = crcs.reshape(p, b)
+    rows[3, 2, 45] ^= 1  # corrupt one batch
+    fn = make_sharded_crc_check(mesh, r)
+    rows_d, lens_d, crcs_d = shard_to_mesh(mesh, rows, lens, crcs)
+    ok, bad = map(np.asarray, fn(rows_d, lens_d, crcs_d))
+    assert ok.shape == (p, b)
+    assert not ok[3, 2]
+    assert ok.sum() == p * b - 1
+    assert bad[3] == 1 and bad.sum() == 1
+
+
+def test_vote_aggregator(eight_devices):
+    from redpanda_tpu.parallel import partition_mesh, make_vote_aggregator
+
+    mesh = partition_mesh(devices=eight_devices)
+    agg = make_vote_aggregator(mesh)
+    votes = np.zeros((8, 16), dtype=np.uint8)
+    votes[0, 3] = 1
+    votes[5, 3] = 1
+    votes[7, 3] = 1
+    votes[2, 9] = 1
+    tally = np.asarray(agg(votes))
+    assert tally[3] == 3 and tally[9] == 1 and tally.sum() == 4
