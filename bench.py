@@ -1,0 +1,179 @@
+"""North-star benchmark: coproc JSON-filter transform at 64 partitions.
+
+Measures record_batches/sec through the TPU engine (BASELINE.md config 4
+shape: JSON filter + project to a fixed struct, 64 partitions, zstd output)
+against a single-core host baseline that mirrors what the reference's
+Node.js sidecar does per record (decode framing, JSON parse, predicate,
+re-encode, re-CRC).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+P = 64  # partitions
+RECORDS_PER_BATCH = 32
+RECORD_JSON_PAD = 900  # ~1KB records
+ROW_STRIDE = 1152
+WARMUP_TICKS = 3
+MEASURE_TICKS = 20
+BASELINE_TICKS = 2
+
+
+def _probe_tpu(timeout_s: int = 150) -> bool:
+    """Check TPU health in a subprocess (the tunnel can hang indefinitely).
+
+    On timeout the child gets SIGTERM (graceful) and only SIGKILL as a last
+    resort: a SIGKILL mid-TPU-init is known to wedge the axon tunnel for
+    every later process (see .claude/skills/verify/SKILL.md).
+    """
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return b"ok" in (out or b"")
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        return False
+    except Exception:
+        return False
+
+
+def _pin_cpu():
+    from redpanda_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform()
+
+
+def _build_workload():
+    from redpanda_tpu.models import Record, RecordBatch, NTP
+    from redpanda_tpu.coproc.engine import ProcessBatchItem, ProcessBatchRequest
+
+    rng = np.random.default_rng(0)
+    levels = ["error", "info", "warn"]
+    items = []
+    for p in range(P):
+        recs = []
+        for i in range(RECORDS_PER_BATCH):
+            doc = '{"level":"%s","code":%d,"msg":"%s"}' % (
+                levels[(p + i) % 3],
+                i,
+                "x" * (RECORD_JSON_PAD + int(rng.integers(0, 100))),
+            )
+            recs.append(Record(offset_delta=i, timestamp_delta=i, value=doc.encode()))
+        batch = RecordBatch.build(recs, base_offset=0, first_timestamp=1_000_000)
+        items.append(ProcessBatchItem(1, NTP.kafka("bench", p), [batch]))
+    return ProcessBatchRequest(items)
+
+
+def _spec():
+    from redpanda_tpu.ops.transforms import Int, Str, filter_field_eq, map_project
+
+    return filter_field_eq("level", "error") | map_project(Int("code"), Str("msg", 64))
+
+
+def run_tpu_engine(req) -> float:
+    """record_batches/sec through the TPU engine."""
+    from redpanda_tpu.coproc import TpuEngine
+
+    engine = TpuEngine(row_stride=ROW_STRIDE)
+    codes = engine.enable_coprocessors([(1, _spec().to_json(), ("bench",))])
+    assert codes[0] == 0
+    for _ in range(WARMUP_TICKS):
+        engine.process_batch(req)
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_TICKS):
+        reply = engine.process_batch(req)
+    elapsed = time.perf_counter() - t0
+    assert len(reply.items) == P
+    return P * MEASURE_TICKS / elapsed
+
+
+def run_cpu_baseline(req) -> float:
+    """Single-core host engine: per-record decode + json.loads + predicate +
+    rebuild + re-CRC (the work profile of the reference's JS supervisor)."""
+    from redpanda_tpu.models import Record, RecordBatch
+    from redpanda_tpu.compression import compress
+    from redpanda_tpu.models.record import Compression, RecordBatchHeader
+
+    def tick():
+        n_batches = 0
+        for item in req.items:
+            for batch in item.batches:
+                kept = []
+                for rec in batch.records():
+                    try:
+                        doc = json.loads(rec.value)
+                    except Exception:
+                        continue
+                    if doc.get("level") != "error":
+                        continue
+                    msg = str(doc.get("msg", ""))[:64].encode()
+                    out_val = struct.pack("<iH", int(doc.get("code", 0)), len(msg)) + msg.ljust(64, b"\x00")
+                    kept.append(out_val)
+                if kept:
+                    recs = [
+                        Record(offset_delta=i, value=v) for i, v in enumerate(kept)
+                    ]
+                    out = RecordBatch.build(
+                        recs,
+                        base_offset=0,
+                        compression=Compression.zstd,
+                        first_timestamp=batch.header.first_timestamp,
+                    )
+                    assert out.header.crc
+                n_batches += 1
+        return n_batches
+
+    tick()  # warmup
+    t0 = time.perf_counter()
+    total = 0
+    for _ in range(BASELINE_TICKS):
+        total += tick()
+    elapsed = time.perf_counter() - t0
+    return total / elapsed
+
+
+def main():
+    tpu_ok = _probe_tpu()
+    if not tpu_ok:
+        _pin_cpu()
+    req = _build_workload()
+    value = run_tpu_engine(req)
+    baseline = run_cpu_baseline(req)
+    import jax
+
+    print(
+        json.dumps(
+            {
+                "metric": "coproc_json_filter_record_batches_per_sec_64p",
+                "value": round(value, 1),
+                "unit": "record_batches/s",
+                "vs_baseline": round(value / baseline, 2),
+                "baseline_cpu_single_core": round(baseline, 1),
+                "device": str(jax.devices()[0]),
+                "partitions": P,
+                "records_per_batch": RECORDS_PER_BATCH,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -124,4 +124,111 @@ int64_t rp_unpack_rows(const uint8_t* src, size_t row_stride,
   return total;
 }
 
+// ---------------------------------------------------------------- records
+// Kafka v2 record framing: zigzag varints, LSB-group-first.
+static inline int64_t zz_decode(uint64_t u) {
+  return (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
+}
+
+static inline const uint8_t* read_uvarint(const uint8_t* p, const uint8_t* end,
+                                          uint64_t* out) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (p < end && shift <= 63) {
+    uint8_t b = *p++;
+    result |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = result;
+      return p;
+    }
+    shift += 7;
+  }
+  return nullptr;
+}
+
+static inline uint8_t* write_zigzag(uint8_t* p, int64_t v) {
+  uint64_t u = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+  while (u >= 0x80) {
+    *p++ = (uint8_t)(u | 0x80);
+    u >>= 7;
+  }
+  *p++ = (uint8_t)u;
+  return p;
+}
+
+// Parse `count` varint-framed records from a batch payload; emit each
+// record's value offset/length (-1 length for null values). Returns the
+// number of records parsed (== count on success).
+int32_t rp_parse_record_values(const uint8_t* payload, size_t payload_len,
+                               int32_t count, int64_t* val_off,
+                               int32_t* val_len) {
+  const uint8_t* p = payload;
+  const uint8_t* end = payload + payload_len;
+  for (int32_t i = 0; i < count; i++) {
+    uint64_t u;
+    p = read_uvarint(p, end, &u);
+    if (!p) return i;
+    int64_t body_len = zz_decode(u);
+    const uint8_t* body_end = p + body_len;
+    if (body_len < 0 || body_end > end) return i;
+    if (p >= body_end) return i;
+    p++;  // attributes
+    if (!(p = read_uvarint(p, body_end, &u))) return i;  // ts delta
+    if (!(p = read_uvarint(p, body_end, &u))) return i;  // offset delta
+    if (!(p = read_uvarint(p, body_end, &u))) return i;  // key len
+    int64_t klen = zz_decode(u);
+    if (klen > 0) p += klen;
+    if (p > body_end) return i;
+    if (!(p = read_uvarint(p, body_end, &u))) return i;  // value len
+    int64_t vlen = zz_decode(u);
+    if (vlen < 0) {
+      val_off[i] = p - payload;
+      val_len[i] = -1;
+    } else {
+      if (p + vlen > body_end) return i;
+      val_off[i] = p - payload;
+      val_len[i] = (int32_t)vlen;
+    }
+    p = body_end;  // skip headers
+  }
+  return count;
+}
+
+// Build a records payload from kept transform outputs: record i (where
+// keep[i] != 0) becomes {attrs=0, ts_delta=0, offset_delta=seq, key=null,
+// value=rows[i][:lens[i]], headers=0}. Writes payload to dst (caller sizes
+// it at n * (row_stride + 16)); returns payload byte length, and the number
+// of kept records via *kept_out.
+int64_t rp_frame_records(const uint8_t* rows, size_t row_stride,
+                         const int32_t* lens, const uint8_t* keep, int32_t n,
+                         uint8_t* dst, int32_t* kept_out) {
+  uint8_t* out = dst;
+  int32_t seq = 0;
+  uint8_t body_buf[16];
+  for (int32_t i = 0; i < n; i++) {
+    if (!keep[i]) continue;
+    int32_t vlen = lens[i] < 0 ? 0 : lens[i];
+    if ((size_t)vlen > row_stride) vlen = (int32_t)row_stride;
+    // body = attrs(1) + ts_delta + offset_delta + key_len(-1) + value_len +
+    //        value + header_count
+    uint8_t* b = body_buf;
+    *b++ = 0;                      // attributes
+    b = write_zigzag(b, 0);        // timestamp delta
+    b = write_zigzag(b, seq);      // offset delta
+    b = write_zigzag(b, -1);       // null key
+    b = write_zigzag(b, vlen);     // value length
+    size_t pre_len = (size_t)(b - body_buf);
+    int64_t body_len = (int64_t)pre_len + vlen + 1;  // +1 header count
+    out = write_zigzag(out, body_len);
+    std::memcpy(out, body_buf, pre_len);
+    out += pre_len;
+    std::memcpy(out, rows + (size_t)i * row_stride, vlen);
+    out += vlen;
+    out = write_zigzag(out, 0);    // header count
+    seq++;
+  }
+  *kept_out = seq;
+  return out - dst;
+}
+
 }  // extern "C"

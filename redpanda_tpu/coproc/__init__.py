@@ -1,0 +1,17 @@
+from redpanda_tpu.coproc.engine import (
+    TpuEngine,
+    ProcessBatchRequest,
+    ProcessBatchReply,
+    EnableResponseCode,
+    DisableResponseCode,
+    ErrorPolicy,
+)
+
+__all__ = [
+    "TpuEngine",
+    "ProcessBatchRequest",
+    "ProcessBatchReply",
+    "EnableResponseCode",
+    "DisableResponseCode",
+    "ErrorPolicy",
+]

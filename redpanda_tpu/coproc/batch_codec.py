@@ -1,0 +1,155 @@
+"""Host-side record-batch explode/rebuild for the engine data path.
+
+The per-record work (varint framing) runs in native code
+(native/redpanda_native.cc rp_parse_record_values / rp_frame_records) with a
+Python fallback; Python only touches per-batch metadata. This is the
+division of labour the whole engine is built around: Python per batch,
+C per record, TPU per byte.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from redpanda_tpu.compression import compress, uncompress
+from redpanda_tpu.models.record import Compression, Record, RecordBatch, RecordBatchHeader
+from redpanda_tpu.utils.vint import decode_zigzag, encode_zigzag
+
+
+def _native():
+    try:
+        from redpanda_tpu.native import lib
+
+        return lib
+    except Exception:
+        return None
+
+
+@dataclass
+class ExplodedBatches:
+    """All record values of a batch list, as offsets into one joined blob."""
+
+    joined: bytes
+    offsets: np.ndarray  # int64 [N]
+    sizes: np.ndarray  # int32 [N] (null values -> 0)
+    ranges: list[tuple[int, int]]  # per input batch: [start, end) in N
+
+
+def explode_batches(batches: list[RecordBatch]) -> ExplodedBatches:
+    lib = _native()
+    payloads: list[bytes] = []
+    offsets: list[np.ndarray] = []
+    sizes: list[np.ndarray] = []
+    ranges: list[tuple[int, int]] = []
+    base = 0
+    n = 0
+    for b in batches:
+        payload = b.payload
+        if b.header.compression != Compression.none:
+            payload = uncompress(payload, b.header.compression)
+        count = b.header.record_count
+        if lib is not None:
+            off, ln = lib.parse_record_values(payload, count)
+        else:
+            off, ln = _parse_record_values_py(payload, count)
+        payloads.append(payload)
+        offsets.append(off + base)
+        sizes.append(np.maximum(ln, 0))
+        ranges.append((n, n + count))
+        base += len(payload)
+        n += count
+    joined = b"".join(payloads)
+    return ExplodedBatches(
+        joined,
+        np.concatenate(offsets) if offsets else np.zeros(0, np.int64),
+        np.concatenate(sizes) if sizes else np.zeros(0, np.int32),
+        ranges,
+    )
+
+
+def _parse_record_values_py(payload: bytes, count: int):
+    off = np.empty(count, dtype=np.int64)
+    ln = np.empty(count, dtype=np.int32)
+    pos = 0
+    for i in range(count):
+        body_len, k = decode_zigzag(payload, pos)
+        pos += k
+        body_end = pos + body_len
+        p = pos + 1  # attributes
+        _, k = decode_zigzag(payload, p)
+        p += k
+        _, k = decode_zigzag(payload, p)
+        p += k
+        klen, k = decode_zigzag(payload, p)
+        p += k
+        if klen > 0:
+            p += klen
+        vlen, k = decode_zigzag(payload, p)
+        p += k
+        off[i] = p
+        ln[i] = vlen if vlen >= 0 else -1
+        pos = body_end
+    return off, ln
+
+
+def frame_records(rows: np.ndarray, lens: np.ndarray, keep: np.ndarray) -> tuple[bytes, int]:
+    lib = _native()
+    if lib is not None:
+        return lib.frame_records(rows, lens, keep)
+    out = bytearray()
+    seq = 0
+    for i in range(len(keep)):
+        if not keep[i]:
+            continue
+        vlen = max(int(lens[i]), 0)
+        body = bytearray()
+        body += b"\x00"
+        body += encode_zigzag(0)
+        body += encode_zigzag(seq)
+        body += encode_zigzag(-1)
+        body += encode_zigzag(vlen)
+        body += rows[i, :vlen].tobytes()
+        body += encode_zigzag(0)
+        out += encode_zigzag(len(body))
+        out += body
+        seq += 1
+    return bytes(out), seq
+
+
+def rebuild_batch(
+    source: RecordBatch,
+    rows: np.ndarray,
+    lens: np.ndarray,
+    keep: np.ndarray,
+    *,
+    compress_threshold: int = 512,
+    codec: Compression = Compression.zstd,
+) -> RecordBatch | None:
+    """Assemble a materialized output batch from kept transform rows.
+
+    Mirrors the reference's write side (script_context_backend.cc:40-68):
+    term reset, zstd recompression above a size threshold, fresh CRCs.
+    Returns None when no record survives the transform.
+    """
+    payload, kept = frame_records(rows, lens, keep)
+    if kept == 0:
+        return None
+    attrs = 0
+    if len(payload) >= compress_threshold and codec != Compression.none:
+        payload = compress(payload, codec)
+        attrs = int(codec)
+    hdr = RecordBatchHeader(
+        base_offset=0,  # assigned by the materialized log appender
+        type=source.header.type,
+        attrs=attrs,
+        last_offset_delta=kept - 1,
+        first_timestamp=source.header.first_timestamp,
+        max_timestamp=source.header.max_timestamp,
+        record_count=kept,
+        term=0,
+    )
+    batch = RecordBatch(hdr, payload)
+    batch.reseal()
+    return batch

@@ -1,0 +1,237 @@
+"""The TPU transform engine — replacement for the reference's Node.js sidecar.
+
+The reference ships record batches over RPC to a Node.js process that runs
+user JS per record (ProcessBatchServer, src/js/modules/rpc/server.ts:79,
+applyCoprocessor :244-266). Here the "supervisor" is a JAX engine: deploys
+carry a declarative TransformSpec (redpanda_tpu.ops.transforms) compiled once
+per (script, row-stride) into a fused XLA program; process_batch packs every
+record of every input batch into one [N, R] staging array, runs a single
+device launch, and reassembles output batches natively.
+
+The RPC surface mirrors the supervisor schema (coproc/gen.json):
+enable_coprocessors / disable_coprocessors / disable_all / process_batch /
+heartbeat — so the engine can sit in-process (hermetic fixtures, the
+reference's supervisor_test_fixture.h pattern) or behind the rpc server.
+
+Error policies mirror the public SDK (Coprocessor.ts:21-24):
+SkipOnFailure drops the failing batch but keeps the script; Deregister
+removes the script on first failure.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from redpanda_tpu.hashing.xx import xxhash64
+from redpanda_tpu.models.fundamental import NTP
+from redpanda_tpu.models.record import Compression, RecordBatch
+from redpanda_tpu.ops.pipeline import make_record_pipeline
+from redpanda_tpu.ops.transforms import TransformSpec
+from redpanda_tpu.coproc import batch_codec
+
+
+class EnableResponseCode(enum.IntEnum):
+    success = 0
+    internal_error = 1
+    script_id_already_exists = 2
+    script_contains_invalid_topic = 3
+    script_contains_no_topics = 4
+
+
+class DisableResponseCode(enum.IntEnum):
+    success = 0
+    internal_error = 1
+    script_id_does_not_exist = 2
+
+
+class ErrorPolicy(enum.IntEnum):
+    skip_on_failure = 0
+    deregister = 1
+
+
+@dataclass
+class ScriptHandle:
+    script_id: int
+    spec: TransformSpec
+    input_topics: tuple[str, ...]
+    policy: ErrorPolicy = ErrorPolicy.skip_on_failure
+    checksum: int = 0
+
+
+@dataclass
+class ProcessBatchItem:
+    script_id: int
+    ntp: NTP
+    batches: list[RecordBatch]
+
+
+@dataclass
+class ProcessBatchRequest:
+    items: list[ProcessBatchItem] = field(default_factory=list)
+
+
+@dataclass
+class ProcessBatchReplyItem:
+    script_id: int
+    source: NTP
+    batches: list[RecordBatch]  # transformed output (may be empty)
+
+
+@dataclass
+class ProcessBatchReply:
+    items: list[ProcessBatchReplyItem] = field(default_factory=list)
+    deregistered: list[int] = field(default_factory=list)
+
+
+class TpuEngine:
+    """HandleTable + batched device execution."""
+
+    def __init__(
+        self,
+        *,
+        row_stride: int = 1024,
+        compress_threshold: int = 512,
+        output_codec: Compression = Compression.zstd,
+    ):
+        self._handles: dict[int, ScriptHandle] = {}
+        self._row_stride = row_stride
+        self._compress_threshold = compress_threshold
+        self._output_codec = output_codec
+        self._pipelines: dict[int, tuple] = {}  # script_id -> (fn, r_out)
+
+    # ------------------------------------------------------------ control
+    def enable_coprocessors(
+        self, scripts: list[tuple[int, str, tuple[str, ...]]]
+    ) -> list[EnableResponseCode]:
+        """scripts: [(script_id, spec_json, input_topics)]."""
+        out = []
+        for script_id, spec_json, topics in scripts:
+            if script_id in self._handles:
+                out.append(EnableResponseCode.script_id_already_exists)
+                continue
+            if not topics:
+                out.append(EnableResponseCode.script_contains_no_topics)
+                continue
+            if any(t.startswith("__") or ".$" in t for t in topics):
+                out.append(EnableResponseCode.script_contains_invalid_topic)
+                continue
+            try:
+                spec = TransformSpec.from_json(spec_json)
+                self._pipelines[script_id] = make_record_pipeline(spec, self._row_stride)
+            except Exception:
+                out.append(EnableResponseCode.internal_error)
+                continue
+            self._handles[script_id] = ScriptHandle(
+                script_id, spec, tuple(topics), checksum=xxhash64(spec_json)
+            )
+            out.append(EnableResponseCode.success)
+        return out
+
+    def disable_coprocessors(self, script_ids: list[int]) -> list[DisableResponseCode]:
+        out = []
+        for sid in script_ids:
+            if sid in self._handles:
+                del self._handles[sid]
+                self._pipelines.pop(sid, None)
+                out.append(DisableResponseCode.success)
+            else:
+                out.append(DisableResponseCode.script_id_does_not_exist)
+        return out
+
+    def disable_all_coprocessors(self) -> int:
+        n = len(self._handles)
+        self._handles.clear()
+        self._pipelines.clear()
+        return n
+
+    def heartbeat(self) -> int:
+        """Returns the number of registered scripts (liveness probe)."""
+        return len(self._handles)
+
+    @property
+    def scripts(self) -> dict[int, ScriptHandle]:
+        return dict(self._handles)
+
+    # ------------------------------------------------------------ data path
+    def process_batch(self, req: ProcessBatchRequest) -> ProcessBatchReply:
+        """One device launch per script, not per (script, ntp): every record
+        of every partition's batches is packed into a single [N, R] staging
+        array — the [partition, batch, record] batching the engine exists
+        for. Items of unknown scripts get empty replies so callers resync."""
+        reply = ProcessBatchReply()
+        by_script: dict[int, list[ProcessBatchItem]] = {}
+        for item in req.items:
+            if item.script_id not in self._handles:
+                reply.items.append(ProcessBatchReplyItem(item.script_id, item.ntp, []))
+            else:
+                by_script.setdefault(item.script_id, []).append(item)
+        for script_id, items in by_script.items():
+            handle = self._handles[script_id]
+            try:
+                outputs = self._run_script_group(script_id, items)
+                for item, out_batches in zip(items, outputs):
+                    reply.items.append(
+                        ProcessBatchReplyItem(script_id, item.ntp, out_batches)
+                    )
+            except Exception:
+                if handle.policy == ErrorPolicy.deregister:
+                    self.disable_coprocessors([script_id])
+                    reply.deregistered.append(script_id)
+                else:  # skip_on_failure: ack every batch with no output
+                    for item in items:
+                        reply.items.append(ProcessBatchReplyItem(script_id, item.ntp, []))
+        return reply
+
+    def _run_script_group(
+        self, script_id: int, items: list[ProcessBatchItem]
+    ) -> list[list[RecordBatch]]:
+        from redpanda_tpu.native import lib
+
+        all_batches = [b for item in items for b in item.batches]
+        exploded = batch_codec.explode_batches(all_batches)
+        n = len(exploded.sizes)
+        if n == 0:
+            return [[] for _ in items]
+        if lib is not None:
+            rows, _ = lib.pack_rows(
+                exploded.joined, exploded.offsets, exploded.sizes, self._row_stride
+            )
+        else:
+            vals = [
+                exploded.joined[o : o + s]
+                for o, s in zip(exploded.offsets, exploded.sizes)
+            ]
+            from redpanda_tpu.ops.packing import pack_rows
+
+            rows, _ = pack_rows(vals, self._row_stride)
+        # Records wider than the staging row cannot be transformed faithfully:
+        # drop them (the reference bounds record size upstream via
+        # coproc_max_batch_size; truncating would corrupt data silently).
+        fits = exploded.sizes <= self._row_stride
+        lens = np.where(fits, exploded.sizes, 0).astype(np.int32)
+        fn, _r_out = self._pipelines[script_id]
+        out, out_len, keep, _out_crc = fn(rows, lens)
+        out = np.asarray(out)
+        out_len = np.asarray(out_len)
+        keep = np.asarray(keep) & fits
+        results: list[list[RecordBatch]] = []
+        range_it = iter(exploded.ranges)
+        for item in items:
+            item_out: list[RecordBatch] = []
+            for batch in item.batches:
+                start, end = next(range_it)
+                rebuilt = batch_codec.rebuild_batch(
+                    batch,
+                    out[start:end],
+                    out_len[start:end],
+                    keep[start:end],
+                    compress_threshold=self._compress_threshold,
+                    codec=self._output_codec,
+                )
+                if rebuilt is not None:
+                    item_out.append(rebuilt)
+            results.append(item_out)
+        return results

@@ -38,6 +38,16 @@ class _NativeLib:
             ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p,
             ctypes.c_size_t, ctypes.c_void_p,
         ]
+        dll.rp_parse_record_values.restype = ctypes.c_int32
+        dll.rp_parse_record_values.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        dll.rp_frame_records.restype = ctypes.c_int64
+        dll.rp_frame_records.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+        ]
 
     def crc32c_update(self, state: int, data: bytes) -> int:
         return self._dll.rp_crc32c_update(state & 0xFFFFFFFF, data, len(data))
@@ -66,6 +76,31 @@ class _NativeLib:
             n, dst.ctypes.data, row_stride,
         )
         return dst, truncated
+
+    def parse_record_values(self, payload: bytes, count: int) -> tuple[np.ndarray, np.ndarray]:
+        """Offsets/lengths of each record's value within a batch payload."""
+        val_off = np.empty(count, dtype=np.int64)
+        val_len = np.empty(count, dtype=np.int32)
+        parsed = self._dll.rp_parse_record_values(
+            payload, len(payload), count, val_off.ctypes.data, val_len.ctypes.data
+        )
+        if parsed != count:
+            raise ValueError(f"record framing parse failed at record {parsed}/{count}")
+        return val_off, val_len
+
+    def frame_records(self, rows: np.ndarray, lens: np.ndarray, keep: np.ndarray) -> tuple[bytes, int]:
+        """Frame kept rows as a records payload; returns (payload, kept_count)."""
+        rows = np.ascontiguousarray(rows, dtype=np.uint8)
+        lens = np.ascontiguousarray(lens, dtype=np.int32)
+        keep = np.ascontiguousarray(keep, dtype=np.uint8)
+        n, stride = rows.shape
+        dst = np.empty(n * (stride + 16) + 16, dtype=np.uint8)
+        kept = ctypes.c_int32()
+        length = self._dll.rp_frame_records(
+            rows.ctypes.data, stride, lens.ctypes.data, keep.ctypes.data,
+            n, dst.ctypes.data, ctypes.byref(kept),
+        )
+        return dst[:length].tobytes(), kept.value
 
     def unpack_rows(self, rows: np.ndarray, sizes: np.ndarray) -> bytes:
         rows = np.ascontiguousarray(rows, dtype=np.uint8)

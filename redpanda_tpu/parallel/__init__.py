@@ -4,7 +4,11 @@ from redpanda_tpu.parallel.mesh import (
     shard_to_mesh,
     sharded_jit,
 )
-from redpanda_tpu.parallel.collectives import make_vote_aggregator, make_sharded_crc_check
+from redpanda_tpu.parallel.collectives import (
+    make_vote_aggregator,
+    make_sharded_crc_check,
+    make_sharded_coproc_step,
+)
 
 __all__ = [
     "partition_mesh",
@@ -13,4 +17,5 @@ __all__ = [
     "sharded_jit",
     "make_vote_aggregator",
     "make_sharded_crc_check",
+    "make_sharded_coproc_step",
 ]

@@ -45,6 +45,63 @@ def make_vote_aggregator(mesh):
     return jax.jit(fn)
 
 
+def make_sharded_coproc_step(mesh, spec_json: str, r_batch: int, r_rec: int):
+    """The full per-tick device program, sharded over the partition axis.
+
+    One launch covers what the reference spreads across three host loops
+    (SURVEY §3.2/§3.4): produce-path batch CRC validation, the coproc
+    record transform, and the cross-partition vote aggregation collective.
+
+    fn(batch_rows [P,B,r_batch] u8, batch_lens [P,B] i32, claimed [P,B] u32,
+       rec_rows [P,N,r_rec] u8, rec_lens [P,N] i32, votes [P,G] u8)
+      -> (ok [P,B] bool, out [P,N,r_out] u8, out_len [P,N] i32,
+          keep [P,N] bool, tally [G] i32)
+    """
+    import jax.numpy as jnp
+    from redpanda_tpu.ops.transforms import TransformSpec, compile_transform, transform_out_width
+
+    spec = TransformSpec.from_json(spec_json)
+    batch_crc = make_crc_fn(r_batch)
+    tfn = compile_transform(spec, r_rec)
+
+    def _local(b_rows, b_lens, claimed, rec_rows, rec_lens, votes):
+        p, b, _ = b_rows.shape
+        got = batch_crc(b_rows.reshape(p * b, r_batch), b_lens.reshape(p * b)).reshape(p, b)
+        ok = (got == claimed) & (b_lens > 0)
+        n = rec_rows.shape[1]
+        out, out_len, keep = tfn(rec_rows.reshape(p * n, r_rec), rec_lens.reshape(p * n))
+        r_out = out.shape[-1]
+        tally = jax.lax.psum(votes.astype(jnp.int32).sum(axis=0), PARTITION_AXIS)
+        return (
+            ok,
+            out.reshape(p, n, r_out),
+            out_len.reshape(p, n),
+            keep.reshape(p, n),
+            tally,
+        )
+
+    fn = shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(
+            P(PARTITION_AXIS, None, None),
+            P(PARTITION_AXIS, None),
+            P(PARTITION_AXIS, None),
+            P(PARTITION_AXIS, None, None),
+            P(PARTITION_AXIS, None),
+            P(PARTITION_AXIS, None),
+        ),
+        out_specs=(
+            P(PARTITION_AXIS, None),
+            P(PARTITION_AXIS, None, None),
+            P(PARTITION_AXIS, None),
+            P(PARTITION_AXIS, None),
+            P(),
+        ),
+    )
+    return jax.jit(fn)
+
+
 def make_sharded_crc_check(mesh, r: int):
     """Returns fn(rows uint8 [P, B, r], lens int32 [P, B], claimed uint32
     [P, B]) -> (ok bool [P, B], bad_per_partition int32 [P]).

@@ -1,0 +1,34 @@
+"""Platform pinning helpers for the axon TPU environment.
+
+The image's sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon; code that must run on the virtual CPU mesh (tests,
+multi-chip dry runs, bench fallback) pins the live config instead of the
+environment, and drops the axon backend factory so an unhealthy TPU tunnel
+cannot hang CPU-only work.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_platform(n_virtual_devices: int | None = None) -> None:
+    """Pin jax to the CPU backend; optionally request N virtual devices.
+
+    The virtual-device flag only takes effect if the CPU backend has not
+    initialized yet (XLA reads XLA_FLAGS at backend-init time).
+    """
+    if n_virtual_devices is not None:
+        flag = f"--xla_force_host_platform_device_count={n_virtual_devices}"
+        if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass

@@ -14,20 +14,11 @@ if _FLAG not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-# The image's sitecustomize imports jax at interpreter startup with
-# JAX_PLATFORMS=axon (the TPU tunnel), so the env vars above are too late —
-# and the axon plugin can hang backend init when its tunnel is unhealthy,
-# even for CPU-only use. Tests only ever touch the virtual CPU mesh, so pin
-# the platform list on the live config and drop the axon factory outright.
-try:
-    import jax  # noqa: E402
+# Tests only ever touch the virtual CPU mesh; pin the live jax config (env
+# vars alone are too late — sitecustomize imports jax at interpreter start).
+from redpanda_tpu.utils.platform import force_cpu_platform  # noqa: E402
 
-    jax.config.update("jax_platforms", "cpu")
-    from jax._src import xla_bridge as _xb
-
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
+force_cpu_platform(8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,167 @@
+"""Coproc TPU engine tests (hermetic, in-process — the reference's
+supervisor_test_fixture pattern with the real engine instead of a fake)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from redpanda_tpu.coproc import (
+    TpuEngine,
+    ProcessBatchRequest,
+    EnableResponseCode,
+    DisableResponseCode,
+    ErrorPolicy,
+)
+from redpanda_tpu.coproc.engine import ProcessBatchItem
+from redpanda_tpu.models import Compression, NTP, Record, RecordBatch
+from redpanda_tpu.ops.transforms import Int, Str, filter_field_eq, identity, map_project
+
+
+def _json_batch(n, base_offset=0, level_of=lambda i: ["error", "info"][i % 2], codec=Compression.none):
+    recs = [
+        Record(
+            offset_delta=i,
+            timestamp_delta=i,
+            value=json.dumps(
+                {"level": level_of(i), "code": i, "msg": f"m{i}"}, separators=(",", ":")
+            ).encode(),
+        )
+        for i in range(n)
+    ]
+    return RecordBatch.build(recs, base_offset=base_offset, compression=codec, first_timestamp=1000)
+
+
+def _deploy(engine, script_id=1, spec=None, topics=("orders",)):
+    spec = spec or (filter_field_eq("level", "error") | map_project(Int("code"), Str("msg", 16)))
+    codes = engine.enable_coprocessors([(script_id, spec.to_json(), topics)])
+    assert codes == [EnableResponseCode.success]
+    return spec
+
+
+def test_enable_disable_lifecycle():
+    engine = TpuEngine(row_stride=256)
+    _deploy(engine, 7)
+    assert engine.heartbeat() == 1
+    # duplicate id rejected
+    codes = engine.enable_coprocessors([(7, identity().to_json(), ("t",))])
+    assert codes == [EnableResponseCode.script_id_already_exists]
+    # invalid topics
+    codes = engine.enable_coprocessors(
+        [(8, identity().to_json(), ()), (9, identity().to_json(), ("x.$mat$",))]
+    )
+    assert codes == [
+        EnableResponseCode.script_contains_no_topics,
+        EnableResponseCode.script_contains_invalid_topic,
+    ]
+    assert engine.disable_coprocessors([7, 99]) == [
+        DisableResponseCode.success,
+        DisableResponseCode.script_id_does_not_exist,
+    ]
+    assert engine.heartbeat() == 0
+
+
+def test_process_batch_filter_project():
+    engine = TpuEngine(row_stride=256, compress_threshold=10**9)
+    _deploy(engine, 1)
+    batch = _json_batch(10)
+    req = ProcessBatchRequest([ProcessBatchItem(1, NTP.kafka("orders", 0), [batch])])
+    reply = engine.process_batch(req)
+    assert len(reply.items) == 1
+    out = reply.items[0].batches
+    assert len(out) == 1
+    ob = out[0]
+    assert ob.header.record_count == 5  # evens are "error"
+    assert ob.verify_kafka_crc() and ob.verify_header_crc()
+    recs = ob.records()
+    import struct
+
+    for j, r in enumerate(recs):
+        code = struct.unpack_from("<i", r.value, 0)[0]
+        slen = struct.unpack_from("<H", r.value, 4)[0]
+        assert code == 2 * j
+        assert r.value[6 : 6 + slen] == f"m{2 * j}".encode()
+        assert r.offset_delta == j
+
+
+def test_process_batch_compressed_input_and_output():
+    engine = TpuEngine(row_stride=256, compress_threshold=1)
+    _deploy(engine, 1, spec=filter_field_eq("level", "error") | map_project(Str("msg", 32)))
+    batch = _json_batch(20, codec=Compression.lz4)
+    reply = engine.process_batch(
+        ProcessBatchRequest([ProcessBatchItem(1, NTP.kafka("orders", 3), [batch])])
+    )
+    ob = reply.items[0].batches[0]
+    assert ob.header.compression == Compression.zstd  # zstd-recompressed output
+    assert ob.header.record_count == 10
+    assert ob.verify_kafka_crc()
+    import struct
+
+    for j, r in enumerate(ob.records()):
+        slen = struct.unpack_from("<H", r.value, 0)[0]
+        assert r.value[2 : 2 + slen] == f"m{2 * j}".encode()
+
+
+def test_process_batch_no_survivors():
+    engine = TpuEngine(row_stride=256)
+    _deploy(engine, 1)
+    batch = _json_batch(4, level_of=lambda i: "info")
+    reply = engine.process_batch(
+        ProcessBatchRequest([ProcessBatchItem(1, NTP.kafka("orders", 0), [batch])])
+    )
+    assert reply.items[0].batches == []
+
+
+def test_unknown_script_gets_empty_reply():
+    engine = TpuEngine()
+    reply = engine.process_batch(
+        ProcessBatchRequest([ProcessBatchItem(42, NTP.kafka("t", 0), [_json_batch(2)])])
+    )
+    assert reply.items[0].batches == [] and reply.items[0].script_id == 42
+
+
+def test_error_policy_deregister():
+    engine = TpuEngine(row_stride=256)
+    _deploy(engine, 1)
+    engine.scripts[1]  # exists
+    engine._handles[1].policy = ErrorPolicy.deregister
+    # Force a failure: corrupt batch (record_count lies about payload)
+    batch = _json_batch(3)
+    batch.header.record_count = 50
+    reply = engine.process_batch(
+        ProcessBatchRequest([ProcessBatchItem(1, NTP.kafka("orders", 0), [batch])])
+    )
+    assert reply.deregistered == [1]
+    assert engine.heartbeat() == 0
+
+
+def test_error_policy_skip_on_failure():
+    engine = TpuEngine(row_stride=256)
+    _deploy(engine, 1)
+    batch = _json_batch(3)
+    batch.header.record_count = 50
+    reply = engine.process_batch(
+        ProcessBatchRequest([ProcessBatchItem(1, NTP.kafka("orders", 0), [batch])])
+    )
+    assert reply.items[0].batches == [] and not reply.deregistered
+    assert engine.heartbeat() == 1
+
+
+def test_multi_batch_multi_partition():
+    engine = TpuEngine(row_stride=256, compress_threshold=10**9)
+    _deploy(engine, 1, spec=filter_field_eq("level", "error"))
+    items = [
+        ProcessBatchItem(
+            1, NTP.kafka("orders", p), [_json_batch(8, base_offset=100 * p), _json_batch(6, base_offset=100 * p + 8)]
+        )
+        for p in range(4)
+    ]
+    reply = engine.process_batch(ProcessBatchRequest(items))
+    assert len(reply.items) == 4
+    for it in reply.items:
+        assert len(it.batches) == 2
+        assert it.batches[0].header.record_count == 4
+        assert it.batches[1].header.record_count == 3
+        for ob in it.batches:
+            for r in ob.records():
+                assert b'"level":"error"' in r.value
