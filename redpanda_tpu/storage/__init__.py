@@ -1,0 +1,20 @@
+from redpanda_tpu.storage.log import DiskLog, LogConfig, AppendResult, LogOffsets
+from redpanda_tpu.storage.log_manager import LogManager, StorageApi
+from redpanda_tpu.storage.kvstore import KvStore, KeySpace
+from redpanda_tpu.storage.snapshot import SnapshotManager, write_snapshot, read_snapshot
+from redpanda_tpu.storage.mem_log import MemLog
+
+__all__ = [
+    "DiskLog",
+    "LogConfig",
+    "AppendResult",
+    "LogOffsets",
+    "LogManager",
+    "StorageApi",
+    "KvStore",
+    "KeySpace",
+    "SnapshotManager",
+    "write_snapshot",
+    "read_snapshot",
+    "MemLog",
+]

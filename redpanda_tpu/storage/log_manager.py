@@ -1,0 +1,86 @@
+"""Log manager + storage API facade.
+
+Parity with storage/api.h:20 (`storage::api` = log_manager + kvstore) and
+log_manager.h:171 (`manage(ntp)` creates/opens the per-ntp log, housekeeping
+applies retention).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+from redpanda_tpu.models.fundamental import NTP
+from redpanda_tpu.storage.kvstore import KvStore
+from redpanda_tpu.storage.log import DiskLog, LogConfig
+
+
+class LogManager:
+    def __init__(self, config: LogConfig):
+        self.config = config
+        self._logs: dict[NTP, DiskLog] = {}
+        self._housekeeping_task: asyncio.Task | None = None
+
+    async def manage(self, ntp: NTP, *, overrides: LogConfig | None = None) -> DiskLog:
+        if ntp in self._logs:
+            return self._logs[ntp]
+        log = await DiskLog.open(ntp, overrides or self.config)
+        self._logs[ntp] = log
+        return log
+
+    def get(self, ntp: NTP) -> DiskLog | None:
+        return self._logs.get(ntp)
+
+    def logs(self) -> dict[NTP, DiskLog]:
+        return dict(self._logs)
+
+    async def shutdown(self, ntp: NTP):
+        log = self._logs.pop(ntp, None)
+        if log:
+            await log.close()
+
+    async def remove(self, ntp: NTP):
+        log = self._logs.pop(ntp, None)
+        if log:
+            await log.remove()
+
+    async def start_housekeeping(self, interval_s: float = 10.0):
+        async def loop():
+            while True:
+                await asyncio.sleep(interval_s)
+                for log in list(self._logs.values()):
+                    try:
+                        await log.apply_retention()
+                    except Exception:
+                        pass
+
+        self._housekeeping_task = asyncio.create_task(loop())
+
+    async def stop(self):
+        if self._housekeeping_task:
+            self._housekeeping_task.cancel()
+            try:
+                await self._housekeeping_task
+            except asyncio.CancelledError:
+                pass
+        for log in self._logs.values():
+            await log.close()
+        self._logs.clear()
+
+
+class StorageApi:
+    """storage::api equivalent: one kvstore + one log_manager per shard."""
+
+    def __init__(self, base_dir: str, log_config: LogConfig | None = None, shard: int = 0):
+        self.base_dir = base_dir
+        cfg = log_config or LogConfig(base_dir=os.path.join(base_dir, "data"))
+        self.log_mgr = LogManager(cfg)
+        self.kvs = KvStore(os.path.join(base_dir, f"kvstore-{shard}"))
+
+    async def start(self) -> "StorageApi":
+        self.kvs.start()
+        return self
+
+    async def stop(self):
+        await self.log_mgr.stop()
+        self.kvs.stop()

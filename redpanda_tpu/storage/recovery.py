@@ -1,0 +1,104 @@
+"""Crash-recovery CRC scan of a segment tail (parity with storage/
+log_replayer.h and the header-CRC validation in storage/parser.cc:159-173).
+
+The scan walks [header][payload] frames; each header's header_crc and each
+batch's Kafka CRC must verify. The host path validates with the native CRC;
+the device path packs every frame of the segment into one [N, R] staging
+array and validates all CRCs in a single batched kernel — the first
+internal consumer of the produce-path validator (SURVEY §7 step 2).
+
+The segment is truncated at the first corrupt frame (everything after a
+torn write is discarded, as the reference does).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from redpanda_tpu.models.record import (
+    INTERNAL_HEADER_SIZE,
+    CorruptBatchError,
+    RecordBatch,
+    RecordBatchHeader,
+)
+
+
+def scan_valid_prefix_host(blob: bytes) -> tuple[int, int]:
+    """Returns (valid_byte_length, last_valid_offset)."""
+    at = 0
+    last_offset = -1
+    n = len(blob)
+    while at + INTERNAL_HEADER_SIZE <= n:
+        try:
+            batch, consumed = RecordBatch.decode_internal(blob, at, verify=True)
+        except CorruptBatchError:
+            break
+        if not batch.verify_kafka_crc():
+            break
+        last_offset = batch.last_offset
+        at += consumed
+    return at, last_offset
+
+
+def scan_valid_prefix_device(blob: bytes, row_stride: int = 4096) -> tuple[int, int]:
+    """Device-batched variant: frame boundaries come from the headers (host,
+    cheap), every frame's Kafka CRC validates in one kernel launch."""
+    frames: list[tuple[int, RecordBatchHeader]] = []
+    at = 0
+    n = len(blob)
+    while at + INTERNAL_HEADER_SIZE <= n:
+        try:
+            hdr = RecordBatchHeader.decode(blob, at)
+        except Exception:
+            break
+        if hdr.size_bytes < INTERNAL_HEADER_SIZE or at + hdr.size_bytes > n:
+            break
+        if hdr.header_crc != hdr.internal_header_only_crc():
+            break
+        if hdr.size_bytes - INTERNAL_HEADER_SIZE + 40 > row_stride:
+            # frame too large for the staging row: fall back to host CRC
+            return scan_valid_prefix_host(blob)
+        frames.append((at, hdr))
+        at += hdr.size_bytes
+    if not frames:
+        return 0, -1
+    from redpanda_tpu.ops.crc32c_device import make_crc_fn
+
+    rows = np.zeros((len(frames), row_stride), dtype=np.uint8)
+    lens = np.zeros(len(frames), dtype=np.int32)
+    claimed = np.zeros(len(frames), dtype=np.uint32)
+    for i, (pos, hdr) in enumerate(frames):
+        prefix = hdr.kafka_header_crc_prefix()
+        payload = blob[pos + INTERNAL_HEADER_SIZE : pos + hdr.size_bytes]
+        row = prefix + payload
+        rows[i, : len(row)] = np.frombuffer(row, dtype=np.uint8)
+        lens[i] = len(row)
+        claimed[i] = hdr.crc
+    got = np.asarray(make_crc_fn(row_stride)(rows, lens))
+    ok = got == claimed
+    bad = ~ok
+    valid = int(np.argmax(bad)) if bad.any() else len(frames)
+    if valid == 0:
+        return 0, -1
+    end_pos, last_hdr = frames[valid - 1]
+    return end_pos + last_hdr.size_bytes, last_hdr.base_offset + last_hdr.last_offset_delta
+
+
+def recover_segment(seg, *, use_device: bool = False) -> None:
+    """Truncate `seg` after its last intact batch and rebuild its index.
+
+    Single read: the blob is scanned once for CRC validity and the surviving
+    prefix is handed to rebuild_index (which also resets dirty_offset and
+    max_timestamp — crucial when the whole tail is corrupt and the stale
+    index footer would otherwise claim offsets that no longer exist)."""
+    blob = seg.read_from(0)
+    if use_device:
+        valid_len, _last_offset = scan_valid_prefix_device(blob)
+    else:
+        valid_len, _last_offset = scan_valid_prefix_host(blob)
+    if valid_len < len(blob):
+        with open(seg.data_path, "r+b") as f:
+            f.truncate(valid_len)
+        seg.size_bytes = valid_len
+        blob = blob[:valid_len]
+    seg.rebuild_index(blob)

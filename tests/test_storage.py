@@ -1,0 +1,392 @@
+"""Storage engine tests: segmented log, recovery, kvstore, snapshots,
+plus an opfuzz-style randomized interleaving test (the reference's
+storage/opfuzz pattern)."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from redpanda_tpu.models import NTP, Record, RecordBatch, RecordBatchType
+from redpanda_tpu.storage import (
+    DiskLog,
+    KeySpace,
+    KvStore,
+    LogConfig,
+    LogManager,
+    MemLog,
+    SnapshotManager,
+    read_snapshot,
+    write_snapshot,
+)
+from redpanda_tpu.storage.recovery import scan_valid_prefix_host
+
+
+def _batch(n=3, value_size=32, type=RecordBatchType.raft_data, ts=0):
+    rng = np.random.default_rng(abs(hash((n, value_size, ts))) % 2**31)
+    recs = [
+        Record(offset_delta=i, timestamp_delta=i, value=rng.bytes(value_size))
+        for i in range(n)
+    ]
+    return RecordBatch.build(recs, type=type, first_timestamp=ts, max_timestamp=ts + n - 1)
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture()
+def ntp():
+    return NTP.kafka("t-log", 0)
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    return LogConfig(base_dir=str(tmp_path), fsync_on_append=False)
+
+
+# ------------------------------------------------------------------ basic log
+def test_append_read_roundtrip(ntp, cfg):
+    async def main():
+        log = await DiskLog.open(ntp, cfg)
+        r1 = await log.append([_batch(3), _batch(2)])
+        assert (r1.base_offset, r1.last_offset) == (0, 4)
+        r2 = await log.append([_batch(4)])
+        assert (r2.base_offset, r2.last_offset) == (5, 8)
+        batches = await log.read(0)
+        assert [b.base_offset for b in batches] == [0, 3, 5]
+        assert [b.header.record_count for b in batches] == [3, 2, 4]
+        for b in batches:
+            assert b.verify_kafka_crc() and b.verify_header_crc()
+        # offset-bounded read
+        mid = await log.read(3, max_offset=4)
+        assert [b.base_offset for b in mid] == [3]
+        await log.close()
+
+    _run(main())
+
+
+def test_reopen_preserves_state(ntp, cfg):
+    async def main():
+        log = await DiskLog.open(ntp, cfg)
+        await log.append([_batch(3), _batch(3)])
+        await log.flush()
+        await log.close()
+        log2 = await DiskLog.open(ntp, cfg)
+        off = log2.offsets()
+        assert off.dirty_offset == 5
+        batches = await log2.read(0)
+        assert len(batches) == 2
+        r = await log2.append([_batch(1)])
+        assert r.base_offset == 6
+        await log2.close()
+
+    _run(main())
+
+
+def test_segment_roll_and_read_across(ntp, cfg):
+    cfg.max_segment_size = 400  # force rolls
+    async def main():
+        log = await DiskLog.open(ntp, cfg)
+        for _ in range(10):
+            await log.append([_batch(2, value_size=64)])
+        assert len(log.segments) > 1
+        batches = await log.read(0, max_bytes=1 << 30)
+        assert sum(b.header.record_count for b in batches) == 20
+        assert [b.base_offset for b in batches] == [2 * i for i in range(10)]
+        await log.close()
+
+    _run(main())
+
+
+def test_truncate_suffix(ntp, cfg):
+    async def main():
+        log = await DiskLog.open(ntp, cfg)
+        for _ in range(5):
+            await log.append([_batch(2)])
+        await log.truncate(6)  # drop offsets >= 6
+        assert log.offsets().dirty_offset == 5
+        batches = await log.read(0)
+        assert [b.base_offset for b in batches] == [0, 2, 4]
+        r = await log.append([_batch(1)])
+        assert r.base_offset == 6
+        await log.close()
+
+    _run(main())
+
+
+def test_prefix_truncate_and_retention(ntp, cfg):
+    cfg.max_segment_size = 300
+    async def main():
+        log = await DiskLog.open(ntp, cfg)
+        for _ in range(10):
+            await log.append([_batch(2, value_size=64)])
+        await log.prefix_truncate(8)
+        assert log.offsets().start_offset == 8
+        batches = await log.read(0)
+        assert all(b.last_offset >= 8 for b in batches)
+        await log.close()
+
+    _run(main())
+
+
+def test_timequery(ntp, cfg):
+    async def main():
+        log = await DiskLog.open(ntp, cfg)
+        for i in range(5):
+            await log.append([_batch(2, ts=1000 * i)])
+        off = await log.timequery(2500)
+        assert off == 6  # first batch with max_ts >= 2500 is batch 3 (ts 3000..)
+        await log.close()
+
+    _run(main())
+
+
+# ------------------------------------------------------------------ recovery
+def test_recovery_truncates_torn_write(ntp, cfg):
+    async def main():
+        log = await DiskLog.open(ntp, cfg)
+        for _ in range(4):
+            await log.append([_batch(2)])
+        await log.flush()
+        path = log.segments[-1].data_path
+        await log.close()
+        # tear the last batch: chop 7 bytes off
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - 7)
+        log2 = await DiskLog.open(ntp, cfg)
+        assert log2.offsets().dirty_offset == 5  # last batch dropped
+        batches = await log2.read(0)
+        assert len(batches) == 3
+        r = await log2.append([_batch(1)])
+        assert r.base_offset == 6
+        await log2.close()
+
+    _run(main())
+
+
+def test_recovery_detects_corruption_midfile(ntp, cfg):
+    async def main():
+        log = await DiskLog.open(ntp, cfg)
+        for _ in range(4):
+            await log.append([_batch(2)])
+        await log.flush()
+        path = log.segments[-1].data_path
+        await log.close()
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            f.write(b"\xde\xad")
+        log2 = await DiskLog.open(ntp, cfg)
+        assert log2.offsets().dirty_offset < 7
+        for b in await log2.read(0):
+            assert b.verify_kafka_crc()
+        await log2.close()
+
+    _run(main())
+
+
+def test_device_recovery_scan_matches_host(tmp_path):
+    blob = b"".join(
+        _batch(2, value_size=24).with_base_offset(2 * i).encode_internal() for i in range(6)
+    )
+    from redpanda_tpu.storage.recovery import scan_valid_prefix_device
+
+    full_host = scan_valid_prefix_host(blob)
+    full_dev = scan_valid_prefix_device(blob)
+    assert full_host == full_dev == (len(blob), 11)
+    # corrupt payload of 4th frame (beyond its header)
+    corrupt = bytearray(blob)
+    frame = len(blob) // 6
+    corrupt[3 * frame + 70] ^= 0xFF
+    assert scan_valid_prefix_host(bytes(corrupt)) == scan_valid_prefix_device(bytes(corrupt))
+    assert scan_valid_prefix_device(bytes(corrupt))[1] == 5
+
+    _ = tmp_path  # unused
+
+
+def test_recovery_fully_corrupt_tail_no_offset_hole(ntp, cfg):
+    """A wholly-corrupt tail segment must not leave stale offsets behind."""
+    cfg.max_segment_size = 250
+    async def main():
+        log = await DiskLog.open(ntp, cfg)
+        for _ in range(4):
+            await log.append([_batch(2, value_size=64)])
+        await log.flush()
+        tail = log.segments[-1]
+        tail_base = tail.base_offset
+        path = tail.data_path
+        await log.close()
+        # corrupt the very first header byte of the tail segment
+        with open(path, "r+b") as f:
+            f.write(b"\xff\xff\xff\xff")
+        log2 = await DiskLog.open(ntp, cfg)
+        assert log2.offsets().dirty_offset == tail_base - 1
+        r = await log2.append([_batch(1)])
+        assert r.base_offset == tail_base  # no hole
+        got = await log2.read(0)
+        offs = [b.base_offset for b in got]
+        assert offs == sorted(offs) and offs[-1] == tail_base
+        await log2.close()
+
+    _run(main())
+
+
+def test_term_survives_restart(ntp, cfg):
+    async def main():
+        log = await DiskLog.open(ntp, cfg)
+        await log.append([_batch(2)], term=3)
+        await log.append([_batch(2)], term=5)
+        assert log.term == 5
+        got = await log.read(0)
+        assert [b.header.term for b in got] == [3, 5]
+        await log.flush()
+        await log.close()
+        log2 = await DiskLog.open(ntp, cfg)
+        assert log2.term == 5
+        got = await log2.read(0)
+        assert [b.header.term for b in got] == [3, 5]
+        await log2.close()
+
+    _run(main())
+
+
+def test_kvstore_stop_without_start_preserves_state(tmp_path):
+    kv = KvStore(str(tmp_path / "kv")).start()
+    kv.put(KeySpace.consensus, b"voted_for", b"node-3")
+    kv.stop()
+    # construct-then-stop without start must not clobber the snapshot
+    KvStore(str(tmp_path / "kv")).stop()
+    kv2 = KvStore(str(tmp_path / "kv")).start()
+    assert kv2.get(KeySpace.consensus, b"voted_for") == b"node-3"
+    kv2.stop()
+
+
+# ------------------------------------------------------------------ kvstore
+def test_kvstore_roundtrip_and_recovery(tmp_path):
+    kv = KvStore(str(tmp_path / "kv")).start()
+    kv.put(KeySpace.consensus, b"voted_for", b"node-3")
+    kv.put(KeySpace.storage, b"start_offset", b"42")
+    kv.remove(KeySpace.storage, b"missing")
+    kv.stop()
+    kv2 = KvStore(str(tmp_path / "kv")).start()
+    assert kv2.get(KeySpace.consensus, b"voted_for") == b"node-3"
+    assert kv2.get(KeySpace.storage, b"start_offset") == b"42"
+    assert kv2.get(KeySpace.storage, b"missing") is None
+    kv2.put(KeySpace.consensus, b"voted_for", b"node-5")
+    kv2.stop()
+    kv3 = KvStore(str(tmp_path / "kv")).start()
+    assert kv3.get(KeySpace.consensus, b"voted_for") == b"node-5"
+    kv3.stop()
+
+
+def test_kvstore_wal_only_recovery(tmp_path):
+    """Kill without stop(): WAL alone must recover state."""
+    kv = KvStore(str(tmp_path / "kv")).start()
+    kv.put(KeySpace.coproc, b"k1", b"v1")
+    kv.put(KeySpace.coproc, b"k2", b"v2")
+    kv._wal.close()  # simulate crash (no snapshot)
+    kv2 = KvStore(str(tmp_path / "kv")).start()
+    assert kv2.get(KeySpace.coproc, b"k1") == b"v1"
+    assert kv2.get(KeySpace.coproc, b"k2") == b"v2"
+    kv2.stop()
+
+
+def test_kvstore_torn_wal_tail(tmp_path):
+    kv = KvStore(str(tmp_path / "kv")).start()
+    kv.put(KeySpace.testing, b"a", b"1")
+    kv.put(KeySpace.testing, b"b", b"2")
+    kv._wal.close()
+    wal = str(tmp_path / "kv" / "kvstore.wal")
+    size = os.path.getsize(wal)
+    with open(wal, "r+b") as f:
+        f.truncate(size - 3)
+    kv2 = KvStore(str(tmp_path / "kv")).start()
+    assert kv2.get(KeySpace.testing, b"a") == b"1"
+    assert kv2.get(KeySpace.testing, b"b") is None  # torn op dropped
+    kv2.stop()
+
+
+# ------------------------------------------------------------------ snapshots
+def test_snapshot_roundtrip(tmp_path):
+    p = str(tmp_path / "snap")
+    write_snapshot(p, b"meta", b"payload-bytes")
+    assert read_snapshot(p) == (b"meta", b"payload-bytes")
+
+
+def test_snapshot_corruption_detected(tmp_path):
+    from redpanda_tpu.storage.snapshot import SnapshotError
+
+    p = str(tmp_path / "snap")
+    write_snapshot(p, b"meta", b"payload-bytes")
+    blob = bytearray(open(p, "rb").read())
+    blob[-2] ^= 1
+    open(p, "wb").write(blob)
+    with pytest.raises(SnapshotError):
+        read_snapshot(p)
+
+
+# ------------------------------------------------------------------ manager
+def test_log_manager_manage_and_remove(tmp_path):
+    async def main():
+        mgr = LogManager(LogConfig(base_dir=str(tmp_path)))
+        a = await mgr.manage(NTP.kafka("a", 0))
+        b = await mgr.manage(NTP.kafka("b", 1))
+        assert a is await mgr.manage(NTP.kafka("a", 0))
+        await a.append([_batch(1)])
+        await mgr.remove(NTP.kafka("a", 0))
+        assert mgr.get(NTP.kafka("a", 0)) is None
+        assert not os.path.exists(os.path.join(str(tmp_path), "kafka/a/0"))
+        await mgr.stop()
+        _ = b
+
+    _run(main())
+
+
+# ------------------------------------------------------------------ opfuzz
+def test_opfuzz_random_interleaving(tmp_path):
+    """Randomized append/read/truncate/prefix/roll/reopen against a model."""
+
+    async def main():
+        rng = np.random.default_rng(1234)
+        ntp = NTP.kafka("fuzz", 0)
+        cfg = LogConfig(base_dir=str(tmp_path), max_segment_size=600)
+        log = await DiskLog.open(ntp, cfg)
+        model: list[RecordBatch] = []  # mirrors expected visible batches
+        start_offset = 0
+
+        def dirty():
+            return model[-1].last_offset if model else start_offset - 1
+
+        for step in range(120):
+            op = rng.choice(["append", "read", "truncate", "prefix", "reopen"], p=[0.5, 0.2, 0.1, 0.1, 0.1])
+            if op == "append":
+                n = int(rng.integers(1, 4))
+                b = _batch(n, value_size=int(rng.integers(8, 80)))
+                r = await log.append([b])
+                expected_base = dirty() + 1
+                assert r.base_offset == expected_base, f"step {step}"
+                model.append(b.with_base_offset(expected_base))
+            elif op == "read":
+                got = await log.read(start_offset, max_bytes=1 << 30)
+                want = [b for b in model if b.last_offset >= start_offset]
+                assert [g.base_offset for g in got] == [w.base_offset for w in want], f"step {step}"
+                assert all(g.verify_kafka_crc() for g in got)
+            elif op == "truncate" and model:
+                cut = int(rng.integers(start_offset, dirty() + 2))
+                await log.truncate(cut)
+                model = [b for b in model if b.last_offset < cut]
+            elif op == "prefix" and model:
+                cut = int(rng.integers(start_offset, dirty() + 2))
+                await log.prefix_truncate(cut)
+                start_offset = max(start_offset, cut)
+            elif op == "reopen":
+                await log.flush()
+                await log.close()
+                log = await DiskLog.open(ntp, cfg)
+                assert log.offsets().dirty_offset == dirty(), f"step {step}"
+        await log.close()
+
+    _run(main())
