@@ -1,0 +1,18 @@
+"""Cluster layer: partition facade, topic metadata, partition manager.
+
+Parity with src/v/cluster. Phase-3 scope is single-node: the ``Partition``
+facade fronts a pluggable consensus (direct-log for one node, raft once the
+consensus layer lands — mirroring cluster::partition over raft::consensus,
+cluster/partition.h:34).
+"""
+
+from redpanda_tpu.cluster.partition import Partition, PartitionManager
+from redpanda_tpu.cluster.topic_table import TopicConfig, TopicMetadata, TopicTable
+
+__all__ = [
+    "Partition",
+    "PartitionManager",
+    "TopicConfig",
+    "TopicMetadata",
+    "TopicTable",
+]

@@ -1,0 +1,171 @@
+"""Partition facade + partition manager.
+
+Parity with cluster::partition (cluster/partition.h:34-69) and
+cluster::partition_manager (partition_manager.cc:53): the partition is the
+broker-facing handle for one replicated log — replicate / make_reader /
+offsets — delegating to a consensus implementation. Single-node mode uses
+``DirectConsensus`` (append straight to storage, always leader); the raft
+layer plugs in behind the same interface.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from redpanda_tpu.models.fundamental import NTP, NodeId
+from redpanda_tpu.models.record import RecordBatch, RecordBatchType
+from redpanda_tpu.storage.log import DiskLog
+from redpanda_tpu.storage.log_manager import StorageApi
+
+
+class ConsistencyLevel:
+    """raft/types.h consistency levels."""
+
+    quorum_ack = 0  # acks=-1
+    leader_ack = 1  # acks=1
+    no_ack = 2  # acks=0
+
+
+@dataclass
+class ReplicateResult:
+    base_offset: int
+    last_offset: int
+
+
+class DirectConsensus:
+    """Single-node consensus: the local log IS the replicated log.
+
+    Mirrors the no-raft slice of SURVEY.md §7 step 3; replaced by
+    raft.Consensus for replicated topics.
+    """
+
+    def __init__(self, log: DiskLog, node_id: NodeId, term: int = 0):
+        self.log = log
+        self.node_id = node_id
+        self._term = term
+
+    @property
+    def term(self) -> int:
+        return self._term
+
+    def is_leader(self) -> bool:
+        return True
+
+    @property
+    def leader_id(self) -> NodeId | None:
+        return self.node_id
+
+    @property
+    def committed_offset(self) -> int:
+        return self.log.offsets().dirty_offset
+
+    @property
+    def last_stable_offset(self) -> int:
+        return self.committed_offset + 1  # exclusive, kafka LSO convention
+
+    @property
+    def start_offset(self) -> int:
+        return self.log.offsets().start_offset
+
+    async def replicate(self, batches: list[RecordBatch], level: int) -> ReplicateResult:
+        res = await self.log.append(batches, term=self._term)
+        if level == ConsistencyLevel.quorum_ack:
+            await self.log.flush()
+        return ReplicateResult(res.base_offset, res.last_offset)
+
+    async def make_reader(
+        self, start: int, max_bytes: int, max_offset: int | None = None
+    ) -> list[RecordBatch]:
+        return await self.log.read(
+            start,
+            max_bytes,
+            max_offset=max_offset,
+            type_filter=(RecordBatchType.raft_data,),
+        )
+
+
+class Partition:
+    """Broker-facing partition handle (cluster/partition.h:34)."""
+
+    def __init__(self, ntp: NTP, consensus, log: DiskLog):
+        self.ntp = ntp
+        self.consensus = consensus
+        self.log = log
+
+    # -------------------------------------------------------------- state
+    def is_leader(self) -> bool:
+        return self.consensus.is_leader()
+
+    @property
+    def leader_id(self) -> NodeId | None:
+        return self.consensus.leader_id
+
+    @property
+    def term(self) -> int:
+        return self.consensus.term
+
+    @property
+    def start_offset(self) -> int:
+        return self.consensus.start_offset
+
+    @property
+    def high_watermark(self) -> int:
+        """Exclusive next-offset convention, like kafka HWM."""
+        return self.consensus.committed_offset + 1
+
+    @property
+    def last_stable_offset(self) -> int:
+        return self.consensus.last_stable_offset
+
+    # -------------------------------------------------------------- io
+    async def replicate(self, batches: list[RecordBatch], level: int) -> ReplicateResult:
+        return await self.consensus.replicate(batches, level)
+
+    async def make_reader(
+        self, start: int, max_bytes: int = 1 << 20, max_offset: int | None = None
+    ) -> list[RecordBatch]:
+        if max_offset is None:
+            max_offset = self.high_watermark - 1
+        if start > max_offset:
+            return []
+        return await self.consensus.make_reader(start, max_bytes, max_offset)
+
+    async def timequery(self, ts: int) -> int | None:
+        return await self.log.timequery(ts)
+
+    async def prefix_truncate(self, offset: int) -> None:
+        await self.log.prefix_truncate(offset)
+
+
+class PartitionManager:
+    """Creates/looks up partitions over the storage api
+    (cluster/partition_manager.cc:53 manage())."""
+
+    def __init__(self, storage: StorageApi, node_id: NodeId):
+        self.storage = storage
+        self.node_id = node_id
+        self._partitions: dict[NTP, Partition] = {}
+
+    async def manage(self, ntp: NTP, *, term: int = 0) -> Partition:
+        if ntp in self._partitions:
+            return self._partitions[ntp]
+        log = await self.storage.log_mgr.manage(ntp)
+        consensus = DirectConsensus(log, self.node_id, term)
+        p = Partition(ntp, consensus, log)
+        self._partitions[ntp] = p
+        return p
+
+    def attach(self, ntp: NTP, partition: Partition) -> None:
+        """Register an externally built partition (raft-backed)."""
+        self._partitions[ntp] = partition
+
+    def get(self, ntp: NTP) -> Partition | None:
+        return self._partitions.get(ntp)
+
+    def partitions(self) -> dict[NTP, Partition]:
+        return dict(self._partitions)
+
+    async def remove(self, ntp: NTP) -> None:
+        p = self._partitions.pop(ntp, None)
+        if p is not None:
+            await self.storage.log_mgr.remove(ntp)

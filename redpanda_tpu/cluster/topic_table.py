@@ -1,0 +1,148 @@
+"""Topic metadata table.
+
+Parity with cluster::topic_table (cluster/topic_table.h): the in-memory
+source of truth for topic/partition metadata plus a delta stream consumed by
+reconciliation (controller_backend.cc:202). In single-node mode mutations
+are applied locally; once the controller lands, mutations arrive as applied
+controller commands.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+from dataclasses import dataclass, field
+
+from redpanda_tpu.models.fundamental import NTP, DEFAULT_NAMESPACE, NodeId
+
+
+@dataclass
+class TopicConfig:
+    name: str
+    partition_count: int
+    replication_factor: int = 1
+    ns: str = DEFAULT_NAMESPACE
+    cleanup_policy: str = "delete"
+    retention_bytes: int | None = None
+    retention_ms: int | None = None
+    segment_size: int | None = None
+    compression: str = "producer"
+    extra: dict[str, str] = field(default_factory=dict)
+
+    def config_map(self) -> dict[str, str | None]:
+        m: dict[str, str | None] = {
+            "cleanup.policy": self.cleanup_policy,
+            "compression.type": self.compression,
+            "retention.bytes": None if self.retention_bytes is None else str(self.retention_bytes),
+            "retention.ms": None if self.retention_ms is None else str(self.retention_ms),
+        }
+        if self.segment_size is not None:
+            m["segment.bytes"] = str(self.segment_size)
+        m.update(self.extra)
+        return m
+
+
+@dataclass
+class PartitionAssignment:
+    ntp: NTP
+    replicas: list[NodeId]
+    leader: NodeId | None = None
+
+
+@dataclass
+class TopicMetadata:
+    config: TopicConfig
+    assignments: dict[int, PartitionAssignment] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.config.name
+
+
+class DeltaType(enum.IntEnum):
+    added = 0
+    removed = 1
+    updated = 2
+
+
+@dataclass
+class TopicDelta:
+    type: DeltaType
+    ntp: NTP
+    assignment: PartitionAssignment | None = None
+
+
+class TopicTable:
+    def __init__(self):
+        self._topics: dict[str, TopicMetadata] = {}
+        self._waiters: list[asyncio.Future] = []
+        self._deltas: list[TopicDelta] = []
+
+    # ------------------------------------------------------------ mutate
+    def add_topic(self, config: TopicConfig, replicas_for=lambda p: [0]) -> TopicMetadata:
+        if config.name in self._topics:
+            raise ValueError(f"topic exists: {config.name}")
+        md = TopicMetadata(config)
+        for p in range(config.partition_count):
+            ntp = NTP(config.ns, config.name, p)
+            reps = list(replicas_for(p))
+            md.assignments[p] = PartitionAssignment(ntp, reps, leader=reps[0] if reps else None)
+            self._push_delta(TopicDelta(DeltaType.added, ntp, md.assignments[p]))
+        self._topics[config.name] = md
+        return md
+
+    def remove_topic(self, name: str) -> TopicMetadata:
+        md = self._topics.pop(name)
+        for pa in md.assignments.values():
+            self._push_delta(TopicDelta(DeltaType.removed, pa.ntp))
+        return md
+
+    def add_partitions(self, name: str, new_count: int, replicas_for=lambda p: [0]) -> None:
+        md = self._topics[name]
+        old = md.config.partition_count
+        if new_count <= old:
+            raise ValueError("partition count can only grow")
+        for p in range(old, new_count):
+            ntp = NTP(md.config.ns, name, p)
+            reps = list(replicas_for(p))
+            md.assignments[p] = PartitionAssignment(ntp, reps, leader=reps[0] if reps else None)
+            self._push_delta(TopicDelta(DeltaType.added, ntp, md.assignments[p]))
+        md.config.partition_count = new_count
+
+    def set_leader(self, ntp: NTP, leader: NodeId | None) -> None:
+        md = self._topics.get(ntp.topic)
+        if md and ntp.partition in md.assignments:
+            md.assignments[ntp.partition].leader = leader
+            self._push_delta(TopicDelta(DeltaType.updated, ntp, md.assignments[ntp.partition]))
+
+    # ------------------------------------------------------------ query
+    def get(self, name: str) -> TopicMetadata | None:
+        return self._topics.get(name)
+
+    def contains(self, name: str) -> bool:
+        return name in self._topics
+
+    def topics(self) -> dict[str, TopicMetadata]:
+        return dict(self._topics)
+
+    def all_ntps(self) -> list[NTP]:
+        return [pa.ntp for md in self._topics.values() for pa in md.assignments.values()]
+
+    # ------------------------------------------------------------ deltas
+    def _push_delta(self, d: TopicDelta) -> None:
+        self._deltas.append(d)
+        waiters, self._waiters = self._waiters, []
+        for w in waiters:
+            if not w.done():
+                w.set_result(None)
+
+    def drain_deltas(self) -> list[TopicDelta]:
+        out, self._deltas = self._deltas, []
+        return out
+
+    async def wait_for_deltas(self) -> list[TopicDelta]:
+        if not self._deltas:
+            fut = asyncio.get_running_loop().create_future()
+            self._waiters.append(fut)
+            await fut
+        return self.drain_deltas()

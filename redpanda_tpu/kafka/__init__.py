@@ -1,0 +1,1 @@
+"""Kafka wire protocol server + embedded client (parity with src/v/kafka)."""

@@ -1,0 +1,10 @@
+"""Embedded async Kafka client (parity with src/v/kafka/client).
+
+Used in-process by the REST proxy, schema registry, and the coproc event
+listener, exactly as the reference's kafka::client is (client/client.h);
+also the primary test client since the framework is its own ecosystem.
+"""
+
+from redpanda_tpu.kafka.client.client import KafkaClient, BrokerConnection
+
+__all__ = ["KafkaClient", "BrokerConnection"]

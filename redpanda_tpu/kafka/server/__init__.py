@@ -1,0 +1,5 @@
+"""Kafka protocol server (parity with src/v/kafka/server)."""
+
+from redpanda_tpu.kafka.server.protocol import KafkaServer
+
+__all__ = ["KafkaServer"]

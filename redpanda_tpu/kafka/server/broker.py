@@ -1,0 +1,71 @@
+"""Broker aggregate: the service graph a request handler can reach.
+
+Parity with kafka::request_context's view of the world (metadata_cache,
+partition_manager, group router, quota manager — kafka/server/
+request_context.h) plus the topic mutation entry points that the reference
+routes through cluster::topics_frontend. Single-node phase: mutations apply
+locally; the controller replaces the mutation path later.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from redpanda_tpu.cluster.partition import Partition, PartitionManager
+from redpanda_tpu.cluster.topic_table import TopicConfig, TopicTable
+from redpanda_tpu.models.fundamental import NTP, DEFAULT_NAMESPACE, NodeId
+from redpanda_tpu.storage.log_manager import StorageApi
+
+
+@dataclass
+class BrokerConfig:
+    node_id: NodeId = 0
+    cluster_id: str = "redpanda_tpu"
+    advertised_host: str = "127.0.0.1"
+    advertised_port: int = 9092
+    data_dir: str = "/tmp/redpanda_tpu"
+    auto_create_topics: bool = True
+    default_partitions: int = 1
+    default_replication: int = 1
+    fetch_poll_interval_s: float = 0.02
+
+
+class Broker:
+    def __init__(self, config: BrokerConfig, storage: StorageApi):
+        self.config = config
+        self.storage = storage
+        self.topic_table = TopicTable()
+        self.partition_manager = PartitionManager(storage, config.node_id)
+        self.group_coordinator = None  # wired by the app once groups land
+        self.authorizer = None  # wired once security lands
+        self.coproc_api = None  # wired once the transform engine attaches
+        self.tx_coordinator = None  # wired once transactions land
+        self.quota_manager = None
+
+    # ------------------------------------------------------------ topics
+    async def create_topic(self, config: TopicConfig) -> None:
+        md = self.topic_table.add_topic(
+            config, replicas_for=lambda p: [self.config.node_id]
+        )
+        for pa in md.assignments.values():
+            await self.partition_manager.manage(pa.ntp)
+
+    async def delete_topic(self, name: str) -> None:
+        md = self.topic_table.remove_topic(name)
+        for pa in md.assignments.values():
+            await self.partition_manager.remove(pa.ntp)
+
+    async def create_partitions(self, name: str, new_count: int) -> None:
+        self.topic_table.add_partitions(
+            name, new_count, replicas_for=lambda p: [self.config.node_id]
+        )
+        md = self.topic_table.get(name)
+        for pa in md.assignments.values():
+            await self.partition_manager.manage(pa.ntp)
+
+    # ------------------------------------------------------------ lookup
+    def get_partition(self, topic: str, partition: int, ns: str = DEFAULT_NAMESPACE) -> Partition | None:
+        return self.partition_manager.get(NTP(ns, topic, partition))
+
+    def is_internal_topic(self, name: str) -> bool:
+        return name.startswith("__") or name.startswith("_redpanda")

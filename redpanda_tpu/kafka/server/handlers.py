@@ -1,0 +1,672 @@
+"""Kafka API handlers.
+
+Parity with kafka/server/handlers/ (one file per API in the reference; here
+one function per API, registered in ``build_dispatch_table`` — the analogue
+of process_request's dispatch table, requests.cc:216).
+
+Group/txn/sasl handlers are registered by their subsystems when those are
+wired onto the broker (group coordinator, tx coordinator, security), so this
+module only covers the data-plane + topic-admin APIs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from redpanda_tpu.kafka.protocol import messages as m
+from redpanda_tpu.kafka.protocol.batch import decode_wire_batches, encode_wire_batches
+from redpanda_tpu.kafka.protocol.errors import ErrorCode
+from redpanda_tpu.cluster.partition import ConsistencyLevel
+from redpanda_tpu.cluster.topic_table import TopicConfig
+
+E = ErrorCode
+
+
+def build_dispatch_table() -> dict:
+    return {
+        m.API_VERSIONS: handle_api_versions,
+        m.METADATA: handle_metadata,
+        m.PRODUCE: handle_produce,
+        m.FETCH: handle_fetch,
+        m.LIST_OFFSETS: handle_list_offsets,
+        m.CREATE_TOPICS: handle_create_topics,
+        m.DELETE_TOPICS: handle_delete_topics,
+        m.CREATE_PARTITIONS: handle_create_partitions,
+        m.DELETE_RECORDS: handle_delete_records,
+        m.DESCRIBE_CONFIGS: handle_describe_configs,
+        m.ALTER_CONFIGS: handle_alter_configs,
+        m.INCREMENTAL_ALTER_CONFIGS: handle_incremental_alter_configs,
+        m.DESCRIBE_LOG_DIRS: handle_describe_log_dirs,
+        m.FIND_COORDINATOR: handle_find_coordinator,
+    }
+
+
+# ---------------------------------------------------------------- api_versions
+async def handle_api_versions(ctx) -> dict:
+    return {
+        "error_code": 0,
+        "api_keys": [
+            {"api_key": a.key, "min_version": a.min_version, "max_version": a.max_version}
+            for a in sorted(m.APIS.values(), key=lambda a: a.key)
+        ],
+        "throttle_time_ms": 0,
+    }
+
+
+# ---------------------------------------------------------------- metadata
+async def handle_metadata(ctx) -> dict:
+    broker = ctx.broker
+    cfg = broker.config
+    requested = ctx.request.get("topics")
+    names: list[str]
+    if requested is None or (ctx.api_version == 0 and not requested):
+        names = sorted(broker.topic_table.topics())
+    else:
+        names = [t["name"] for t in requested]
+        allow_auto = ctx.request.get("allow_auto_topic_creation", True)
+        if cfg.auto_create_topics and allow_auto:
+            for name in names:
+                if not broker.topic_table.contains(name) and _valid_topic_name(name):
+                    try:
+                        await broker.create_topic(
+                            TopicConfig(
+                                name,
+                                cfg.default_partitions,
+                                cfg.default_replication,
+                            )
+                        )
+                    except ValueError:
+                        pass  # concurrent create
+    topics = []
+    for name in names:
+        md = broker.topic_table.get(name)
+        if md is None:
+            code = (
+                E.invalid_topic_exception
+                if not _valid_topic_name(name)
+                else E.unknown_topic_or_partition
+            )
+            topics.append({"error_code": int(code), "name": name, "partitions": []})
+            continue
+        partitions = []
+        for idx in sorted(md.assignments):
+            pa = md.assignments[idx]
+            partitions.append(
+                {
+                    "error_code": 0,
+                    "partition_index": idx,
+                    "leader_id": pa.leader if pa.leader is not None else -1,
+                    "replica_nodes": list(pa.replicas),
+                    "isr_nodes": list(pa.replicas),
+                    "offline_replicas": [],
+                }
+            )
+        topics.append(
+            {
+                "error_code": 0,
+                "name": name,
+                "is_internal": broker.is_internal_topic(name),
+                "partitions": partitions,
+            }
+        )
+    return {
+        "brokers": [
+            {
+                "node_id": cfg.node_id,
+                "host": cfg.advertised_host,
+                "port": cfg.advertised_port,
+                "rack": None,
+            }
+        ],
+        "cluster_id": cfg.cluster_id,
+        "controller_id": cfg.node_id,
+        "topics": topics,
+    }
+
+
+def _valid_topic_name(name: str) -> bool:
+    return (
+        0 < len(name) <= 249
+        and name not in (".", "..")
+        and all(c.isalnum() or c in "._-" for c in name)
+    )
+
+
+# ---------------------------------------------------------------- produce
+async def handle_produce(ctx) -> dict | None:
+    acks = ctx.request["acks"]
+    if acks not in (-1, 0, 1):
+        responses = [
+            {
+                "name": t["name"],
+                "partitions": [
+                    _produce_partition_error(p["partition_index"], E.invalid_required_acks)
+                    for p in t["partitions"]
+                ],
+            }
+            for t in ctx.request["topics"]
+        ]
+        return {"responses": responses}
+    level = {
+        -1: ConsistencyLevel.quorum_ack,
+        0: ConsistencyLevel.no_ack,
+        1: ConsistencyLevel.leader_ack,
+    }[acks]
+    responses = []
+    for t in ctx.request["topics"]:
+        parts = await asyncio.gather(
+            *(
+                _produce_one(ctx.broker, t["name"], p, level)
+                for p in t["partitions"]
+            )
+        )
+        responses.append({"name": t["name"], "partitions": list(parts)})
+    if acks == 0:
+        return None
+    return {"responses": responses, "throttle_time_ms": 0}
+
+
+def _produce_partition_error(index: int, code: ErrorCode) -> dict:
+    return {
+        "partition_index": index,
+        "error_code": int(code),
+        "base_offset": -1,
+        "log_append_time_ms": -1,
+        "log_start_offset": -1,
+    }
+
+
+async def _produce_one(broker, topic: str, p: dict, level: int) -> dict:
+    index = p["partition_index"]
+    partition = broker.get_partition(topic, index)
+    if partition is None:
+        return _produce_partition_error(index, E.unknown_topic_or_partition)
+    if not partition.is_leader():
+        return _produce_partition_error(index, E.not_leader_for_partition)
+    records = p.get("records")
+    if not records:
+        return _produce_partition_error(index, E.invalid_record)
+    try:
+        adapted = decode_wire_batches(records, verify_crc=True)
+    except EOFError:
+        return _produce_partition_error(index, E.corrupt_message)
+    batches = []
+    for a in adapted:
+        # kafka_batch_adapter.cc:93-121: reject legacy magic and bad CRC
+        if not a.v2_format:
+            return _produce_partition_error(index, E.unsupported_for_message_format)
+        if not a.valid_crc:
+            return _produce_partition_error(index, E.corrupt_message)
+        batches.append(a.batch)
+    if not batches:
+        return _produce_partition_error(index, E.invalid_record)
+    result = await partition.replicate(batches, level)
+    return {
+        "partition_index": index,
+        "error_code": 0,
+        "base_offset": result.base_offset,
+        "log_append_time_ms": -1,
+        "log_start_offset": partition.start_offset,
+    }
+
+
+# ---------------------------------------------------------------- fetch
+async def handle_fetch(ctx) -> dict:
+    req = ctx.request
+    max_wait_ms = req.get("max_wait_ms", 0)
+    min_bytes = max(req.get("min_bytes", 0), 0)
+    max_bytes = req.get("max_bytes", 0x7FFFFFFF)
+    deadline = time.monotonic() + max(max_wait_ms, 0) / 1000.0
+    poll = ctx.broker.config.fetch_poll_interval_s
+    while True:
+        responses, total, any_error = await _fetch_once(ctx, max_bytes)
+        # respond immediately on any partition error (kafka semantics) or
+        # once min_bytes is satisfied / the wait budget is spent
+        if any_error or total >= min_bytes or time.monotonic() >= deadline:
+            break
+        await asyncio.sleep(min(poll, max(deadline - time.monotonic(), 0)))
+    out = {"responses": responses}
+    if ctx.api_version >= 7:
+        out["error_code"] = 0
+        out["session_id"] = req.get("session_id", 0)
+    return out
+
+
+async def _fetch_once(ctx, max_bytes: int) -> tuple[list, int, bool]:
+    broker = ctx.broker
+    responses = []
+    total = 0
+    any_error = False
+    budget = max_bytes
+    for t in ctx.request.get("topics") or []:
+        parts = []
+        for p in t["partitions"]:
+            index = p["partition_index"]
+            partition = broker.get_partition(t["name"], index)
+            if partition is None:
+                parts.append(_fetch_partition_error(index, E.unknown_topic_or_partition))
+                any_error = True
+                continue
+            if not partition.is_leader():
+                parts.append(_fetch_partition_error(index, E.not_leader_for_partition))
+                any_error = True
+                continue
+            hwm = partition.high_watermark
+            fetch_offset = p["fetch_offset"]
+            if fetch_offset < partition.start_offset or fetch_offset > hwm:
+                parts.append(_fetch_partition_error(index, E.offset_out_of_range, hwm=hwm))
+                any_error = True
+                continue
+            take = min(p.get("partition_max_bytes", budget), max(budget, 0))
+            batches = (
+                await partition.make_reader(fetch_offset, take, max_offset=hwm - 1)
+                if take > 0
+                else []
+            )
+            records = encode_wire_batches(batches) if batches else b""
+            total += len(records)
+            budget -= len(records)
+            parts.append(
+                {
+                    "partition_index": index,
+                    "error_code": 0,
+                    "high_watermark": hwm,
+                    "last_stable_offset": partition.last_stable_offset,
+                    "log_start_offset": partition.start_offset,
+                    "aborted_transactions": None,
+                    "preferred_read_replica": -1,
+                    "records": records or None,
+                }
+            )
+        responses.append({"name": t["name"], "partitions": parts})
+    return responses, total, any_error
+
+
+def _fetch_partition_error(index: int, code: ErrorCode, hwm: int = -1) -> dict:
+    return {
+        "partition_index": index,
+        "error_code": int(code),
+        "high_watermark": hwm,
+        "last_stable_offset": -1,
+        "log_start_offset": -1,
+        "aborted_transactions": None,
+        "preferred_read_replica": -1,
+        "records": None,
+    }
+
+
+# ---------------------------------------------------------------- list_offsets
+async def handle_list_offsets(ctx) -> dict:
+    broker = ctx.broker
+    topics = []
+    for t in ctx.request.get("topics") or []:
+        parts = []
+        for p in t["partitions"]:
+            index = p["partition_index"]
+            partition = broker.get_partition(t["name"], index)
+            if partition is None:
+                parts.append(
+                    {
+                        "partition_index": index,
+                        "error_code": int(E.unknown_topic_or_partition),
+                        "timestamp": -1,
+                        "offset": -1,
+                        "old_style_offsets": [],
+                    }
+                )
+                continue
+            ts = p["timestamp"]
+            if ts == -1:  # latest
+                offset = partition.high_watermark
+            elif ts == -2:  # earliest
+                offset = partition.start_offset
+            else:
+                q = await partition.timequery(ts)
+                offset = q if q is not None else -1
+            parts.append(
+                {
+                    "partition_index": index,
+                    "error_code": 0,
+                    "timestamp": -1,
+                    "offset": offset,
+                    "old_style_offsets": [offset] if offset >= 0 else [],
+                }
+            )
+        topics.append({"name": t["name"], "partitions": parts})
+    return {"topics": topics}
+
+
+# ---------------------------------------------------------------- topic admin
+async def handle_create_topics(ctx) -> dict:
+    broker = ctx.broker
+    validate_only = ctx.request.get("validate_only", False)
+    results = []
+    for t in ctx.request.get("topics") or []:
+        name = t["name"]
+        if not _valid_topic_name(name):
+            results.append(_topic_result(name, E.invalid_topic_exception))
+            continue
+        if broker.topic_table.contains(name):
+            results.append(_topic_result(name, E.topic_already_exists))
+            continue
+        num_partitions = t.get("num_partitions", -1)
+        if num_partitions == -1:
+            num_partitions = broker.config.default_partitions
+        if num_partitions <= 0:
+            results.append(_topic_result(name, E.invalid_partitions))
+            continue
+        replication = t.get("replication_factor", -1)
+        if replication == -1:
+            replication = broker.config.default_replication
+        cfg = TopicConfig(name, num_partitions, replication)
+        for c in t.get("configs") or []:
+            _apply_topic_config(cfg, c["name"], c["value"])
+        if not validate_only:
+            await broker.create_topic(cfg)
+        results.append(_topic_result(name, E.none))
+    return {"topics": results}
+
+
+def _topic_result(name: str, code: ErrorCode, msg: str | None = None) -> dict:
+    return {"name": name, "error_code": int(code), "error_message": msg}
+
+
+def _apply_topic_config(cfg: TopicConfig, key: str, value: str | None) -> None:
+    if value is None:
+        return
+    if key == "cleanup.policy":
+        cfg.cleanup_policy = value
+    elif key == "retention.bytes":
+        cfg.retention_bytes = int(value)
+    elif key == "retention.ms":
+        cfg.retention_ms = int(value)
+    elif key == "segment.bytes":
+        cfg.segment_size = int(value)
+    elif key == "compression.type":
+        cfg.compression = value
+    else:
+        cfg.extra[key] = value
+
+
+async def handle_delete_topics(ctx) -> dict:
+    broker = ctx.broker
+    responses = []
+    for name in ctx.request.get("topic_names") or []:
+        if not broker.topic_table.contains(name):
+            responses.append({"name": name, "error_code": int(E.unknown_topic_or_partition)})
+            continue
+        await broker.delete_topic(name)
+        responses.append({"name": name, "error_code": 0})
+    return {"responses": responses}
+
+
+async def handle_create_partitions(ctx) -> dict:
+    broker = ctx.broker
+    results = []
+    for t in ctx.request.get("topics") or []:
+        name = t["name"]
+        md = broker.topic_table.get(name)
+        if md is None:
+            results.append(_topic_result(name, E.unknown_topic_or_partition))
+            continue
+        if t["count"] <= md.config.partition_count:
+            results.append(
+                _topic_result(
+                    name, E.invalid_partitions, "partition count can only grow"
+                )
+            )
+            continue
+        if not ctx.request.get("validate_only", False):
+            await broker.create_partitions(name, t["count"])
+        results.append(_topic_result(name, E.none))
+    return {"results": results}
+
+
+async def handle_delete_records(ctx) -> dict:
+    broker = ctx.broker
+    topics = []
+    for t in ctx.request.get("topics") or []:
+        parts = []
+        for p in t["partitions"]:
+            index = p["partition_index"]
+            partition = broker.get_partition(t["name"], index)
+            if partition is None:
+                parts.append(
+                    {
+                        "partition_index": index,
+                        "low_watermark": -1,
+                        "error_code": int(E.unknown_topic_or_partition),
+                    }
+                )
+                continue
+            offset = p["offset"]
+            if offset == -1:
+                offset = partition.high_watermark
+            if offset > partition.high_watermark:
+                parts.append(
+                    {
+                        "partition_index": index,
+                        "low_watermark": -1,
+                        "error_code": int(E.offset_out_of_range),
+                    }
+                )
+                continue
+            await partition.prefix_truncate(offset)
+            parts.append(
+                {
+                    "partition_index": index,
+                    "low_watermark": partition.start_offset,
+                    "error_code": 0,
+                }
+            )
+        topics.append({"name": t["name"], "partitions": parts})
+    return {"topics": topics}
+
+
+# ---------------------------------------------------------------- configs
+_RESOURCE_TOPIC = 2
+_RESOURCE_BROKER = 4
+
+
+async def handle_describe_configs(ctx) -> dict:
+    broker = ctx.broker
+    results = []
+    for res in ctx.request.get("resources") or []:
+        rtype, rname = res["resource_type"], res["resource_name"]
+        keys = res.get("configuration_keys")
+        if rtype == _RESOURCE_TOPIC:
+            md = broker.topic_table.get(rname)
+            if md is None:
+                results.append(
+                    {
+                        "error_code": int(E.unknown_topic_or_partition),
+                        "error_message": None,
+                        "resource_type": rtype,
+                        "resource_name": rname,
+                        "configs": [],
+                    }
+                )
+                continue
+            cfg_map = md.config.config_map()
+        elif rtype == _RESOURCE_BROKER:
+            cfg_map = {
+                "auto.create.topics.enable": str(broker.config.auto_create_topics).lower(),
+                "num.partitions": str(broker.config.default_partitions),
+                "default.replication.factor": str(broker.config.default_replication),
+            }
+        else:
+            results.append(
+                {
+                    "error_code": int(E.invalid_request),
+                    "error_message": "unsupported resource type",
+                    "resource_type": rtype,
+                    "resource_name": rname,
+                    "configs": [],
+                }
+            )
+            continue
+        configs = [
+            {
+                "name": k,
+                "value": v,
+                "read_only": False,
+                "is_default": True,
+                "config_source": 5,  # DEFAULT_CONFIG
+                "is_sensitive": False,
+                "synonyms": [],
+            }
+            for k, v in cfg_map.items()
+            if keys is None or k in keys
+        ]
+        results.append(
+            {
+                "error_code": 0,
+                "error_message": None,
+                "resource_type": rtype,
+                "resource_name": rname,
+                "configs": configs,
+            }
+        )
+    return {"results": results}
+
+
+async def handle_alter_configs(ctx) -> dict:
+    broker = ctx.broker
+    responses = []
+    for res in ctx.request.get("resources") or []:
+        rtype, rname = res["resource_type"], res["resource_name"]
+        code = E.none
+        if rtype == _RESOURCE_TOPIC:
+            md = broker.topic_table.get(rname)
+            if md is None:
+                code = E.unknown_topic_or_partition
+            elif not ctx.request.get("validate_only", False):
+                for c in res.get("configs") or []:
+                    _apply_topic_config(md.config, c["name"], c["value"])
+        else:
+            code = E.invalid_request
+        responses.append(
+            {
+                "error_code": int(code),
+                "error_message": None,
+                "resource_type": rtype,
+                "resource_name": rname,
+            }
+        )
+    return {"responses": responses}
+
+
+async def handle_incremental_alter_configs(ctx) -> dict:
+    broker = ctx.broker
+    responses = []
+    for res in ctx.request.get("resources") or []:
+        rtype, rname = res["resource_type"], res["resource_name"]
+        code = E.none
+        if rtype == _RESOURCE_TOPIC:
+            md = broker.topic_table.get(rname)
+            if md is None:
+                code = E.unknown_topic_or_partition
+            elif not ctx.request.get("validate_only", False):
+                for c in res.get("configs") or []:
+                    op = c.get("config_operation", 0)
+                    if op == 0:  # SET
+                        _apply_topic_config(md.config, c["name"], c["value"])
+                    elif op == 1:  # DELETE
+                        md.config.extra.pop(c["name"], None)
+        else:
+            code = E.invalid_request
+        responses.append(
+            {
+                "error_code": int(code),
+                "error_message": None,
+                "resource_type": rtype,
+                "resource_name": rname,
+            }
+        )
+    return {"responses": responses}
+
+
+async def handle_describe_log_dirs(ctx) -> dict:
+    broker = ctx.broker
+    requested = ctx.request.get("topics")
+    wanted: dict[str, set[int]] | None = None
+    if requested is not None:
+        wanted = {t["topic"]: set(t["partitions"]) for t in requested}
+    by_topic: dict[str, list[dict]] = {}
+    for ntp, partition in broker.partition_manager.partitions().items():
+        if wanted is not None and (
+            ntp.topic not in wanted or ntp.partition not in wanted[ntp.topic]
+        ):
+            continue
+        size = sum(seg.size_bytes for seg in partition.log.segments)
+        by_topic.setdefault(ntp.topic, []).append(
+            {
+                "partition_index": ntp.partition,
+                "partition_size": size,
+                "offset_lag": 0,
+                "is_future_key": False,
+            }
+        )
+    return {
+        "results": [
+            {
+                "error_code": 0,
+                "log_dir": broker.config.data_dir,
+                "topics": [
+                    {"name": name, "partitions": parts}
+                    for name, parts in sorted(by_topic.items())
+                ],
+            }
+        ]
+    }
+
+
+# ---------------------------------------------------------------- coordinator
+async def handle_find_coordinator(ctx) -> dict:
+    cfg = ctx.broker.config
+    return {
+        "error_code": 0,
+        "error_message": None,
+        "node_id": cfg.node_id,
+        "host": cfg.advertised_host,
+        "port": cfg.advertised_port,
+    }
+
+
+# ---------------------------------------------------------------- error makers
+def _produce_error_maker(ctx, code: ErrorCode) -> dict:
+    return {
+        "responses": [
+            {
+                "name": t["name"],
+                "partitions": [
+                    _produce_partition_error(p["partition_index"], code)
+                    for p in t["partitions"]
+                ],
+            }
+            for t in ctx.request.get("topics") or []
+        ]
+    }
+
+
+def _fetch_error_maker(ctx, code: ErrorCode) -> dict:
+    return {
+        "error_code": int(code),
+        "responses": [
+            {
+                "name": t["name"],
+                "partitions": [
+                    _fetch_partition_error(p["partition_index"], code)
+                    for p in t["partitions"]
+                ],
+            }
+            for t in ctx.request.get("topics") or []
+        ],
+    }
+
+
+ERROR_RESPONSE_MAKERS = {
+    m.PRODUCE: _produce_error_maker,
+    m.FETCH: _fetch_error_maker,
+}
