@@ -1,0 +1,102 @@
+"""Failure-injection registry ("honey badger").
+
+Parity with finjector/hbadger.h:23-60: subsystems register named probes;
+tests (or the admin API) arm a probe on a module with one of three effects —
+raise an exception, delay, or terminate (here: raise SystemExit, since we
+have no per-shard process to kill). The reference compiles probes out of
+release builds (hbadger.h:30-37); here arming is a no-op unless
+``honey_badger.enable()`` was called, so production paths stay branch-cheap.
+
+Per-RPC-method probes are generated alongside services (tools/rpcgen.py:
+159-165 renders a failure_probes struct per service); rpc.service mirrors
+that by registering ``<service>.<method>`` probes automatically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+EXCEPTION = "exception"
+DELAY = "delay"
+TERMINATE = "terminate"
+
+
+class ProbeTriggered(Exception):
+    """Raised by an armed 'exception' probe."""
+
+
+@dataclass
+class _Module:
+    probes: set = field(default_factory=set)
+    armed: dict = field(default_factory=dict)  # probe -> effect
+
+
+class HoneyBadger:
+    def __init__(self) -> None:
+        self._enabled = False
+        self._modules: dict[str, _Module] = defaultdict(_Module)
+        self.delay_ms = 50
+
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+        for m in self._modules.values():
+            m.armed.clear()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def register_probe(self, module: str, *probes: str) -> None:
+        self._modules[module].probes.update(probes)
+
+    def modules(self) -> dict[str, list[str]]:
+        return {name: sorted(m.probes) for name, m in self._modules.items()}
+
+    def set_exception(self, module: str, probe: str) -> None:
+        self._arm(module, probe, EXCEPTION)
+
+    def set_delay(self, module: str, probe: str) -> None:
+        self._arm(module, probe, DELAY)
+
+    def set_termination(self, module: str, probe: str) -> None:
+        self._arm(module, probe, TERMINATE)
+
+    def unset(self, module: str, probe: str) -> None:
+        self._modules[module].armed.pop(probe, None)
+
+    def _arm(self, module: str, probe: str, effect: str) -> None:
+        if not self._enabled:
+            return
+        self._modules[module].armed[probe] = effect
+
+    async def maybe_inject(self, module: str, probe: str) -> None:
+        """Await point placed at each probe site."""
+        if not self._enabled:
+            return
+        effect = self._modules[module].armed.get(probe)
+        if effect is None:
+            return
+        if effect == DELAY:
+            await asyncio.sleep(self.delay_ms / 1000)
+        elif effect == EXCEPTION:
+            raise ProbeTriggered(f"{module}.{probe}")
+        elif effect == TERMINATE:
+            raise SystemExit(f"honey badger terminate: {module}.{probe}")
+
+    def inject_sync(self, module: str, probe: str) -> None:
+        """Synchronous probe site (storage paths)."""
+        if not self._enabled:
+            return
+        effect = self._modules[module].armed.get(probe)
+        if effect == EXCEPTION:
+            raise ProbeTriggered(f"{module}.{probe}")
+        if effect == TERMINATE:
+            raise SystemExit(f"honey badger terminate: {module}.{probe}")
+
+
+honey_badger = HoneyBadger()

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 import struct
 
 from redpanda_tpu.kafka.protocol import messages as m
@@ -17,6 +18,8 @@ from redpanda_tpu.kafka.protocol.errors import ErrorCode, KafkaError
 from redpanda_tpu.kafka.protocol.primitives import Reader
 from redpanda_tpu.kafka.protocol.schema import RequestHeader, decode_message, encode_message
 from redpanda_tpu.models.record import Record, RecordBatch
+
+logger = logging.getLogger("rptpu.kafka.client")
 
 
 class BrokerConnection:
@@ -116,7 +119,12 @@ class BrokerConnection:
                 except Exception as e:  # noqa: BLE001
                     if not fut.done():
                         fut.set_exception(e)
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            pass
+        except Exception:  # noqa: BLE001 — any framing error kills the connection
+            logger.exception("broker connection receive loop failed")
+        finally:
+            # Whatever ended the loop, nothing will ever complete these.
             for entry in self._inflight.values():
                 fut = entry[0]
                 if not fut.done():

@@ -225,12 +225,28 @@ async def handle_fetch(ctx) -> dict:
         # once min_bytes is satisfied / the wait budget is spent
         if any_error or total >= min_bytes or time.monotonic() >= deadline:
             break
-        await asyncio.sleep(min(poll, max(deadline - time.monotonic(), 0)))
+        # Long-poll gate: re-reading and re-encoding every poll tick is
+        # wasted work — only rerun _fetch_once after some requested
+        # partition's high watermark advances.
+        hwms = _fetch_hwm_snapshot(ctx)
+        while time.monotonic() < deadline:
+            await asyncio.sleep(min(poll, max(deadline - time.monotonic(), 0)))
+            if _fetch_hwm_snapshot(ctx) != hwms:
+                break
     out = {"responses": responses}
     if ctx.api_version >= 7:
         out["error_code"] = 0
         out["session_id"] = req.get("session_id", 0)
     return out
+
+
+def _fetch_hwm_snapshot(ctx) -> tuple:
+    out = []
+    for t in ctx.request.get("topics") or []:
+        for p in t["partitions"]:
+            part = ctx.broker.get_partition(t["name"], p["partition_index"])
+            out.append(part.high_watermark if part is not None else -1)
+    return tuple(out)
 
 
 async def _fetch_once(ctx, max_bytes: int) -> tuple[list, int, bool]:

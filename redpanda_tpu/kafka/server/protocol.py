@@ -5,7 +5,9 @@ apply loop; connection_context.cc:32 process_one_request, :215
 dispatch_method_once): size-prefixed frames, per-connection **staged
 pipelining** — each request's handler runs as its own task so handlers
 overlap, while a writer fiber drains responses strictly in request order —
-and a memory gate sized like the reference's size-gated memory units.
+with pipeline depth bounded per connection (the reference gates on
+size-based memory units; here the response queue is bounded, so one
+connection can hold at most MAX_PIPELINE frames in flight).
 """
 
 from __future__ import annotations
@@ -16,7 +18,7 @@ import struct
 
 from redpanda_tpu.kafka.protocol.errors import ErrorCode, KafkaError
 from redpanda_tpu.kafka.protocol.messages import API_VERSIONS, APIS
-from redpanda_tpu.kafka.protocol.primitives import Reader, Writer
+from redpanda_tpu.kafka.protocol.primitives import Reader
 from redpanda_tpu.kafka.protocol.schema import (
     RequestHeader,
     decode_message,
@@ -27,6 +29,7 @@ from redpanda_tpu.kafka.protocol.schema import (
 logger = logging.getLogger("rptpu.kafka")
 
 MAX_REQUEST_SIZE = 100 * 1024 * 1024
+MAX_PIPELINE = 64  # max in-flight requests per connection
 
 
 class RequestContext:
@@ -52,7 +55,10 @@ class Connection:
         self.writer = writer
         self.sasl_state = None  # set by the sasl handlers
         self.authenticated_principal: str | None = None
-        self._responses: asyncio.Queue[asyncio.Task | None] = asyncio.Queue()
+        # Bounded: `await put` backpressures the read loop once MAX_PIPELINE
+        # requests are in flight on this connection.
+        self._responses: asyncio.Queue[asyncio.Task | None] = asyncio.Queue(maxsize=MAX_PIPELINE)
+        self._handler_tasks: set[asyncio.Task] = set()
 
     async def run(self) -> None:
         writer_task = asyncio.create_task(self._drain_responses())
@@ -67,22 +73,33 @@ class Connection:
                 # handler as a task so handlers overlap while the writer
                 # fiber drains responses strictly in request order.
                 decoded = self._decode_frame(frame)
+                if decoded is None:
+                    break  # fatal protocol error: close the connection
                 if isinstance(decoded, bytes):
                     done: asyncio.Future = asyncio.get_running_loop().create_future()
                     done.set_result(decoded)
                     await self._responses.put(done)
                 else:
                     task = asyncio.create_task(self._dispatch(*decoded))
+                    self._handler_tasks.add(task)
+                    task.add_done_callback(self._handler_tasks.discard)
                     await self._responses.put(task)
         except asyncio.CancelledError:
             cancelled = True
             raise
         finally:
-            self._responses.put_nowait(None)
             if cancelled:
+                # Server shutdown: stop in-flight handlers (they may be
+                # long-polling fetches) before tearing down the writer.
+                for t in list(self._handler_tasks):
+                    t.cancel()
                 writer_task.cancel()
             else:
+                # Normal close: let queued handlers finish and drain.
+                self._responses.put_nowait(None)
                 await writer_task
+            if self._handler_tasks:
+                await asyncio.gather(*self._handler_tasks, return_exceptions=True)
             self.writer.close()
             try:
                 await self.writer.wait_closed()
@@ -119,8 +136,10 @@ class Connection:
         try:
             request = decode_message(api, "request", frame[r.pos :], header.api_version)
         except Exception:
+            # A frame we can't parse at a version we claim to support is a
+            # broken client; close rather than answer with garbage.
             logger.exception("decode failed for %s v%d", api.name, header.api_version)
-            return self._unsupported_version_response(header)
+            return None
         return header, api, request
 
     async def _dispatch(self, header: RequestHeader, api, request: dict) -> bytes | None:
@@ -142,11 +161,14 @@ class Connection:
         body = encode_message(api, "response", response, header.api_version)
         return encode_response_header(header.correlation_id, flexible_hdr) + body
 
-    def _unsupported_version_response(self, header: RequestHeader) -> bytes:
-        """Respond per KIP-511: unknown/unsupported api version -> error 35;
-        for ApiVersions include the supported range so the client downgrades."""
-        api = APIS.get(API_VERSIONS)
+    def _unsupported_version_response(self, header: RequestHeader) -> bytes | None:
+        """Per KIP-511, an unsupported ApiVersions request gets a v0 response
+        with the supported ranges so the client downgrades. For any other API
+        we cannot encode a response the client will parse at its requested
+        version, so close the connection (what real brokers do) by returning
+        the close sentinel."""
         if header.api_key == API_VERSIONS:
+            api = APIS.get(API_VERSIONS)
             body = encode_message(
                 api,
                 "response",
@@ -165,19 +187,12 @@ class Connection:
                 0,
             )
             return encode_response_header(header.correlation_id, False) + body
-        target = APIS.get(header.api_key)
-        if target is None:
-            logger.warning("unknown api key %d", header.api_key)
-            w = Writer().int16(int(ErrorCode.unsupported_version))
-            return encode_response_header(header.correlation_id, False) + w.build()
-        version = min(max(header.api_version, target.min_version), target.max_version)
-        body = encode_message(
-            target,
-            "response",
-            self.server.minimal_error_body(target, ErrorCode.unsupported_version),
-            version,
+        logger.warning(
+            "unsupported api key %d v%d from client; closing connection",
+            header.api_key,
+            header.api_version,
         )
-        return encode_response_header(header.correlation_id, False) + body
+        return None
 
     async def _drain_responses(self) -> None:
         while True:
@@ -186,6 +201,10 @@ class Connection:
                 return
             try:
                 payload = await task
+            except asyncio.CancelledError:
+                if isinstance(task, asyncio.Task) and task.cancelled():
+                    continue  # the handler was cancelled, not this fiber
+                raise
             except Exception:
                 logger.exception("response task failed")
                 continue

@@ -1,0 +1,237 @@
+"""Declarative binary serialization for internal RPC and disk types.
+
+The reference walks C++ structs at compile time (reflection/adl.h,
+reflection/to_tuple.h) and layers a versioned envelope on top
+(serde/envelope.h). Here the same information is a field table interpreted at
+runtime: ``Struct`` holds ordered (name, type) pairs; values travel as plain
+dicts. Everything is little-endian, matching adl.
+
+Envelope framing (serde/envelope.h): {version u8, compat_version u8,
+size u32} then the body; readers written against an older compat version
+reject newer incompatible payloads, and trailing bytes added by newer
+versions are skipped using the size field.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+
+class SerdeError(Exception):
+    pass
+
+
+# ------------------------------------------------------------------ writer/reader
+class Writer:
+    __slots__ = ("_parts",)
+
+    def __init__(self) -> None:
+        self._parts: list[bytes] = []
+
+    def raw(self, b: bytes) -> "Writer":
+        self._parts.append(bytes(b))
+        return self
+
+    def pack(self, fmt: str, *vals) -> "Writer":
+        self._parts.append(struct.pack("<" + fmt, *vals))
+        return self
+
+    def build(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class Reader:
+    __slots__ = ("_buf", "_pos")
+
+    def __init__(self, buf: bytes) -> None:
+        self._buf = bytes(buf)
+        self._pos = 0
+
+    def unpack(self, fmt: str):
+        s = struct.Struct("<" + fmt)
+        if self._pos + s.size > len(self._buf):
+            raise SerdeError("short buffer")
+        vals = s.unpack_from(self._buf, self._pos)
+        self._pos += s.size
+        return vals if len(vals) > 1 else vals[0]
+
+    def take(self, n: int) -> bytes:
+        if n < 0 or self._pos + n > len(self._buf):
+            raise SerdeError(f"short buffer: want {n}")
+        b = self._buf[self._pos : self._pos + n]
+        self._pos += n
+        return b
+
+    def skip(self, n: int) -> None:
+        self.take(n)
+
+    def remaining(self) -> int:
+        return len(self._buf) - self._pos
+
+
+# ------------------------------------------------------------------ types
+@dataclass(frozen=True)
+class Scalar:
+    fmt: str  # struct format char
+
+
+I8 = Scalar("b")
+U8 = Scalar("B")
+I16 = Scalar("h")
+U16 = Scalar("H")
+I32 = Scalar("i")
+U32 = Scalar("I")
+I64 = Scalar("q")
+U64 = Scalar("Q")
+F64 = Scalar("d")
+BOOL = Scalar("?")
+
+
+class _String:
+    pass
+
+
+class _Bytes:
+    pass
+
+
+STRING = _String()
+BYTES = _Bytes()
+
+
+@dataclass(frozen=True)
+class Vector:
+    inner: object
+
+
+@dataclass(frozen=True)
+class Optional:
+    inner: object
+
+
+@dataclass(frozen=True)
+class Map:
+    key: object
+    value: object
+
+
+@dataclass(frozen=True)
+class Struct:
+    fields: tuple  # of (name, type)
+
+    def encode(self, msg: dict) -> bytes:
+        w = Writer()
+        _write(w, self, msg)
+        return w.build()
+
+    def decode(self, buf: bytes) -> dict:
+        return _read(Reader(buf), self)
+
+
+def S(*fields) -> Struct:
+    return Struct(tuple(fields))
+
+
+@dataclass(frozen=True)
+class Envelope:
+    """serde::envelope-style versioned wrapper around a Struct."""
+
+    body: Struct
+    version: int = 0
+    compat_version: int = 0
+
+    def encode(self, msg: dict) -> bytes:
+        inner = self.body.encode(msg)
+        return struct.pack("<BBI", self.version, self.compat_version, len(inner)) + inner
+
+    def decode(self, buf: bytes) -> dict:
+        r = Reader(buf)
+        version, compat, size = r.unpack("BBI")
+        if compat > self.version:
+            raise SerdeError(
+                f"incompatible envelope: peer compat {compat} > our version {self.version}"
+            )
+        body = r.take(size)
+        return self.body.decode(body)
+
+
+# ------------------------------------------------------------------ codec core
+def _write(w: Writer, typ, value) -> None:
+    if isinstance(typ, Scalar):
+        w.pack(typ.fmt, value)
+    elif typ is STRING:
+        b = value.encode() if isinstance(value, str) else bytes(value)
+        w.pack("i", len(b)).raw(b)
+    elif typ is BYTES:
+        b = bytes(value)
+        w.pack("i", len(b)).raw(b)
+    elif isinstance(typ, Vector):
+        items = list(value)
+        w.pack("i", len(items))
+        for item in items:
+            _write(w, typ.inner, item)
+    elif isinstance(typ, Optional):
+        if value is None:
+            w.pack("b", 0)
+        else:
+            w.pack("b", 1)
+            _write(w, typ.inner, value)
+    elif isinstance(typ, Map):
+        items = sorted(value.items()) if isinstance(value, dict) else list(value)
+        w.pack("i", len(items))
+        for k, v in items:
+            _write(w, typ.key, k)
+            _write(w, typ.value, v)
+    elif isinstance(typ, Struct):
+        for name, ft in typ.fields:
+            _write(w, ft, value.get(name, _default(ft)) if isinstance(value, dict) else getattr(value, name))
+    elif isinstance(typ, Envelope):
+        w.raw(typ.encode(value))
+    else:
+        raise SerdeError(f"unknown type {typ!r}")
+
+
+def _read(r: Reader, typ):
+    if isinstance(typ, Scalar):
+        return r.unpack(typ.fmt)
+    if typ is STRING:
+        n = r.unpack("i")
+        return r.take(n).decode()
+    if typ is BYTES:
+        n = r.unpack("i")
+        return r.take(n)
+    if isinstance(typ, Vector):
+        n = r.unpack("i")
+        return [_read(r, typ.inner) for _ in range(n)]
+    if isinstance(typ, Optional):
+        return _read(r, typ.inner) if r.unpack("b") else None
+    if isinstance(typ, Map):
+        n = r.unpack("i")
+        return {_read(r, typ.key): _read(r, typ.value) for _ in range(n)}
+    if isinstance(typ, Struct):
+        return {name: _read(r, ft) for name, ft in typ.fields}
+    if isinstance(typ, Envelope):
+        version, compat, size = r.unpack("BBI")
+        if compat > typ.version:
+            raise SerdeError("incompatible nested envelope")
+        return typ.body.decode(r.take(size))
+    raise SerdeError(f"unknown type {typ!r}")
+
+
+def _default(typ):
+    if isinstance(typ, Scalar):
+        return 0
+    if typ is STRING:
+        return ""
+    if typ is BYTES:
+        return b""
+    if isinstance(typ, Vector):
+        return []
+    if isinstance(typ, Optional):
+        return None
+    if isinstance(typ, Map):
+        return {}
+    if isinstance(typ, Struct):
+        return {}
+    return None

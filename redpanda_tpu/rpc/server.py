@@ -1,0 +1,132 @@
+"""Internal RPC server.
+
+Parity with rpc::server (rpc/server.cc:47-99): an accept loop hands each
+connection to a pluggable ``protocol`` — the internal simple_protocol here;
+the Kafka layer plugs its own protocol into the same engine
+(kafka/server/protocol.py), mirroring how the reference reuses one server
+for both (application.cc:791-850).
+
+simple_protocol semantics (rpc/simple_protocol.cc): read header, verify
+checksums, look up method id; unknown id → status 404 in the reply header
+meta (simple_protocol.cc:101-103); handler exception → 500; per-connection
+requests may overlap, responses carry the request's correlation id.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from redpanda_tpu.rpc import wire
+from redpanda_tpu.rpc.service import ServiceHandler
+
+logger = logging.getLogger("rpc.server")
+
+
+class SimpleProtocol:
+    """Method-id dispatch over registered services."""
+
+    name = "vectorized internal rpc protocol"
+
+    def __init__(self) -> None:
+        self._methods: dict[int, ServiceHandler] = {}
+
+    def register_service(self, handler: ServiceHandler) -> None:
+        for mid in handler.method_ids():
+            if mid in self._methods:
+                raise ValueError(f"duplicate method id {mid:#x}")
+            self._methods[mid] = handler
+
+    async def apply(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        write_lock = asyncio.Lock()
+        pending: set[asyncio.Task] = set()
+        try:
+            while True:
+                try:
+                    raw = await reader.readexactly(wire.HEADER_SIZE)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                h = wire.Header.decode(raw)
+                payload = await reader.readexactly(h.payload_size)
+                body = wire.open_payload(h, payload)
+                # Handlers overlap across requests on one connection; each
+                # response is written atomically under the lock.
+                t = asyncio.ensure_future(
+                    self._handle_one(h, body, writer, write_lock)
+                )
+                pending.add(t)
+                t.add_done_callback(pending.discard)
+        finally:
+            for t in pending:
+                t.cancel()
+
+    async def _handle_one(self, h: wire.Header, body: bytes, writer, write_lock) -> None:
+        status = wire.STATUS_SUCCESS
+        handler = self._methods.get(h.meta)
+        if handler is None:
+            status, reply = wire.STATUS_METHOD_NOT_FOUND, b""
+        else:
+            try:
+                reply = await handler.dispatch(h.meta, body)
+            except asyncio.CancelledError:
+                raise
+            except SystemExit:
+                raise
+            except Exception:
+                logger.exception("rpc handler failed (method %#x)", h.meta)
+                status, reply = wire.STATUS_SERVER_ERROR, b""
+        out = wire.frame(reply, status, h.correlation_id)
+        async with write_lock:
+            try:
+                writer.write(out)
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+
+class Server:
+    """TCP accept loop with a pluggable protocol."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.host = host
+        self.port = port
+        self._protocol = None
+        self._server: asyncio.AbstractServer | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+
+    def set_protocol(self, protocol) -> None:
+        self._protocol = protocol
+
+    async def start(self) -> None:
+        assert self._protocol is not None, "set_protocol first"
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def _on_conn(self, reader, writer) -> None:
+        t = asyncio.current_task()
+        self._conn_tasks.add(t)
+        try:
+            await self._protocol.apply(reader, writer)
+        except (wire.WireError, ConnectionResetError) as e:
+            logger.debug("connection dropped: %s", e)
+        finally:
+            self._conn_tasks.discard(t)
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def stop(self) -> None:
+        # Cancel live connection handlers BEFORE wait_closed(): since
+        # Python 3.12 wait_closed blocks until every handler returns.
+        if self._server is not None:
+            self._server.close()
+        for t in list(self._conn_tasks):
+            t.cancel()
+        if self._conn_tasks:
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+        self._conn_tasks.clear()
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None

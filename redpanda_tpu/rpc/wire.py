@@ -1,0 +1,103 @@
+"""Internal-RPC wire format.
+
+Parity with the reference's 26-byte header (rpc/types.h:73-99): every payload
+travels behind ``{version u8, header_checksum u32, compression u8,
+payload_size u32, meta u32, correlation_id u32, payload_checksum u64}``.
+The header checksum is CRC-32C over everything after the checksum field; the
+payload checksum is xxhash64. ``meta`` carries the method id on requests and
+an HTTP-style status (rpc/types.h:64-70) on responses. Optional zstd payload
+compression mirrors compression_type (rpc/types.h:50-55).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from redpanda_tpu.hashing.crc32c import crc32c
+from redpanda_tpu.hashing.xx import xxhash64
+
+HEADER_SIZE = 26
+_PRE = struct.Struct("<B I")        # version, header_checksum
+_POST = struct.Struct("<B I I I Q")  # compression, payload_size, meta, corr, payload_checksum
+
+COMPRESSION_NONE = 0
+COMPRESSION_ZSTD = 1
+
+# rpc::status (rpc/types.h:64-70) — well-known HTTP codes for readability.
+STATUS_SUCCESS = 200
+STATUS_METHOD_NOT_FOUND = 404
+STATUS_REQUEST_TIMEOUT = 408
+STATUS_SERVER_ERROR = 500
+
+# Compress payloads above this size when the transport negotiated zstd.
+ZSTD_MIN_SIZE = 1024
+
+
+class WireError(Exception):
+    pass
+
+
+@dataclass
+class Header:
+    version: int = 0
+    compression: int = COMPRESSION_NONE
+    payload_size: int = 0
+    meta: int = 0
+    correlation_id: int = 0
+    payload_checksum: int = 0
+
+    def _post_bytes(self) -> bytes:
+        return _POST.pack(
+            self.compression,
+            self.payload_size,
+            self.meta,
+            self.correlation_id & 0xFFFFFFFF,
+            self.payload_checksum,
+        )
+
+    def encode(self) -> bytes:
+        post = self._post_bytes()
+        return _PRE.pack(self.version, crc32c(post)) + post
+
+    @staticmethod
+    def decode(buf: bytes) -> "Header":
+        if len(buf) < HEADER_SIZE:
+            raise WireError(f"short header: {len(buf)}")
+        version, hcrc = _PRE.unpack_from(buf, 0)
+        post = buf[_PRE.size : HEADER_SIZE]
+        if crc32c(post) != hcrc:
+            raise WireError("header checksum mismatch")
+        compression, size, meta, corr, pcrc = _POST.unpack(post)
+        return Header(version, compression, size, meta, corr, pcrc)
+
+
+def frame(payload: bytes, meta: int, correlation_id: int, compress: bool = False) -> bytes:
+    """Build header+payload for one message."""
+    compression = COMPRESSION_NONE
+    if compress and len(payload) >= ZSTD_MIN_SIZE:
+        from redpanda_tpu.compression.codecs import zstd_compress
+
+        payload = zstd_compress(payload)
+        compression = COMPRESSION_ZSTD
+    h = Header(
+        compression=compression,
+        payload_size=len(payload),
+        meta=meta,
+        correlation_id=correlation_id,
+        payload_checksum=xxhash64(payload),
+    )
+    return h.encode() + payload
+
+
+def open_payload(h: Header, payload: bytes) -> bytes:
+    """Verify the payload checksum and undo wire compression."""
+    if xxhash64(payload) != h.payload_checksum:
+        raise WireError("payload checksum mismatch")
+    if h.compression == COMPRESSION_ZSTD:
+        from redpanda_tpu.compression.codecs import zstd_uncompress
+
+        return zstd_uncompress(payload)
+    if h.compression != COMPRESSION_NONE:
+        raise WireError(f"unknown compression {h.compression}")
+    return payload
