@@ -1,0 +1,78 @@
+"""Batched cross-group heartbeats.
+
+Parity with raft/heartbeat_manager.cc:155-204: one heartbeat manager per
+shard coalesces the heartbeats of ALL raft groups into a single RPC per
+destination node per tick — the reason a node with thousands of partitions
+doesn't send thousands of heartbeat RPCs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import defaultdict
+
+from redpanda_tpu.rpc.transport import RpcError, TransportClosed
+
+logger = logging.getLogger("rptpu.raft.heartbeat")
+
+
+class HeartbeatManager:
+    def __init__(self, client_for, interval_ms: float = 60.0) -> None:
+        self._client_for = client_for  # callable(node_id) -> raftgen Client
+        self.interval_ms = interval_ms
+        self._groups: dict[int, object] = {}  # group id -> Consensus
+        self._task: asyncio.Task | None = None
+
+    def register(self, consensus) -> None:
+        self._groups[consensus.group] = consensus
+
+    def deregister(self, group: int) -> None:
+        self._groups.pop(group, None)
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_ms / 1000.0)
+            try:
+                await self.send_heartbeats()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("heartbeat tick failed")
+
+    async def send_heartbeats(self) -> None:
+        # Gather per-destination batches across every leader group on this
+        # node (heartbeat_manager.cc requests_for_range).
+        by_node: dict[int, list[dict]] = defaultdict(list)
+        for c in list(self._groups.values()):
+            for meta in c.heartbeat_metadata():
+                by_node[meta["target"]["id"]].append(meta)
+        if not by_node:
+            return
+        await asyncio.gather(
+            *(self._send_one(nid, metas) for nid, metas in by_node.items())
+        )
+
+    async def _send_one(self, node_id: int, metas: list[dict]) -> None:
+        try:
+            reply = await self._client_for(node_id).heartbeat(
+                {"heartbeats": metas}, timeout=self.interval_ms / 1000.0 * 4
+            )
+        except (RpcError, TransportClosed, OSError):
+            return  # follower timeout detection is the election timer's job
+        for m in reply["meta"]:
+            c = self._groups.get(m["group"])
+            if c is not None:
+                c.process_heartbeat_reply(m)

@@ -123,11 +123,7 @@ class DiskLog:
             return AppendResult(off.dirty_offset + 1, off.dirty_offset, 0)
         async with self._lock:
             if term is not None and term > self._term:
-                # Term change rolls the segment so the term is durable in the
-                # segment name and survives restart.
                 self._term = term
-                if self.segments and self.segments[-1].writable:
-                    self.segments[-1].release_appender()
             seg = self._active_segment_for_append()
             next_offset = seg.dirty_offset + 1
             first = None
@@ -135,9 +131,20 @@ class DiskLog:
             for batch in batches:
                 if assign_offsets:
                     batch = batch.with_base_offset(next_offset)
-                batch.header.term = self._term
+                    batch.header.term = self._term
+                elif batch.header.term < 0:
+                    batch.header.term = self._term
+                else:
+                    # Follower-path append: batches arrive with the leader's
+                    # term already stamped; adopt it (terms may also go DOWN
+                    # after a divergent suffix was truncated).
+                    self._term = batch.header.term
                 if first is None:
                     first = batch.base_offset
+                # The segment filename is the durable term record (the packed
+                # header has no term field), so the active segment's term must
+                # match every batch written into it.
+                seg = self._segment_for_term(seg, batch.header.term)
                 seg = self._maybe_roll(seg)
                 seg.append(batch)
                 size += batch.size_bytes
@@ -156,6 +163,24 @@ class DiskLog:
             self._active_created_at = time.monotonic()
             return seg
         return self.segments[-1]
+
+    def _segment_for_term(self, seg: Segment, term: int) -> Segment:
+        """Roll (or, if still empty, replace) the active segment so its
+        filename term matches `term`."""
+        if seg.term == term:
+            return seg
+        if seg.size_bytes == 0:
+            # Nothing written yet: replace it so no batch is ever mislabeled.
+            base = seg.base_offset
+            seg.remove()
+            self.segments.pop()
+        else:
+            base = seg.dirty_offset + 1
+            seg.release_appender()
+        new = Segment(self.dir, base, term).create()
+        self.segments.append(new)
+        self._active_created_at = time.monotonic()
+        return new
 
     def _maybe_roll(self, seg: Segment) -> Segment:
         too_big = seg.size_bytes >= self.config.max_segment_size

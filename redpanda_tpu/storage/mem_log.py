@@ -38,7 +38,13 @@ class MemLog:
         for batch in batches:
             if assign_offsets:
                 batch = batch.with_base_offset(next_offset)
-            batch.header.term = self._term
+                batch.header.term = self._term
+            elif batch.header.term < 0:
+                batch.header.term = self._term
+            else:
+                # Follower-path append keeps the replicated term (MemLog has
+                # no segments, so the term survives only in the header).
+                self._term = batch.header.term
             if first is None:
                 first = batch.base_offset
             self._batches.append(batch)

@@ -253,6 +253,42 @@ def test_term_survives_restart(ntp, cfg):
     _run(main())
 
 
+def test_follower_append_preserves_wire_terms_across_restart(ntp, cfg):
+    """Follower-path appends (assign_offsets=False) carry the leader's terms;
+    the segment filename is the durable term record, so a fresh (empty) or
+    mid-term segment must never absorb batches from another term — including
+    terms going DOWN after a divergent-suffix truncation."""
+
+    async def main():
+        log = await DiskLog.open(ntp, cfg)
+        b1 = _batch(2).with_base_offset(0)
+        b1.header.term = 5  # fresh log: empty 0-0 segment must be replaced
+        b2 = _batch(2).with_base_offset(2)
+        b2.header.term = 7
+        b3 = _batch(2).with_base_offset(4)
+        b3.header.term = 7
+        await log.append([b1, b2, b3], assign_offsets=False)
+        assert [b.header.term for b in await log.read(0)] == [5, 7, 7]
+        await log.flush()
+        await log.close()
+        # restart: terms recovered from segment names, not headers
+        log2 = await DiskLog.open(ntp, cfg)
+        assert [b.header.term for b in await log2.read(0)] == [5, 7, 7]
+        # divergence repair: truncate the term-7 suffix, append term-6 history
+        await log2.truncate(2)
+        b4 = _batch(2).with_base_offset(2)
+        b4.header.term = 6
+        await log2.append([b4], assign_offsets=False)
+        assert [b.header.term for b in await log2.read(0)] == [5, 6]
+        await log2.flush()
+        await log2.close()
+        log3 = await DiskLog.open(ntp, cfg)
+        assert [b.header.term for b in await log3.read(0)] == [5, 6]
+        await log3.close()
+
+    _run(main())
+
+
 def test_kvstore_stop_without_start_preserves_state(tmp_path):
     kv = KvStore(str(tmp_path / "kv")).start()
     kv.put(KeySpace.consensus, b"voted_for", b"node-3")
