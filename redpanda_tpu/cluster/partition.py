@@ -159,6 +159,11 @@ class PartitionManager:
         """Register an externally built partition (raft-backed)."""
         self._partitions[ntp] = partition
 
+    def detach(self, ntp: NTP) -> Partition | None:
+        """Unregister without touching storage (raft-backed partitions: the
+        group manager owns the log teardown)."""
+        return self._partitions.pop(ntp, None)
+
     def get(self, ntp: NTP) -> Partition | None:
         return self._partitions.get(ntp)
 

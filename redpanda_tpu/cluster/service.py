@@ -1,0 +1,168 @@
+"""Internal cluster RPC service + leader-forwarding frontend.
+
+Parity with cluster/service.cc + controller.json: brokers that are not the
+controller leader forward mutations (topic ops, node join, decommission) to
+the leader over the internal RPC mesh. The wire carries the already-built
+``Command`` (type + JSON payload), so the leader-side handler is one line:
+replicate_and_wait. join_node is the cluster entry point for new brokers
+(members_manager handle_join_request).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from redpanda_tpu import rpc
+from redpanda_tpu.cluster.commands import Command, CommandType
+from redpanda_tpu.cluster.controller import ClusterError, Controller, NotControllerError
+from redpanda_tpu.cluster.members import Broker
+from redpanda_tpu.rpc import serde
+
+logger = logging.getLogger("rptpu.cluster.service")
+
+REPLICATE_CMD_REQUEST = serde.S(("type", serde.I32), ("data_json", serde.BYTES))
+REPLICATE_CMD_REPLY = serde.S(
+    ("errc", serde.I32),  # 0 ok, 1 not-leader, 2 error
+    ("leader", serde.I32),  # -1 unknown
+    ("message", serde.STRING),
+)
+JOIN_NODE_REQUEST = serde.S(
+    ("node_id", serde.I32),
+    ("host", serde.STRING),
+    ("port", serde.I32),
+    ("kafka_host", serde.STRING),
+    ("kafka_port", serde.I32),
+)
+JOIN_NODE_REPLY = REPLICATE_CMD_REPLY
+
+cluster_service = rpc.ServiceDef(
+    "cluster",
+    "controller",
+    [
+        rpc.MethodDef("replicate_command", REPLICATE_CMD_REQUEST, REPLICATE_CMD_REPLY),
+        rpc.MethodDef("join_node", JOIN_NODE_REQUEST, JOIN_NODE_REPLY),
+    ],
+)
+
+_OK, _NOT_LEADER, _ERROR = 0, 1, 2
+
+
+class ClusterService:
+    """Server-side handler bound on every broker.
+
+    With a dispatcher attached, join_node works against ANY broker (the
+    handler forwards to the controller leader itself — members_manager
+    handle_join_request semantics); without one it serves leader-local only.
+    """
+
+    def __init__(self, controller: Controller, dispatcher: "ControllerDispatcher | None" = None) -> None:
+        self.controller = controller
+        self.dispatcher = dispatcher
+
+    def register(self, protocol: rpc.SimpleProtocol) -> None:
+        protocol.register_service(rpc.ServiceHandler(cluster_service, self))
+
+    def _reply(self, errc: int, message: str = "") -> dict:
+        leader = self.controller.leader_id
+        return {"errc": errc, "leader": -1 if leader is None else leader, "message": message}
+
+    async def replicate_command(self, req: dict) -> dict:
+        cmd = Command(CommandType(req["type"]), json.loads(req["data_json"].decode()))
+        try:
+            await self.controller.replicate_and_wait(cmd)
+            return self._reply(_OK)
+        except NotControllerError:
+            return self._reply(_NOT_LEADER)
+        except Exception as e:
+            logger.exception("replicate_command failed")
+            return self._reply(_ERROR, str(e))
+
+    async def join_node(self, req: dict) -> dict:
+        from redpanda_tpu.cluster import commands as cmds
+
+        cmd = cmds.register_node_cmd(
+            req["node_id"], req["host"], req["port"],
+            req["kafka_host"], req["kafka_port"],
+        )
+        try:
+            if self.dispatcher is not None:
+                await self.dispatcher.replicate(cmd)
+            else:
+                await self.controller.replicate_and_wait(cmd)
+            return self._reply(_OK)
+        except NotControllerError:
+            return self._reply(_NOT_LEADER)
+        except Exception as e:
+            logger.exception("join_node failed")
+            return self._reply(_ERROR, str(e))
+
+
+class ControllerDispatcher:
+    """Run a controller mutation from ANY broker: try locally, forward to
+    the leader otherwise (topics_frontend redirect semantics)."""
+
+    def __init__(self, controller: Controller, connection_cache: rpc.ConnectionCache) -> None:
+        self.controller = controller
+        self.connections = connection_cache
+
+    async def replicate(self, cmd: Command, *, retries: int = 3, timeout: float = 10.0) -> None:
+        last = "no controller leader"
+        for _ in range(retries):
+            if self.controller.is_leader():
+                try:
+                    await self.controller.replicate_and_wait(cmd, timeout)
+                    return
+                except NotControllerError:
+                    pass  # lost leadership mid-call; fall through to forward
+            leader = self.controller.leader_id
+            if leader is None or leader == self.controller.self_node.id:
+                import asyncio
+
+                await asyncio.sleep(0.2)
+                continue
+            client = rpc.Client(cluster_service, self.connections.get(leader))
+            reply = await client.replicate_command(
+                {
+                    "type": int(cmd.type),
+                    "data_json": json.dumps(cmd.data).encode(),
+                },
+                timeout=timeout,
+            )
+            if reply["errc"] == _OK:
+                return
+            last = reply["message"] or f"errc={reply['errc']}"
+        raise ClusterError(f"controller mutation failed: {last}", retriable=True)
+
+
+async def join_cluster(
+    broker: Broker,
+    seed_addr: tuple[str, int],
+    connections: rpc.ConnectionCache,
+    *,
+    seed_node_hint: int = 0,
+    timeout: float = 10.0,
+) -> None:
+    """Client side of node join: a fresh broker announces itself to a seed
+    broker, which forwards to the controller leader if needed."""
+    import asyncio
+
+    connections.register(seed_node_hint, *seed_addr)
+    client = rpc.Client(cluster_service, connections.get(seed_node_hint))
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        reply = await client.join_node(
+            {
+                "node_id": broker.node_id,
+                "host": broker.host,
+                "port": broker.port,
+                "kafka_host": broker.kafka_host,
+                "kafka_port": broker.kafka_port,
+            },
+            timeout=5.0,
+        )
+        if reply["errc"] == _OK:
+            return
+        if asyncio.get_running_loop().time() > deadline:
+            raise ClusterError(f"join failed: {reply['message']}")
+        await asyncio.sleep(0.3)

@@ -29,6 +29,24 @@ class TopicConfig:
     compression: str = "producer"
     extra: dict[str, str] = field(default_factory=dict)
 
+    def apply_override(self, key: str, value: str | None) -> None:
+        """Kafka config key → typed field (alter_configs / controller
+        update_topic_properties apply path)."""
+        if value is None:
+            return
+        if key == "cleanup.policy":
+            self.cleanup_policy = value
+        elif key == "retention.bytes":
+            self.retention_bytes = int(value)
+        elif key == "retention.ms":
+            self.retention_ms = int(value)
+        elif key == "segment.bytes":
+            self.segment_size = int(value)
+        elif key == "compression.type":
+            self.compression = value
+        else:
+            self.extra[key] = value
+
     def config_map(self) -> dict[str, str | None]:
         m: dict[str, str | None] = {
             "cleanup.policy": self.cleanup_policy,
@@ -47,6 +65,13 @@ class PartitionAssignment:
     ntp: NTP
     replicas: list[NodeId]
     leader: NodeId | None = None
+    # raft group id, allocated by the controller leader and carried in the
+    # create command so the apply is deterministic on every node
+    # (cluster/partition_assignment.h `group`); -1 = single-node direct log.
+    group: int = -1
+    # replica set being moved to, while a move_partition_replicas is in
+    # flight (topic_table in_progress updates)
+    moving_to: list[NodeId] | None = None
 
 
 @dataclass
@@ -108,6 +133,46 @@ class TopicTable:
             md.assignments[p] = PartitionAssignment(ntp, reps, leader=reps[0] if reps else None)
             self._push_delta(TopicDelta(DeltaType.added, ntp, md.assignments[p]))
         md.config.partition_count = new_count
+
+    def apply_create(self, config: TopicConfig, assignments: list[PartitionAssignment]) -> TopicMetadata:
+        """Deterministic apply of a replicated create_topic command: the
+        assignments (incl. raft group ids) were fixed by the leader."""
+        if config.name in self._topics:
+            raise ValueError(f"topic exists: {config.name}")
+        md = TopicMetadata(config)
+        for pa in assignments:
+            md.assignments[pa.ntp.partition] = pa
+            self._push_delta(TopicDelta(DeltaType.added, pa.ntp, pa))
+        config.partition_count = len(assignments)
+        self._topics[config.name] = md
+        return md
+
+    def apply_add_partitions(self, name: str, assignments: list[PartitionAssignment]) -> None:
+        md = self._topics[name]
+        for pa in assignments:
+            md.assignments[pa.ntp.partition] = pa
+            self._push_delta(TopicDelta(DeltaType.added, pa.ntp, pa))
+        md.config.partition_count = len(md.assignments)
+
+    def update_properties(self, name: str, overrides: dict) -> None:
+        md = self._topics[name]
+        for k, v in overrides.items():
+            md.config.apply_override(k, v)
+
+    def begin_move(self, ntp: NTP, replicas: list[NodeId]) -> None:
+        """move_partition_replicas: new set recorded, reconciliation begins
+        (topic_table in-progress update + delta)."""
+        pa = self._topics[ntp.topic].assignments[ntp.partition]
+        pa.moving_to = list(replicas)
+        self._push_delta(TopicDelta(DeltaType.updated, ntp, pa))
+
+    def finish_move(self, ntp: NTP, replicas: list[NodeId]) -> None:
+        """finish_moving_partition_replicas: the new replica set is caught
+        up; old replicas can drop their copy."""
+        pa = self._topics[ntp.topic].assignments[ntp.partition]
+        pa.replicas = list(replicas)
+        pa.moving_to = None
+        self._push_delta(TopicDelta(DeltaType.updated, ntp, pa))
 
     def set_leader(self, ntp: NTP, leader: NodeId | None) -> None:
         md = self._topics.get(ntp.topic)

@@ -389,20 +389,7 @@ def _topic_result(name: str, code: ErrorCode, msg: str | None = None) -> dict:
 
 
 def _apply_topic_config(cfg: TopicConfig, key: str, value: str | None) -> None:
-    if value is None:
-        return
-    if key == "cleanup.policy":
-        cfg.cleanup_policy = value
-    elif key == "retention.bytes":
-        cfg.retention_bytes = int(value)
-    elif key == "retention.ms":
-        cfg.retention_ms = int(value)
-    elif key == "segment.bytes":
-        cfg.segment_size = int(value)
-    elif key == "compression.type":
-        cfg.compression = value
-    else:
-        cfg.extra[key] = value
+    cfg.apply_override(key, value)
 
 
 async def handle_delete_topics(ctx) -> dict:

@@ -428,6 +428,13 @@ class Consensus:
         )
         res = await self._append_locked([batch])
         self.config_mgr.add(res.base_offset, cfg)
+        # Flush so the leader's own ack counts toward the quorum: config
+        # appends happen outside the batcher's flush path, and in a 2-voter
+        # group one follower ack alone can never reach majority.
+        r = self.log.flush()
+        if asyncio.iscoroutine(r):
+            await r
+        self._maybe_advance_commit_index()
         return res.last_offset
 
     def _fanout_append(self) -> None:
@@ -662,9 +669,18 @@ class Consensus:
                 r = self.log.prefix_truncate(last_idx + 1)
                 if asyncio.iscoroutine(r):
                     await r
-                self._term_starts = [(o, t) for o, t in self._term_starts if o > last_idx] or [
-                    (last_idx, last_term)
-                ]
+                # Preserve the term of retained entries above last_idx: the
+                # span covering them may START at an offset <= last_idx, and
+                # dropping it would make term_at() return -1 for offsets we
+                # still hold, breaking divergence detection on later appends.
+                retained_term = (
+                    self.term_at(last_idx + 1) if self.dirty_offset > last_idx else -1
+                )
+                kept = [(o, t) for o, t in self._term_starts if o > last_idx]
+                spans = [(last_idx, last_term)]
+                if retained_term != -1 and not any(o == last_idx + 1 for o, _ in kept):
+                    spans.append((last_idx + 1, retained_term))
+                self._term_starts = spans + kept
                 self.config_mgr.prefix_truncate(last_idx)
                 self._set_commit_index(max(self._commit_index, last_idx))
             return {"term": self.term, "bytes_stored": len(rx["data"]), "success": True}
@@ -752,11 +768,19 @@ class Consensus:
         if not self.is_leader():
             raise RaftError(Errc.not_leader)
         if self.config().old_voters is not None:
-            raise RaftError(Errc.configuration_change_in_progress)
-        async with self._op_lock:
-            joint = self.config().enter_joint(new_voters)
-            off = await self._append_config_locked(joint)
-            self._sync_followers_with_config(joint)
+            # An earlier change attempt left a joint config in the log (e.g.
+            # its commit timed out while a new voter bootstrapped). Resume it
+            # if the target matches; a different target must wait.
+            if sorted(v.id for v in self.config().voters) != sorted(
+                v.id for v in new_voters
+            ):
+                raise RaftError(Errc.configuration_change_in_progress)
+            off = self.config_mgr.latest_offset()
+        else:
+            async with self._op_lock:
+                joint = self.config().enter_joint(new_voters)
+                off = await self._append_config_locked(joint)
+                self._sync_followers_with_config(joint)
         self._fanout_append()
         await self.wait_for_commit(off, timeout)
         async with self._op_lock:
