@@ -118,6 +118,9 @@ class Controller:
         self._next_group = CONTROLLER_GROUP + 1
         # pluggable appliers: CommandType -> async callable(cmd)
         self._extra_appliers: dict[CommandType, object] = {}
+        # strong refs for background fibers (drain watchers): the loop only
+        # holds weak refs, so an unreferenced task can be GC'd mid-flight
+        self._bg_tasks: set[asyncio.Task] = set()
         # keep connection cache in sync with membership
         self.members.register_change_callback(self._on_member_change)
 
@@ -149,6 +152,11 @@ class Controller:
         return self
 
     async def stop(self) -> None:
+        for t in list(self._bg_tasks):
+            t.cancel()
+        if self._bg_tasks:
+            await asyncio.gather(*self._bg_tasks, return_exceptions=True)
+        self._bg_tasks.clear()
         if self.stm is not None:
             await self.stm.stop()
             self.stm = None
@@ -390,7 +398,9 @@ class Controller:
                     )
         # watch the drain and seal it with finish_reallocations so the node
         # transitions draining -> removed (members_backend completion)
-        asyncio.create_task(self._watch_drain(node_id))
+        t = asyncio.create_task(self._watch_drain(node_id))
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
 
     def _node_is_drained(self, node_id: NodeId) -> bool:
         for md in self.topic_table.topics().values():

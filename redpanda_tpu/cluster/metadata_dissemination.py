@@ -117,10 +117,15 @@ class MetadataDisseminationService:
             if not updates:
                 continue
             blob = _encode_updates(updates)
-            for b in self.members.all_brokers():
-                if b.node_id == self.self_node_id:
-                    continue
-                asyncio.create_task(self._send(b.node_id, blob))
+            # gather (not fire-and-forget: unreferenced tasks can be GC'd):
+            # sends run concurrently and each has its own short rpc timeout
+            await asyncio.gather(
+                *(
+                    self._send(b.node_id, blob)
+                    for b in self.members.all_brokers()
+                    if b.node_id != self.self_node_id
+                )
+            )
 
     async def _send(self, node_id: int, blob: bytes) -> None:
         try:

@@ -26,10 +26,19 @@ class BrokerConnection:
     """One TCP connection with correlation-id request/response matching
     (kafka/client/broker.h + transport)."""
 
-    def __init__(self, host: str, port: int, client_id: str = "rptpu-client"):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        client_id: str = "rptpu-client",
+        sasl: tuple[str, str] | None = None,
+        sasl_mechanism: str = "SCRAM-SHA-256",
+    ):
         self.host = host
         self.port = port
         self.client_id = client_id
+        self.sasl = sasl  # (username, password) enables the SCRAM dance
+        self.sasl_mechanism = sasl_mechanism
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._correlation = itertools.count(1)
@@ -46,7 +55,44 @@ class BrokerConnection:
             self._versions = {
                 e["api_key"]: (e["min_version"], e["max_version"]) for e in vs["api_keys"]
             }
+        if self.sasl is not None:
+            await self._authenticate()
         return self
+
+    async def _authenticate(self) -> None:
+        """SCRAM over SaslHandshake/SaslAuthenticate (client/sasl_client)."""
+        import base64
+        import os
+
+        from redpanda_tpu.security.scram import (
+            MECHANISMS,
+            ScramError,
+            scram_client_final,
+            scram_client_first,
+        )
+
+        username, password = self.sasl
+        algo = MECHANISMS[self.sasl_mechanism]
+        hs = await self.request(m.SASL_HANDSHAKE, {"mechanism": algo.name})
+        if hs["error_code"] != 0:
+            raise KafkaError(
+                ErrorCode(hs["error_code"]),
+                f"mechanism {algo.name} rejected; server offers {hs['mechanisms']}",
+            )
+        nonce = base64.b64encode(os.urandom(18)).decode()
+        first = scram_client_first(username, nonce)
+        r1 = await self.request(m.SASL_AUTHENTICATE, {"auth_bytes": first})
+        if r1["error_code"] != 0:
+            raise KafkaError(ErrorCode(r1["error_code"]), r1.get("error_message") or "")
+        final, expected_sig = scram_client_final(
+            username, password, nonce, first, r1["auth_bytes"], algo
+        )
+        r2 = await self.request(m.SASL_AUTHENTICATE, {"auth_bytes": final})
+        if r2["error_code"] != 0:
+            raise KafkaError(ErrorCode(r2["error_code"]), r2.get("error_message") or "")
+        attrs = r2["auth_bytes"].decode()
+        if not attrs.startswith("v=") or base64.b64decode(attrs[2:]) != expected_sig:
+            raise ScramError("server signature mismatch (not the real broker?)")
 
     async def close(self) -> None:
         if self._recv_task:
@@ -135,18 +181,31 @@ class BrokerConnection:
 class KafkaClient:
     """Metadata-routed multi-broker client (kafka/client/client.h)."""
 
-    def __init__(self, bootstrap: list[tuple[str, int]], client_id: str = "rptpu-client"):
+    def __init__(
+        self,
+        bootstrap: list[tuple[str, int]],
+        client_id: str = "rptpu-client",
+        sasl: tuple[str, str] | None = None,
+        sasl_mechanism: str = "SCRAM-SHA-256",
+    ):
         self.bootstrap = bootstrap
         self.client_id = client_id
+        self.sasl = sasl
+        self.sasl_mechanism = sasl_mechanism
         self._conns: dict[int, BrokerConnection] = {}
         self._brokers: dict[int, tuple[str, int]] = {}
         self._leaders: dict[tuple[str, int], int] = {}
         self._bootstrap_conn: BrokerConnection | None = None
         self._conn_lock = asyncio.Lock()
 
+    def _new_conn(self, host: str, port: int) -> BrokerConnection:
+        return BrokerConnection(
+            host, port, self.client_id, sasl=self.sasl, sasl_mechanism=self.sasl_mechanism
+        )
+
     async def connect(self) -> "KafkaClient":
         host, port = self.bootstrap[0]
-        self._bootstrap_conn = await BrokerConnection(host, port, self.client_id).connect()
+        self._bootstrap_conn = await self._new_conn(host, port).connect()
         await self.refresh_metadata()
         return self
 
@@ -173,9 +232,7 @@ class KafkaClient:
         async with self._conn_lock:
             if node_id not in self._conns:
                 host, port = self._brokers[node_id]
-                self._conns[node_id] = await BrokerConnection(
-                    host, port, self.client_id
-                ).connect()
+                self._conns[node_id] = await self._new_conn(host, port).connect()
             return self._conns[node_id]
 
     async def leader_connection(self, topic: str, partition: int) -> BrokerConnection:

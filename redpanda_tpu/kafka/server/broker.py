@@ -28,6 +28,8 @@ class BrokerConfig:
     default_partitions: int = 1
     default_replication: int = 1
     fetch_poll_interval_s: float = 0.02
+    sasl_enabled: bool = False
+    superusers: list = field(default_factory=list)
 
 
 class Broker:
@@ -37,10 +39,25 @@ class Broker:
         self.topic_table = TopicTable()
         self.partition_manager = PartitionManager(storage, config.node_id)
         self.group_coordinator = None  # wired by the app once groups land
-        self.authorizer = None  # wired once security lands
         self.coproc_api = None  # wired once the transform engine attaches
         self.tx_coordinator = None  # wired once transactions land
         self.quota_manager = None
+        self.controller_dispatcher = None  # multi-node: routes security/topic cmds
+        # SCRAM credentials + ACLs; cluster-replicated when a controller is
+        # attached, applied locally otherwise (single-node mode)
+        from redpanda_tpu.security import Authorizer, SecurityManager
+
+        self.security = SecurityManager()
+        self.authorizer = Authorizer(self.security.acls, set(config.superusers))
+        self.sasl_enabled = config.sasl_enabled
+
+    async def replicate_security_cmd(self, cmd) -> None:
+        """Route a user/ACL mutation: through the controller when clustered
+        (security_frontend), straight into the local stores otherwise."""
+        if self.controller_dispatcher is not None:
+            await self.controller_dispatcher.replicate(cmd)
+        else:
+            await self.security.apply_command(cmd)
 
     # ------------------------------------------------------------ topics
     async def create_topic(self, config: TopicConfig) -> None:

@@ -19,8 +19,15 @@ from redpanda_tpu.kafka.protocol.batch import decode_wire_batches, encode_wire_b
 from redpanda_tpu.kafka.protocol.errors import ErrorCode
 from redpanda_tpu.cluster.partition import ConsistencyLevel
 from redpanda_tpu.cluster.topic_table import TopicConfig
+from redpanda_tpu.security.acl import AclOperation, ResourceType
 
 E = ErrorCode
+
+
+def _authorized(ctx, op: AclOperation, topic: str) -> bool:
+    from redpanda_tpu.kafka.server.security_handlers import authorize
+
+    return authorize(ctx, ResourceType.topic, topic, op)
 
 
 def build_dispatch_table() -> dict:
@@ -61,13 +68,23 @@ async def handle_metadata(ctx) -> dict:
     requested = ctx.request.get("topics")
     names: list[str]
     if requested is None or (ctx.api_version == 0 and not requested):
-        names = sorted(broker.topic_table.topics())
+        # full listing is filtered to what the principal may describe
+        # (metadata.cc filters unauthorized topics out, no error entries)
+        names = sorted(
+            n for n in broker.topic_table.topics()
+            if _authorized(ctx, AclOperation.describe, n)
+        )
     else:
         names = [t["name"] for t in requested]
         allow_auto = ctx.request.get("allow_auto_topic_creation", True)
         if cfg.auto_create_topics and allow_auto:
             for name in names:
-                if not broker.topic_table.contains(name) and _valid_topic_name(name):
+                if (
+                    not broker.topic_table.contains(name)
+                    and _valid_topic_name(name)
+                    # auto-create honors the same create ACL as CreateTopics
+                    and _authorized(ctx, AclOperation.create, name)
+                ):
                     try:
                         await broker.create_topic(
                             TopicConfig(
@@ -80,6 +97,13 @@ async def handle_metadata(ctx) -> dict:
                         pass  # concurrent create
     topics = []
     for name in names:
+        if not _authorized(ctx, AclOperation.describe, name):
+            topics.append({
+                "error_code": int(E.topic_authorization_failed),
+                "name": name,
+                "partitions": [],
+            })
+            continue
         md = broker.topic_table.get(name)
         if md is None:
             code = (
@@ -155,6 +179,15 @@ async def handle_produce(ctx) -> dict | None:
     }[acks]
     responses = []
     for t in ctx.request["topics"]:
+        if not _authorized(ctx, AclOperation.write, t["name"]):
+            responses.append({
+                "name": t["name"],
+                "partitions": [
+                    _produce_partition_error(p["partition_index"], E.topic_authorization_failed)
+                    for p in t["partitions"]
+                ],
+            })
+            continue
         parts = await asyncio.gather(
             *(
                 _produce_one(ctx.broker, t["name"], p, level)
@@ -257,6 +290,16 @@ async def _fetch_once(ctx, max_bytes: int) -> tuple[list, int, bool]:
     budget = max_bytes
     for t in ctx.request.get("topics") or []:
         parts = []
+        if not _authorized(ctx, AclOperation.read, t["name"]):
+            responses.append({
+                "name": t["name"],
+                "partitions": [
+                    _fetch_partition_error(p["partition_index"], E.topic_authorization_failed)
+                    for p in t["partitions"]
+                ],
+            })
+            any_error = True
+            continue
         for p in t["partitions"]:
             index = p["partition_index"]
             partition = broker.get_partition(t["name"], index)
@@ -318,6 +361,20 @@ async def handle_list_offsets(ctx) -> dict:
     topics = []
     for t in ctx.request.get("topics") or []:
         parts = []
+        if not _authorized(ctx, AclOperation.describe, t["name"]):
+            topics.append({
+                "name": t["name"],
+                "partitions": [
+                    {
+                        "partition_index": p["partition_index"],
+                        "error_code": int(E.topic_authorization_failed),
+                        "timestamp": -1,
+                        "offset": -1,
+                    }
+                    for p in t["partitions"]
+                ],
+            })
+            continue
         for p in t["partitions"]:
             index = p["partition_index"]
             partition = broker.get_partition(t["name"], index)
@@ -360,6 +417,9 @@ async def handle_create_topics(ctx) -> dict:
     results = []
     for t in ctx.request.get("topics") or []:
         name = t["name"]
+        if not _authorized(ctx, AclOperation.create, name):
+            results.append(_topic_result(name, E.topic_authorization_failed))
+            continue
         if not _valid_topic_name(name):
             results.append(_topic_result(name, E.invalid_topic_exception))
             continue
@@ -396,6 +456,9 @@ async def handle_delete_topics(ctx) -> dict:
     broker = ctx.broker
     responses = []
     for name in ctx.request.get("topic_names") or []:
+        if not _authorized(ctx, AclOperation.delete, name):
+            responses.append({"name": name, "error_code": int(E.topic_authorization_failed)})
+            continue
         if not broker.topic_table.contains(name):
             responses.append({"name": name, "error_code": int(E.unknown_topic_or_partition)})
             continue
@@ -409,6 +472,9 @@ async def handle_create_partitions(ctx) -> dict:
     results = []
     for t in ctx.request.get("topics") or []:
         name = t["name"]
+        if not _authorized(ctx, AclOperation.alter, name):
+            results.append(_topic_result(name, E.topic_authorization_failed))
+            continue
         md = broker.topic_table.get(name)
         if md is None:
             results.append(_topic_result(name, E.unknown_topic_or_partition))
@@ -431,6 +497,19 @@ async def handle_delete_records(ctx) -> dict:
     topics = []
     for t in ctx.request.get("topics") or []:
         parts = []
+        if not _authorized(ctx, AclOperation.delete, t["name"]):
+            topics.append({
+                "name": t["name"],
+                "partitions": [
+                    {
+                        "partition_index": p["partition_index"],
+                        "low_watermark": -1,
+                        "error_code": int(E.topic_authorization_failed),
+                    }
+                    for p in t["partitions"]
+                ],
+            })
+            continue
         for p in t["partitions"]:
             index = p["partition_index"]
             partition = broker.get_partition(t["name"], index)
@@ -478,6 +557,19 @@ async def handle_describe_configs(ctx) -> dict:
     for res in ctx.request.get("resources") or []:
         rtype, rname = res["resource_type"], res["resource_name"]
         keys = res.get("configuration_keys")
+        if rtype == _RESOURCE_TOPIC and not _authorized(
+            ctx, AclOperation.describe_configs, rname
+        ):
+            results.append(
+                {
+                    "error_code": int(E.topic_authorization_failed),
+                    "error_message": "describe configs denied",
+                    "resource_type": rtype,
+                    "resource_name": rname,
+                    "configs": [],
+                }
+            )
+            continue
         if rtype == _RESOURCE_TOPIC:
             md = broker.topic_table.get(rname)
             if md is None:
@@ -540,7 +632,9 @@ async def handle_alter_configs(ctx) -> dict:
     for res in ctx.request.get("resources") or []:
         rtype, rname = res["resource_type"], res["resource_name"]
         code = E.none
-        if rtype == _RESOURCE_TOPIC:
+        if rtype == _RESOURCE_TOPIC and not _authorized(ctx, AclOperation.alter_configs, rname):
+            code = E.topic_authorization_failed
+        elif rtype == _RESOURCE_TOPIC:
             md = broker.topic_table.get(rname)
             if md is None:
                 code = E.unknown_topic_or_partition
@@ -566,7 +660,9 @@ async def handle_incremental_alter_configs(ctx) -> dict:
     for res in ctx.request.get("resources") or []:
         rtype, rname = res["resource_type"], res["resource_name"]
         code = E.none
-        if rtype == _RESOURCE_TOPIC:
+        if rtype == _RESOURCE_TOPIC and not _authorized(ctx, AclOperation.alter_configs, rname):
+            code = E.topic_authorization_failed
+        elif rtype == _RESOURCE_TOPIC:
             md = broker.topic_table.get(rname)
             if md is None:
                 code = E.unknown_topic_or_partition
@@ -669,7 +765,60 @@ def _fetch_error_maker(ctx, code: ErrorCode) -> dict:
     }
 
 
+def _create_topics_error_maker(ctx, code: ErrorCode) -> dict:
+    return {
+        "topics": [
+            _topic_result(t["name"], code) for t in ctx.request.get("topics") or []
+        ]
+    }
+
+
+def _delete_topics_error_maker(ctx, code: ErrorCode) -> dict:
+    return {
+        "responses": [
+            {"name": n, "error_code": int(code)}
+            for n in ctx.request.get("topic_names") or []
+        ]
+    }
+
+
+def _metadata_error_maker(ctx, code: ErrorCode) -> dict:
+    return {
+        "brokers": [],
+        "cluster_id": None,
+        "controller_id": -1,
+        "topics": [
+            {"error_code": int(code), "name": t["name"], "partitions": []}
+            for t in ctx.request.get("topics") or []
+        ],
+    }
+
+
+def _list_offsets_error_maker(ctx, code: ErrorCode) -> dict:
+    return {
+        "topics": [
+            {
+                "name": t["name"],
+                "partitions": [
+                    {
+                        "partition_index": p["partition_index"],
+                        "error_code": int(code),
+                        "timestamp": -1,
+                        "offset": -1,
+                    }
+                    for p in t["partitions"]
+                ],
+            }
+            for t in ctx.request.get("topics") or []
+        ]
+    }
+
+
 ERROR_RESPONSE_MAKERS = {
     m.PRODUCE: _produce_error_maker,
     m.FETCH: _fetch_error_maker,
+    m.CREATE_TOPICS: _create_topics_error_maker,
+    m.DELETE_TOPICS: _delete_topics_error_maker,
+    m.METADATA: _metadata_error_maker,
+    m.LIST_OFFSETS: _list_offsets_error_maker,
 }

@@ -17,7 +17,12 @@ import logging
 import struct
 
 from redpanda_tpu.kafka.protocol.errors import ErrorCode, KafkaError
-from redpanda_tpu.kafka.protocol.messages import API_VERSIONS, APIS
+from redpanda_tpu.kafka.protocol.messages import (
+    API_VERSIONS,
+    APIS,
+    SASL_AUTHENTICATE,
+    SASL_HANDSHAKE,
+)
 from redpanda_tpu.kafka.protocol.primitives import Reader
 from redpanda_tpu.kafka.protocol.schema import (
     RequestHeader,
@@ -55,6 +60,8 @@ class Connection:
         self.writer = writer
         self.sasl_state = None  # set by the sasl handlers
         self.authenticated_principal: str | None = None
+        peer = writer.get_extra_info("peername")
+        self.client_host: str = peer[0] if peer else "*"
         # Bounded: `await put` backpressures the read loop once MAX_PIPELINE
         # requests are in flight on this connection.
         self._responses: asyncio.Queue[asyncio.Task | None] = asyncio.Queue(maxsize=MAX_PIPELINE)
@@ -145,6 +152,26 @@ class Connection:
     async def _dispatch(self, header: RequestHeader, api, request: dict) -> bytes | None:
         ctx = RequestContext(self.server.broker, header, request, self)
         handler = self.server.handlers[header.api_key]
+        # SASL gate: with authentication enabled, only the handshake dance
+        # and ApiVersions may run unauthenticated (requests.cc:99-160).
+        if (
+            getattr(self.server.broker, "sasl_enabled", False)
+            and self.authenticated_principal is None
+            and header.api_key not in (API_VERSIONS, SASL_HANDSHAKE, SASL_AUTHENTICATE)
+        ):
+            resp = self.server.error_response(
+                api, header.api_version, ctx, ErrorCode.sasl_authentication_failed
+            )
+            if resp:
+                return self._encode_response(header, api, resp)
+            # No expressible error shape for this API (no error_code field,
+            # no maker): a success-shaped empty body would read as a healthy
+            # empty cluster, so close the connection like real brokers do.
+            logger.warning(
+                "closing unauthenticated connection on api %s", api.name
+            )
+            self.writer.close()
+            return None
         try:
             response = await handler(ctx)
         except KafkaError as e:
@@ -154,6 +181,9 @@ class Connection:
             response = self.server.error_response(
                 api, header.api_version, ctx, ErrorCode.unknown_server_error
             )
+        return self._encode_response(header, api, response)
+
+    def _encode_response(self, header: RequestHeader, api, response: dict | None) -> bytes | None:
         if response is None:
             return None  # e.g. acks=0 produce: no response on the wire
         # ApiVersions responses always use the v0 response header.
@@ -222,11 +252,13 @@ class KafkaServer:
 
     def __init__(self, broker, host: str = "127.0.0.1", port: int = 9092):
         from redpanda_tpu.kafka.server import handlers as h
+        from redpanda_tpu.kafka.server import security_handlers as sh
 
         self.broker = broker
         self.host = host
         self.port = port
         self.handlers = h.build_dispatch_table()
+        sh.register_security_handlers(self.handlers)
         self._server: asyncio.AbstractServer | None = None
         self._conn_tasks: set[asyncio.Task] = set()
 

@@ -1,0 +1,246 @@
+"""Kafka SASL + ACL handlers.
+
+Parity with kafka/server/handlers/{sasl_handshake,sasl_authenticate}.cc and
+the ACL CRUD handlers (describe_acls/create_acls/delete_acls.cc), plus the
+`authorize()` helper every data-path handler calls through its request
+context (request_context.h authorized()). The SASL state machine lives on
+the connection (requests.cc:99-160 interception; here the dispatch gate in
+protocol.py enforces auth before any other API when SASL is enabled).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from redpanda_tpu.kafka.protocol import messages as m
+from redpanda_tpu.kafka.protocol.errors import ErrorCode
+from redpanda_tpu.security import SecurityManager
+from redpanda_tpu.security.acl import (
+    AclBinding,
+    AclBindingFilter,
+    AclEntry,
+    AclOperation,
+    AclPermission,
+    PatternType,
+    ResourcePattern,
+    ResourceType,
+    DEFAULT_CLUSTER_NAME,
+)
+from redpanda_tpu.security.scram import MECHANISMS, ScramError, ScramServerConversation
+
+logger = logging.getLogger("rptpu.kafka.security")
+
+
+def authorize(ctx, resource_type: ResourceType, name: str, op: AclOperation) -> bool:
+    """True when the connection's principal may perform op; open when no
+    authorizer is wired (single-node dev mode). The client's peer address
+    feeds host-scoped ACL entries (request_context.h passes the connection
+    address the same way)."""
+    az = ctx.broker.authorizer
+    if az is None:
+        return True
+    return az.authorized(
+        resource_type, name, op,
+        ctx.connection.authenticated_principal,
+        host=ctx.connection.client_host,
+    )
+
+
+# ------------------------------------------------------------------ sasl
+async def handle_sasl_handshake(ctx) -> dict:
+    mech = ctx.request["mechanism"]
+    conn = ctx.connection
+    if mech not in MECHANISMS:
+        return {
+            "error_code": int(ErrorCode.unsupported_sasl_mechanism),
+            "mechanisms": sorted(MECHANISMS),
+        }
+    sec: SecurityManager | None = ctx.broker.security
+    algo = MECHANISMS[mech]
+    lookup = (lambda u: None) if sec is None else sec.credentials.get
+    conn.sasl_state = ScramServerConversation(lookup, algo)
+    return {"error_code": 0, "mechanisms": sorted(MECHANISMS)}
+
+
+async def handle_sasl_authenticate(ctx) -> dict:
+    conn = ctx.connection
+
+    def fail(msg: str) -> dict:
+        conn.sasl_state = None
+        return {
+            "error_code": int(ErrorCode.sasl_authentication_failed),
+            "error_message": msg,
+            "auth_bytes": b"",
+            "session_lifetime_ms": 0,
+        }
+
+    convo = conn.sasl_state
+    if not isinstance(convo, ScramServerConversation):
+        return fail("sasl handshake required before authenticate")
+    try:
+        if not convo._client_first_bare:
+            out = convo.handle_client_first(ctx.request["auth_bytes"])
+        else:
+            out = convo.handle_client_final(ctx.request["auth_bytes"])
+    except (ScramError, UnicodeDecodeError, ValueError) as e:
+        return fail(str(e))
+    if convo.complete:
+        conn.authenticated_principal = f"User:{convo.username}"
+        conn.sasl_state = None
+    return {
+        "error_code": 0,
+        "error_message": None,
+        "auth_bytes": out,
+        "session_lifetime_ms": 0,
+    }
+
+
+# ------------------------------------------------------------------ acl crud
+def _binding_from_creation(c: dict) -> AclBinding:
+    return AclBinding(
+        ResourcePattern(
+            ResourceType(c["resource_type"]),
+            c["resource_name"],
+            PatternType(c.get("resource_pattern_type", int(PatternType.literal))),
+        ),
+        AclEntry(
+            c["principal"], c["host"],
+            AclOperation(c["operation"]), AclPermission(c["permission_type"]),
+        ),
+    )
+
+
+def _filter_from_request(f: dict) -> AclBindingFilter:
+    """Wire field names per the acl filter schema (messages.py
+    _ACL_FILTER_REQ): *_filter variants, 0/absent = any."""
+
+    def _enum(cls, v, default):
+        return cls(v) if v else default
+
+    return AclBindingFilter(
+        resource_type=_enum(ResourceType, f.get("resource_type_filter"), ResourceType.any),
+        name=f.get("resource_name_filter"),
+        pattern_type=_enum(PatternType, f.get("pattern_type_filter"), PatternType.any),
+        principal=f.get("principal_filter"),
+        host=f.get("host_filter"),
+        operation=_enum(AclOperation, f.get("operation"), AclOperation.any),
+        permission=_enum(AclPermission, f.get("permission_type"), AclPermission.any),
+    )
+
+
+def _binding_wire(b: AclBinding) -> dict:
+    return {
+        "resource_type": int(b.pattern.resource_type),
+        "resource_name": b.pattern.name,
+        "pattern_type": int(b.pattern.pattern_type),
+        "principal": b.entry.principal,
+        "host": b.entry.host,
+        "operation": int(b.entry.operation),
+        "permission_type": int(b.entry.permission),
+    }
+
+
+async def handle_describe_acls(ctx) -> dict:
+    if not authorize(ctx, ResourceType.cluster, DEFAULT_CLUSTER_NAME, AclOperation.describe):
+        return {
+            "error_code": int(ErrorCode.cluster_authorization_failed),
+            "error_message": "cluster describe denied",
+            "resources": [],
+            "throttle_time_ms": 0,
+        }
+    sec: SecurityManager = ctx.broker.security
+    flt = _filter_from_request(ctx.request)
+    by_pattern: dict[ResourcePattern, list] = {}
+    for b in sec.acls.describe(flt) if sec else []:
+        by_pattern.setdefault(b.pattern, []).append(b.entry)
+    return {
+        "error_code": 0,
+        "error_message": None,
+        "throttle_time_ms": 0,
+        "resources": [
+            {
+                "resource_type": int(p.resource_type),
+                "resource_name": p.name,
+                "pattern_type": int(p.pattern_type),
+                "acls": [
+                    {
+                        "principal": e.principal,
+                        "host": e.host,
+                        "operation": int(e.operation),
+                        "permission_type": int(e.permission),
+                    }
+                    for e in entries
+                ],
+            }
+            for p, entries in by_pattern.items()
+        ],
+    }
+
+
+async def handle_create_acls(ctx) -> dict:
+    results = []
+    if not authorize(ctx, ResourceType.cluster, DEFAULT_CLUSTER_NAME, AclOperation.alter):
+        results = [
+            {"error_code": int(ErrorCode.cluster_authorization_failed), "error_message": "denied"}
+            for _ in ctx.request["creations"]
+        ]
+        return {"throttle_time_ms": 0, "results": results}
+    bindings = []
+    for c in ctx.request["creations"]:
+        try:
+            bindings.append(_binding_from_creation(c))
+            results.append({"error_code": 0, "error_message": None})
+        except (ValueError, KeyError) as e:
+            results.append(
+                {"error_code": int(ErrorCode.invalid_request), "error_message": str(e)}
+            )
+    if bindings:
+        await ctx.broker.replicate_security_cmd(
+            SecurityManager.create_acls_cmd(bindings)
+        )
+    return {"throttle_time_ms": 0, "results": results}
+
+
+async def handle_delete_acls(ctx) -> dict:
+    if not authorize(ctx, ResourceType.cluster, DEFAULT_CLUSTER_NAME, AclOperation.alter):
+        return {
+            "throttle_time_ms": 0,
+            "filter_results": [
+                {
+                    "error_code": int(ErrorCode.cluster_authorization_failed),
+                    "error_message": "denied",
+                    "matching_acls": [],
+                }
+                for _ in ctx.request["filters"]
+            ],
+        }
+    sec: SecurityManager = ctx.broker.security
+    filter_results = []
+    all_filters = []
+    for f in ctx.request["filters"]:
+        flt = _filter_from_request(f)
+        matched = sec.acls.describe(flt) if sec else []
+        all_filters.append(flt)
+        filter_results.append(
+            {
+                "error_code": 0,
+                "error_message": None,
+                "matching_acls": [
+                    dict(_binding_wire(b), error_code=0, error_message=None)
+                    for b in matched
+                ],
+            }
+        )
+    if all_filters:
+        await ctx.broker.replicate_security_cmd(
+            SecurityManager.delete_acls_cmd(all_filters)
+        )
+    return {"throttle_time_ms": 0, "filter_results": filter_results}
+
+
+def register_security_handlers(handlers: dict) -> None:
+    handlers[m.SASL_HANDSHAKE] = handle_sasl_handshake
+    handlers[m.SASL_AUTHENTICATE] = handle_sasl_authenticate
+    handlers[m.DESCRIBE_ACLS] = handle_describe_acls
+    handlers[m.CREATE_ACLS] = handle_create_acls
+    handlers[m.DELETE_ACLS] = handle_delete_acls

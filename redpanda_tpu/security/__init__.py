@@ -1,0 +1,50 @@
+"""Security layer: SCRAM SASL, credential store, ACLs, authorizer.
+
+Parity with src/v/security: scram_algorithm.h:203 (templated SHA-256/512
+SCRAM with client/server message parsing), credential_store.h,
+acl.h/acl_store/authorizer.h:39. Credentials and ACLs replicate through the
+controller (user_management_cmd / acl_management_cmd batches) exactly like
+topics do — the SecurityManager is the STM-side applier.
+"""
+
+from redpanda_tpu.security.acl import (
+    AclBinding,
+    AclBindingFilter,
+    AclEntry,
+    AclOperation,
+    AclPermission,
+    AclStore,
+    Authorizer,
+    PatternType,
+    ResourcePattern,
+    ResourceType,
+)
+from redpanda_tpu.security.credential_store import CredentialStore
+from redpanda_tpu.security.manager import SecurityManager
+from redpanda_tpu.security.scram import (
+    ScramAlgorithm,
+    ScramCredential,
+    ScramServerConversation,
+    scram_client_first,
+    scram_client_final,
+)
+
+__all__ = [
+    "AclBinding",
+    "AclBindingFilter",
+    "AclEntry",
+    "AclOperation",
+    "AclPermission",
+    "AclStore",
+    "Authorizer",
+    "CredentialStore",
+    "PatternType",
+    "ResourcePattern",
+    "ResourceType",
+    "ScramAlgorithm",
+    "ScramCredential",
+    "ScramServerConversation",
+    "SecurityManager",
+    "scram_client_first",
+    "scram_client_final",
+]

@@ -1,0 +1,30 @@
+"""Credential store: username → SCRAM credential.
+
+Parity with security/credential_store.h. Mutations arrive as applied
+controller commands (user_management_cmd batches), so every broker holds
+the same verifier material.
+"""
+
+from __future__ import annotations
+
+from redpanda_tpu.security.scram import ScramCredential
+
+
+class CredentialStore:
+    def __init__(self) -> None:
+        self._creds: dict[str, ScramCredential] = {}
+
+    def put(self, username: str, cred: ScramCredential) -> None:
+        self._creds[username] = cred
+
+    def get(self, username: str) -> ScramCredential | None:
+        return self._creds.get(username)
+
+    def remove(self, username: str) -> bool:
+        return self._creds.pop(username, None) is not None
+
+    def contains(self, username: str) -> bool:
+        return username in self._creds
+
+    def users(self) -> list[str]:
+        return sorted(self._creds)

@@ -1,0 +1,136 @@
+"""Security manager: the controller-side applier + frontend for users/ACLs.
+
+Parity with cluster/security_manager + security_frontend: SCRAM user CRUD
+and ACL CRUD are controller commands (commands.h:116-150 create/delete/
+update_user, create/delete_acls) replicated through raft0 and applied on
+every broker, so the credential store and ACL store are cluster-consistent.
+The kafka SASL handlers and the admin API both route through this.
+"""
+
+from __future__ import annotations
+
+from redpanda_tpu.cluster.commands import Command, CommandType
+from redpanda_tpu.security.acl import AclBinding, AclBindingFilter, AclStore
+from redpanda_tpu.security.credential_store import CredentialStore
+from redpanda_tpu.security.scram import (
+    MECHANISMS,
+    SCRAM_SHA256,
+    ScramCredential,
+    make_credential,
+)
+
+_USER_ACL_CMDS = [
+    CommandType.create_user,
+    CommandType.delete_user,
+    CommandType.update_user,
+    CommandType.create_acls,
+    CommandType.delete_acls,
+]
+
+
+class SecurityManager:
+    def __init__(self) -> None:
+        self.credentials = CredentialStore()
+        self.acls = AclStore()
+
+    # ------------------------------------------------------------ wiring
+    def attach(self, controller) -> "SecurityManager":
+        """Register as the applier for user/acl command types; returns self.
+        Frontend methods then need the controller (or a dispatcher) to
+        replicate — they accept it per call to stay import-cycle-free."""
+        controller.register_applier(_USER_ACL_CMDS, self.apply_command)
+        return self
+
+    # ------------------------------------------------------------ apply (every node)
+    async def apply_command(self, cmd: Command) -> None:
+        d = cmd.data
+        if cmd.type == CommandType.create_user:
+            self.credentials.put(d["username"], ScramCredential.from_dict(d["credential"]))
+        elif cmd.type == CommandType.update_user:
+            if not self.credentials.contains(d["username"]):
+                raise ValueError(f"unknown user: {d['username']}")
+            self.credentials.put(d["username"], ScramCredential.from_dict(d["credential"]))
+        elif cmd.type == CommandType.delete_user:
+            if not self.credentials.remove(d["username"]):
+                raise ValueError(f"unknown user: {d['username']}")
+        elif cmd.type == CommandType.create_acls:
+            self.acls.add([AclBinding.from_dict(b) for b in d["bindings"]])
+        elif cmd.type == CommandType.delete_acls:
+            # filters serialized as binding-filter dicts; None = wildcard
+            filters = [
+                AclBindingFilter(**{k: _flt(k, v) for k, v in f.items()})
+                for f in d["filters"]
+            ]
+            self.acls.remove(filters)
+
+    # ------------------------------------------------------------ command builders
+    @staticmethod
+    def create_user_cmd(
+        username: str, password: str, mechanism: str = SCRAM_SHA256.name,
+        iterations: int | None = None,
+    ) -> Command:
+        algo = MECHANISMS[mechanism]
+        cred = make_credential(password, algo, iterations)
+        return Command(
+            CommandType.create_user,
+            {"username": username, "credential": cred.to_dict()},
+        )
+
+    @staticmethod
+    def update_user_cmd(
+        username: str, password: str, mechanism: str = SCRAM_SHA256.name
+    ) -> Command:
+        cred = make_credential(password, MECHANISMS[mechanism])
+        return Command(
+            CommandType.update_user,
+            {"username": username, "credential": cred.to_dict()},
+        )
+
+    @staticmethod
+    def delete_user_cmd(username: str) -> Command:
+        return Command(CommandType.delete_user, {"username": username})
+
+    @staticmethod
+    def create_acls_cmd(bindings: list[AclBinding]) -> Command:
+        return Command(
+            CommandType.create_acls, {"bindings": [b.to_dict() for b in bindings]}
+        )
+
+    @staticmethod
+    def delete_acls_cmd(filters: list[AclBindingFilter]) -> Command:
+        return Command(
+            CommandType.delete_acls,
+            {
+                "filters": [
+                    {
+                        "resource_type": int(f.resource_type),
+                        "name": f.name,
+                        "pattern_type": int(f.pattern_type),
+                        "principal": f.principal,
+                        "host": f.host,
+                        "operation": int(f.operation),
+                        "permission": int(f.permission),
+                    }
+                    for f in filters
+                ]
+            },
+        )
+
+
+def _flt(key: str, value):
+    from redpanda_tpu.security.acl import (
+        AclOperation,
+        AclPermission,
+        PatternType,
+        ResourceType,
+    )
+
+    if value is None:
+        return None
+    conv = {
+        "resource_type": ResourceType,
+        "pattern_type": PatternType,
+        "operation": AclOperation,
+        "permission": AclPermission,
+    }.get(key)
+    return conv(value) if conv else value

@@ -1,0 +1,230 @@
+"""SCRAM-SHA-256 / SCRAM-SHA-512 (RFC 5802).
+
+Parity with security/scram_algorithm.h:203: the same algorithm templated
+over the hash, credential generation (salted-password PBKDF2 → client/server
+keys), and the server-side 4-message conversation with strict message
+parsing (scram_algorithm.h:53-201 parses via regex; we parse attr=value
+pairs with the same validation rules). Used by the kafka SASL handlers and
+by the admin API's user CRUD (credentials are created controller-side and
+replicated — only salted verifier material is ever stored, never the
+password).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import re
+from dataclasses import dataclass
+
+
+class ScramError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class ScramAlgorithm:
+    name: str  # SASL mechanism name
+    hash_name: str  # hashlib name
+    min_iterations: int
+
+    def hmac(self, key: bytes, msg: bytes) -> bytes:
+        return hmac.new(key, msg, self.hash_name).digest()
+
+    def h(self, data: bytes) -> bytes:
+        return hashlib.new(self.hash_name, data).digest()
+
+    def hi(self, password: bytes, salt: bytes, iterations: int) -> bytes:
+        return hashlib.pbkdf2_hmac(self.hash_name, password, salt, iterations)
+
+
+SCRAM_SHA256 = ScramAlgorithm("SCRAM-SHA-256", "sha256", 4096)
+SCRAM_SHA512 = ScramAlgorithm("SCRAM-SHA-512", "sha512", 4096)
+MECHANISMS: dict[str, ScramAlgorithm] = {
+    SCRAM_SHA256.name: SCRAM_SHA256,
+    SCRAM_SHA512.name: SCRAM_SHA512,
+}
+
+
+@dataclass
+class ScramCredential:
+    """What the broker stores per user (scram_credential: salt, server_key,
+    stored_key, iterations — never the password)."""
+
+    salt: bytes
+    server_key: bytes
+    stored_key: bytes
+    iterations: int
+    mechanism: str = SCRAM_SHA256.name
+
+    def to_dict(self) -> dict:
+        return {
+            "salt": base64.b64encode(self.salt).decode(),
+            "server_key": base64.b64encode(self.server_key).decode(),
+            "stored_key": base64.b64encode(self.stored_key).decode(),
+            "iterations": self.iterations,
+            "mechanism": self.mechanism,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ScramCredential":
+        return ScramCredential(
+            base64.b64decode(d["salt"]),
+            base64.b64decode(d["server_key"]),
+            base64.b64decode(d["stored_key"]),
+            int(d["iterations"]),
+            d.get("mechanism", SCRAM_SHA256.name),
+        )
+
+
+def make_credential(
+    password: str, algo: ScramAlgorithm = SCRAM_SHA256, iterations: int | None = None
+) -> ScramCredential:
+    iterations = iterations or algo.min_iterations
+    if iterations < algo.min_iterations:
+        raise ScramError(f"iterations < {algo.min_iterations}")
+    salt = os.urandom(16)
+    salted = algo.hi(password.encode(), salt, iterations)
+    client_key = algo.hmac(salted, b"Client Key")
+    server_key = algo.hmac(salted, b"Server Key")
+    stored_key = algo.h(client_key)
+    return ScramCredential(salt, server_key, stored_key, iterations, algo.name)
+
+
+# Per-process seed for unknown-user dummy salts (stable within a broker's
+# lifetime so the same username always sees the same salt).
+_DUMMY_SALT_SEED = os.urandom(16)
+
+# -------------------------------------------------------------- wire parsing
+_ATTR_RE = re.compile(r"^[a-z]=")
+
+
+def _parse_attrs(msg: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for part in msg.split(","):
+        if not part:
+            continue
+        if not _ATTR_RE.match(part):
+            raise ScramError(f"malformed scram attribute: {part!r}")
+        out[part[0]] = part[2:]
+    return out
+
+
+def _saslname_decode(name: str) -> str:
+    return name.replace("=2C", ",").replace("=3D", "=")
+
+
+def _saslname_encode(name: str) -> str:
+    return name.replace("=", "=3D").replace(",", "=2C")
+
+
+class ScramServerConversation:
+    """Server side of one SCRAM authentication (scram_authenticator).
+
+    handle_client_first() -> server-first message
+    handle_client_final() -> server-final message (raises on bad proof)
+    """
+
+    def __init__(self, lookup_credential, algo: ScramAlgorithm) -> None:
+        """lookup_credential(username) -> ScramCredential | None"""
+        self._lookup = lookup_credential
+        self.algo = algo
+        self.username: str | None = None
+        self._cred: ScramCredential | None = None
+        self._client_first_bare = ""
+        self._server_first = ""
+        self._nonce = ""
+        self.complete = False
+
+    def handle_client_first(self, msg: bytes) -> bytes:
+        text = msg.decode("utf-8")
+        # gs2 header: "n," [authzid] "," then client-first-bare
+        if not (text.startswith("n,") or text.startswith("y,")):
+            raise ScramError("channel binding not supported")
+        gs2_end = text.index(",", 2)
+        bare = text[gs2_end + 1 :]
+        attrs = _parse_attrs(bare)
+        if "n" not in attrs or "r" not in attrs:
+            raise ScramError("missing user/nonce in client-first")
+        self.username = _saslname_decode(attrs["n"])
+        client_nonce = attrs["r"]
+        self._client_first_bare = bare
+        self._cred = self._lookup(self.username)
+        if self._cred is None or self._cred.mechanism != self.algo.name:
+            # Keep going with a dummy credential; fail at proof check so
+            # usernames can't be probed (the reference fails late too). The
+            # dummy salt is DERIVED from the username so repeated attempts
+            # see a stable salt — a fresh random salt per attempt would
+            # itself reveal that the account doesn't exist.
+            salt = hmac.new(_DUMMY_SALT_SEED, self.username.encode(), "sha256").digest()[:16]
+            digest_len = hashlib.new(self.algo.hash_name).digest_size
+            self._cred = ScramCredential(
+                salt, b"\x00" * digest_len, b"\x00" * digest_len,
+                self.algo.min_iterations, self.algo.name,
+            )
+        self._nonce = client_nonce + base64.b64encode(os.urandom(18)).decode()
+        self._server_first = (
+            f"r={self._nonce},"
+            f"s={base64.b64encode(self._cred.salt).decode()},"
+            f"i={self._cred.iterations}"
+        )
+        return self._server_first.encode()
+
+    def handle_client_final(self, msg: bytes) -> bytes:
+        text = msg.decode("utf-8")
+        attrs = _parse_attrs(text)
+        if "c" not in attrs or "r" not in attrs or "p" not in attrs:
+            raise ScramError("missing attributes in client-final")
+        if attrs["r"] != self._nonce:
+            raise ScramError("nonce mismatch")
+        without_proof = text[: text.rindex(",p=")]
+        auth_message = ",".join(
+            [self._client_first_bare, self._server_first, without_proof]
+        ).encode()
+        proof = base64.b64decode(attrs["p"])
+        client_signature = self.algo.hmac(self._cred.stored_key, auth_message)
+        if len(proof) != len(client_signature):
+            raise ScramError("bad proof length")
+        client_key = bytes(a ^ b for a, b in zip(proof, client_signature))
+        if not hmac.compare_digest(self.algo.h(client_key), self._cred.stored_key):
+            raise ScramError("authentication failed")
+        self.complete = True
+        server_signature = self.algo.hmac(self._cred.server_key, auth_message)
+        return b"v=" + base64.b64encode(server_signature)
+
+
+# -------------------------------------------------------------- client side
+def scram_client_first(username: str, nonce: str) -> bytes:
+    return f"n,,n={_saslname_encode(username)},r={nonce}".encode()
+
+
+def scram_client_final(
+    username: str,
+    password: str,
+    nonce: str,
+    client_first: bytes,
+    server_first: bytes,
+    algo: ScramAlgorithm = SCRAM_SHA256,
+) -> tuple[bytes, bytes]:
+    """Returns (client-final message, expected server signature)."""
+    attrs = _parse_attrs(server_first.decode())
+    full_nonce, salt, iterations = attrs["r"], base64.b64decode(attrs["s"]), int(attrs["i"])
+    if not full_nonce.startswith(nonce):
+        raise ScramError("server nonce does not extend client nonce")
+    salted = algo.hi(password.encode(), salt, iterations)
+    client_key = algo.hmac(salted, b"Client Key")
+    stored_key = algo.h(client_key)
+    bare = client_first.decode()[2:]
+    gs2_end = bare.index(",")
+    bare = bare[gs2_end + 1 :]
+    channel = base64.b64encode(b"n,,").decode()
+    without_proof = f"c={channel},r={full_nonce}"
+    auth_message = ",".join([bare, server_first.decode(), without_proof]).encode()
+    client_signature = algo.hmac(stored_key, auth_message)
+    proof = bytes(a ^ b for a, b in zip(client_key, client_signature))
+    final = f"{without_proof},p={base64.b64encode(proof).decode()}".encode()
+    server_key = algo.hmac(salted, b"Server Key")
+    expected_sig = algo.hmac(server_key, auth_message)
+    return final, expected_sig

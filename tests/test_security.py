@@ -1,0 +1,337 @@
+"""Security tests: SCRAM algorithm, ACL matching/authorizer, SASL over the
+kafka wire, ACL CRUD APIs, and cluster-replicated credentials.
+
+Mirrors security/tests (scram_algorithm_test.cc, authorizer tests) plus
+ducktape scram_test.py / acls_test.py driven hermetically through the
+in-proc broker + client.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+
+import pytest
+
+from redpanda_tpu.kafka.client.client import KafkaClient
+from redpanda_tpu.kafka.protocol import messages as m
+from redpanda_tpu.kafka.protocol.errors import ErrorCode, KafkaError
+from redpanda_tpu.kafka.server.broker import Broker, BrokerConfig
+from redpanda_tpu.kafka.server.protocol import KafkaServer
+from redpanda_tpu.security import (
+    AclBinding,
+    AclBindingFilter,
+    AclEntry,
+    AclOperation,
+    AclPermission,
+    AclStore,
+    Authorizer,
+    PatternType,
+    ResourcePattern,
+    ResourceType,
+    SecurityManager,
+)
+from redpanda_tpu.security.scram import (
+    SCRAM_SHA256,
+    SCRAM_SHA512,
+    ScramError,
+    ScramServerConversation,
+    make_credential,
+    scram_client_final,
+    scram_client_first,
+)
+from redpanda_tpu.storage.log_manager import StorageApi
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# ------------------------------------------------------------------ scram unit
+@pytest.mark.parametrize("algo", [SCRAM_SHA256, SCRAM_SHA512])
+def test_scram_conversation_success(algo):
+    cred = make_credential("hunter2", algo)
+    convo = ScramServerConversation(lambda u: cred if u == "alice" else None, algo)
+    nonce = base64.b64encode(b"client-nonce-0123").decode()
+    first = scram_client_first("alice", nonce)
+    server_first = convo.handle_client_first(first)
+    final, expected_sig = scram_client_final(
+        "alice", "hunter2", nonce, first, server_first, algo
+    )
+    server_final = convo.handle_client_final(final)
+    assert convo.complete and convo.username == "alice"
+    assert server_final == b"v=" + base64.b64encode(expected_sig)
+
+
+def test_scram_wrong_password_rejected():
+    cred = make_credential("correct", SCRAM_SHA256)
+    convo = ScramServerConversation(lambda u: cred, SCRAM_SHA256)
+    nonce = base64.b64encode(b"n0").decode()
+    first = scram_client_first("bob", nonce)
+    server_first = convo.handle_client_first(first)
+    final, _ = scram_client_final("bob", "wrong", nonce, first, server_first)
+    with pytest.raises(ScramError):
+        convo.handle_client_final(final)
+    assert not convo.complete
+
+
+def test_scram_unknown_user_fails_late_not_early():
+    convo = ScramServerConversation(lambda u: None, SCRAM_SHA256)
+    nonce = base64.b64encode(b"n1").decode()
+    first = scram_client_first("ghost", nonce)
+    server_first = convo.handle_client_first(first)  # must NOT raise (no probing)
+    final, _ = scram_client_final("ghost", "whatever", nonce, first, server_first)
+    with pytest.raises(ScramError):
+        convo.handle_client_final(final)
+
+
+def test_scram_username_escaping():
+    cred = make_credential("pw", SCRAM_SHA256)
+    seen = []
+
+    def lookup(u):
+        seen.append(u)
+        return cred
+
+    convo = ScramServerConversation(lookup, SCRAM_SHA256)
+    nonce = base64.b64encode(b"n2").decode()
+    first = scram_client_first("we,ird=user", nonce)
+    convo.handle_client_first(first)
+    assert seen == ["we,ird=user"]
+
+
+# ------------------------------------------------------------------ acl unit
+def _b(rt, name, principal, op, perm=AclPermission.allow, pt=PatternType.literal, host="*"):
+    return AclBinding(ResourcePattern(rt, name, pt), AclEntry(principal, host, op, perm))
+
+
+def test_authorizer_deny_wins_and_implied_describe():
+    store = AclStore()
+    store.add([
+        _b(ResourceType.topic, "logs", "User:alice", AclOperation.write),
+        _b(ResourceType.topic, "logs", "User:alice", AclOperation.write, AclPermission.deny, host="10.0.0.1"),
+    ])
+    az = Authorizer(store)
+    assert az.authorized(ResourceType.topic, "logs", AclOperation.write, "User:alice")
+    # deny for that host wins
+    assert not az.authorized(ResourceType.topic, "logs", AclOperation.write, "User:alice", host="10.0.0.1")
+    # write implies describe
+    assert az.authorized(ResourceType.topic, "logs", AclOperation.describe, "User:alice")
+    # no binding for bob
+    assert not az.authorized(ResourceType.topic, "logs", AclOperation.write, "User:bob")
+
+
+def test_authorizer_prefix_wildcard_superuser():
+    store = AclStore()
+    store.add([
+        _b(ResourceType.topic, "metrics-", "User:svc", AclOperation.read, pt=PatternType.prefixed),
+        _b(ResourceType.group, "*", "User:*", AclOperation.read),
+    ])
+    az = Authorizer(store, superusers={"admin"})
+    assert az.authorized(ResourceType.topic, "metrics-cpu", AclOperation.read, "User:svc")
+    assert not az.authorized(ResourceType.topic, "other", AclOperation.read, "User:svc")
+    assert az.authorized(ResourceType.group, "anything", AclOperation.read, "User:whoever")
+    # superuser bypasses everything
+    assert az.authorized(ResourceType.topic, "other", AclOperation.write, "User:admin")
+    # empty store == permissive; non-empty == deny by default
+    assert Authorizer(AclStore()).authorized(ResourceType.topic, "t", AclOperation.write, None)
+    assert not az.authorized(ResourceType.cluster, "kafka-cluster", AclOperation.alter, "User:rando")
+
+
+def test_acl_store_filters():
+    store = AclStore()
+    b1 = _b(ResourceType.topic, "a", "User:x", AclOperation.read)
+    b2 = _b(ResourceType.topic, "b", "User:y", AclOperation.write)
+    b3 = _b(ResourceType.group, "g", "User:x", AclOperation.read)
+    store.add([b1, b2, b3])
+    assert set(store.describe(AclBindingFilter(principal="User:x"))) == {b1, b3}
+    removed = store.remove([AclBindingFilter(resource_type=ResourceType.topic)])
+    assert set(removed) == {b1, b2}
+    assert store.all_bindings() == [b3]
+
+
+# ------------------------------------------------------------------ wire e2e
+async def _start_sasl_broker(tmp_path, **cfg_kw):
+    storage = await StorageApi(str(tmp_path)).start()
+    cfg = BrokerConfig(data_dir=str(tmp_path), **cfg_kw)
+    broker = Broker(cfg, storage)
+    server = await KafkaServer(broker, "127.0.0.1", 0).start()
+    cfg.advertised_port = server.port
+    return broker, server
+
+
+async def _stop(server, broker, *clients):
+    for c in clients:
+        await c.close()
+    await server.stop()
+    await broker.storage.stop()
+
+
+def test_sasl_e2e_and_gate(tmp_path):
+    async def main():
+        broker, server = await _start_sasl_broker(tmp_path, sasl_enabled=True)
+        await broker.security.apply_command(
+            SecurityManager.create_user_cmd("alice", "hunter2")
+        )
+        # unauthenticated requests are gated
+        bare = KafkaClient([("127.0.0.1", server.port)])
+        await bare.connect()  # ApiVersions allowed pre-auth
+        with pytest.raises(KafkaError):
+            await bare.create_topic("nope", partitions=1)
+        await bare.close()
+        # authenticated client works end-to-end
+        client = KafkaClient([("127.0.0.1", server.port)], sasl=("alice", "hunter2"))
+        await client.connect()
+        await client.create_topic("events", partitions=1)
+        await client.produce("events", 0, [b"hello"])
+        batches, _hwm = await client.fetch("events", 0, 0)
+        assert [r.value for b in batches for r in b.records()] == [b"hello"]
+        # wrong password fails the dance
+        bad = KafkaClient([("127.0.0.1", server.port)], sasl=("alice", "wrong"))
+        with pytest.raises(KafkaError):
+            await bad.connect()
+        await bad.close()
+        await _stop(server, broker, client)
+
+    run(main())
+
+
+def test_sasl_sha512_mechanism(tmp_path):
+    async def main():
+        broker, server = await _start_sasl_broker(tmp_path, sasl_enabled=True)
+        await broker.security.apply_command(
+            SecurityManager.create_user_cmd("u512", "pw", mechanism="SCRAM-SHA-512")
+        )
+        client = KafkaClient(
+            [("127.0.0.1", server.port)], sasl=("u512", "pw"), sasl_mechanism="SCRAM-SHA-512"
+        )
+        await client.connect()
+        await client.create_topic("t512", partitions=1)
+        await _stop(server, broker, client)
+
+    run(main())
+
+
+def test_acl_crud_over_wire_and_enforcement(tmp_path):
+    async def main():
+        broker, server = await _start_sasl_broker(
+            tmp_path, sasl_enabled=True, superusers=["admin"]
+        )
+        for u, p in [("admin", "adminpw"), ("alice", "alicepw")]:
+            await broker.security.apply_command(SecurityManager.create_user_cmd(u, p))
+        admin = KafkaClient([("127.0.0.1", server.port)], sasl=("admin", "adminpw"))
+        await admin.connect()
+        await admin.create_topic("secured", partitions=1)
+        conn = await admin.any_connection()
+        # create an allow-read (but not write) ACL for alice
+        res = await conn.request(m.CREATE_ACLS, {"creations": [{
+            "resource_type": int(ResourceType.topic),
+            "resource_name": "secured",
+            "resource_pattern_type": int(PatternType.literal),
+            "principal": "User:alice",
+            "host": "*",
+            "operation": int(AclOperation.read),
+            "permission_type": int(AclPermission.allow),
+        }]})
+        assert res["results"][0]["error_code"] == 0
+        # describe sees it
+        res = await conn.request(m.DESCRIBE_ACLS, {
+            "resource_type_filter": int(ResourceType.any),
+            "resource_name_filter": None,
+            "pattern_type_filter": int(PatternType.any),
+            "principal_filter": None,
+            "host_filter": None,
+            "operation": int(AclOperation.any),
+            "permission_type": int(AclPermission.any),
+        })
+        assert res["error_code"] == 0 and len(res["resources"]) == 1
+        # alice may read but not write
+        alice = KafkaClient([("127.0.0.1", server.port)], sasl=("alice", "alicepw"))
+        await alice.connect()
+        with pytest.raises(KafkaError) as ei:
+            await alice.produce("secured", 0, [b"denied"])
+        assert ei.value.code == ErrorCode.topic_authorization_failed
+        batches, _hwm = await alice.fetch("secured", 0, 0)
+        assert batches == []
+        # metadata auto-create must honor the create ACL: alice names a
+        # nonexistent topic and the broker must NOT create it
+        aconn = await alice.any_connection()
+        md = await aconn.request(m.METADATA, {
+            "topics": [{"name": "alice-made-this"}],
+            "allow_auto_topic_creation": True,
+        })
+        assert not broker.topic_table.contains("alice-made-this")
+        # full listing only shows what alice may describe (read implies it)
+        md = await aconn.request(m.METADATA, {"topics": None})
+        assert [t["name"] for t in md["topics"]] == ["secured"]
+        # list_offsets on an unauthorized topic is denied, not leaked
+        lo = await aconn.request(m.LIST_OFFSETS, {
+            "replica_id": -1,
+            "isolation_level": 0,
+            "topics": [{"name": "alice-made-this", "partitions": [
+                {"partition_index": 0, "current_leader_epoch": -1,
+                 "timestamp": -1, "max_num_offsets": 1}]}],
+        })
+        assert lo["topics"][0]["partitions"][0]["error_code"] == int(
+            ErrorCode.topic_authorization_failed
+        )
+        # delete the acl; alice loses read too (deny-by-default once ACLs exist)
+        res = await conn.request(m.DELETE_ACLS, {"filters": [{
+            "resource_type_filter": int(ResourceType.topic),
+            "resource_name_filter": "secured",
+            "pattern_type_filter": int(PatternType.any),
+            "principal_filter": None,
+            "host_filter": None,
+            "operation": int(AclOperation.any),
+            "permission_type": int(AclPermission.any),
+        }]})
+        assert len(res["filter_results"][0]["matching_acls"]) == 1
+        await _stop(server, broker, admin, alice)
+
+    run(main())
+
+
+def test_credentials_replicate_through_controller(tmp_path):
+    """SecurityManager as controller applier: user created on the leader is
+    usable (same verifier) on every node."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from test_cluster import ClusterFixture
+
+    async def main():
+        fx = await ClusterFixture(tmp_path, 3).start()
+        try:
+            mgrs = [SecurityManager().attach(n.controller) for n in fx.nodes]
+            leader = fx.controller_leader()
+            i = fx.nodes.index(leader)
+            await leader.controller.replicate_and_wait(
+                SecurityManager.create_user_cmd("clusteruser", "pw")
+            )
+            # follower STMs apply asynchronously; wait for convergence
+            from test_cluster import wait_until
+
+            await wait_until(
+                lambda: all(m_.credentials.contains("clusteruser") for m_ in mgrs),
+                msg="credential replication",
+            )
+            for mgr in mgrs:
+                # same salted verifier everywhere (replicated, not re-derived)
+                assert (
+                    mgr.credentials.get("clusteruser").stored_key
+                    == mgrs[i].credentials.get("clusteruser").stored_key
+                )
+            # acls too
+            await leader.controller.replicate_and_wait(
+                SecurityManager.create_acls_cmd(
+                    [_b(ResourceType.topic, "x", "User:clusteruser", AclOperation.read)]
+                )
+            )
+            await wait_until(
+                lambda: all(len(m_.acls.all_bindings()) == 1 for m_ in mgrs),
+                msg="acl replication",
+            )
+        finally:
+            await fx.stop()
+
+    run(main())
