@@ -38,7 +38,10 @@ class Broker:
         self.storage = storage
         self.topic_table = TopicTable()
         self.partition_manager = PartitionManager(storage, config.node_id)
-        self.group_coordinator = None  # wired by the app once groups land
+        from redpanda_tpu.kafka.server.group_manager import GroupManager
+
+        self.group_coordinator = GroupManager(self)
+        self.metadata_cache = None  # multi-node: cluster.MetadataCache
         self.coproc_api = None  # wired once the transform engine attaches
         self.tx_coordinator = None  # wired once transactions land
         self.quota_manager = None
@@ -59,6 +62,61 @@ class Broker:
         else:
             await self.security.apply_command(cmd)
 
+    # ------------------------------------------------------------ recovery
+    def _persist_topic_config(self, cfg: TopicConfig) -> None:
+        """Topic configs go to the kvstore so restart recovery restores
+        overrides (cleanup.policy, retention, …) — in a cluster the
+        controller log is the durable copy instead."""
+        import json
+
+        from redpanda_tpu.storage.kvstore import KeySpace
+
+        payload = {"ns": cfg.ns, "partitions": cfg.partition_count,
+                   "config": cfg.config_map()}
+        self.storage.kvs.put(
+            KeySpace.storage, f"topic_cfg/{cfg.ns}/{cfg.name}".encode(),
+            json.dumps(payload).encode(),
+        )
+
+    async def recover_topics(self) -> None:
+        """Single-node restart: rediscover topics from the on-disk log tree
+        (<data>/<ns>/<topic>/<partition>) plus their persisted configs. In a
+        cluster the controller STM replay rebuilds the topic table instead;
+        here the disk IS the source of truth (log_manager.cc:179 recovery)."""
+        import json
+        import os
+
+        from redpanda_tpu.storage.kvstore import KeySpace
+
+        base = self.storage.log_mgr.config.base_dir
+        if not os.path.isdir(base):
+            return
+        found: dict[tuple[str, str], int] = {}  # (ns, topic) -> partitions
+        for ns in os.listdir(base):
+            ns_dir = os.path.join(base, ns)
+            if not os.path.isdir(ns_dir):
+                continue
+            for topic in os.listdir(ns_dir):
+                t_dir = os.path.join(ns_dir, topic)
+                if not os.path.isdir(t_dir):
+                    continue
+                parts = [p for p in os.listdir(t_dir) if p.isdigit()]
+                if parts:
+                    found[(ns, topic)] = max(int(p) for p in parts) + 1
+        for (ns, topic), n_parts in sorted(found.items()):
+            if self.topic_table.contains(topic):
+                continue
+            cfg = TopicConfig(topic, n_parts, ns=ns)
+            saved = self.storage.kvs.get(
+                KeySpace.storage, f"topic_cfg/{ns}/{topic}".encode()
+            )
+            if saved is not None:
+                for k, v in json.loads(saved.decode()).get("config", {}).items():
+                    cfg.apply_override(k, v)
+            elif topic == "__consumer_offsets":
+                cfg.cleanup_policy = "compact"
+            await self.create_topic(cfg)
+
     # ------------------------------------------------------------ topics
     async def create_topic(self, config: TopicConfig) -> None:
         md = self.topic_table.add_topic(
@@ -66,11 +124,17 @@ class Broker:
         )
         for pa in md.assignments.values():
             await self.partition_manager.manage(pa.ntp)
+        self._persist_topic_config(config)
 
     async def delete_topic(self, name: str) -> None:
+        from redpanda_tpu.storage.kvstore import KeySpace
+
         md = self.topic_table.remove_topic(name)
         for pa in md.assignments.values():
             await self.partition_manager.remove(pa.ntp)
+        self.storage.kvs.remove(
+            KeySpace.storage, f"topic_cfg/{md.config.ns}/{name}".encode()
+        )
 
     async def create_partitions(self, name: str, new_count: int) -> None:
         self.topic_table.add_partitions(

@@ -45,7 +45,6 @@ def build_dispatch_table() -> dict:
         m.ALTER_CONFIGS: handle_alter_configs,
         m.INCREMENTAL_ALTER_CONFIGS: handle_incremental_alter_configs,
         m.DESCRIBE_LOG_DIRS: handle_describe_log_dirs,
-        m.FIND_COORDINATOR: handle_find_coordinator,
     }
 
 
@@ -82,6 +81,7 @@ async def handle_metadata(ctx) -> dict:
                 if (
                     not broker.topic_table.contains(name)
                     and _valid_topic_name(name)
+                    and not broker.is_internal_topic(name)
                     # auto-create honors the same create ACL as CreateTopics
                     and _authorized(ctx, AclOperation.create, name)
                 ):
@@ -423,6 +423,11 @@ async def handle_create_topics(ctx) -> dict:
         if not _valid_topic_name(name):
             results.append(_topic_result(name, E.invalid_topic_exception))
             continue
+        if broker.is_internal_topic(name):
+            results.append(
+                _topic_result(name, E.invalid_topic_exception, "reserved internal name")
+            )
+            continue
         if broker.topic_table.contains(name):
             results.append(_topic_result(name, E.topic_already_exists))
             continue
@@ -641,6 +646,7 @@ async def handle_alter_configs(ctx) -> dict:
             elif not ctx.request.get("validate_only", False):
                 for c in res.get("configs") or []:
                     _apply_topic_config(md.config, c["name"], c["value"])
+                broker._persist_topic_config(md.config)
         else:
             code = E.invalid_request
         responses.append(
@@ -673,6 +679,7 @@ async def handle_incremental_alter_configs(ctx) -> dict:
                         _apply_topic_config(md.config, c["name"], c["value"])
                     elif op == 1:  # DELETE
                         md.config.extra.pop(c["name"], None)
+                broker._persist_topic_config(md.config)
         else:
             code = E.invalid_request
         responses.append(
@@ -722,17 +729,6 @@ async def handle_describe_log_dirs(ctx) -> dict:
 
 
 # ---------------------------------------------------------------- coordinator
-async def handle_find_coordinator(ctx) -> dict:
-    cfg = ctx.broker.config
-    return {
-        "error_code": 0,
-        "error_message": None,
-        "node_id": cfg.node_id,
-        "host": cfg.advertised_host,
-        "port": cfg.advertised_port,
-    }
-
-
 # ---------------------------------------------------------------- error makers
 def _produce_error_maker(ctx, code: ErrorCode) -> dict:
     return {

@@ -259,10 +259,17 @@ class KafkaServer:
         self.port = port
         self.handlers = h.build_dispatch_table()
         sh.register_security_handlers(self.handlers)
+        from redpanda_tpu.kafka.server import group_handlers as gh
+
+        gh.register_group_handlers(self.handlers)
         self._server: asyncio.AbstractServer | None = None
         self._conn_tasks: set[asyncio.Task] = set()
 
     async def start(self) -> "KafkaServer":
+        # single-node mode: rediscover topics from disk before serving
+        # (cluster mode repopulates the table via controller replay instead)
+        if getattr(self.broker, "controller_dispatcher", None) is None:
+            await self.broker.recover_topics()
         self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
@@ -283,6 +290,11 @@ class KafkaServer:
                 await asyncio.wait_for(self._server.wait_closed(), timeout=1.0)
             except asyncio.TimeoutError:
                 pass
+        # AFTER connections are torn down: an in-flight group request could
+        # otherwise restart the manager and leak its expiry fiber
+        gm = getattr(self.broker, "group_coordinator", None)
+        if gm is not None:
+            await gm.stop()
 
     async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         task = asyncio.current_task()
