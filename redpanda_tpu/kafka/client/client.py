@@ -311,15 +311,18 @@ class KafkaClient:
         max_bytes: int = 1 << 20,
         max_wait_ms: int = 100,
         min_bytes: int = 1,
+        isolation_level: int = 0,
     ) -> tuple[list[RecordBatch], int]:
-        """Returns (batches, high_watermark)."""
+        """Returns (batches, high_watermark). isolation_level=1 =
+        read_committed (server clamps to LSO; aborted batches filtered
+        client-side via the aborted_transactions ranges)."""
         conn = await self.leader_connection(topic, partition)
         body = {
             "replica_id": -1,
             "max_wait_ms": max_wait_ms,
             "min_bytes": min_bytes,
             "max_bytes": max_bytes,
-            "isolation_level": 0,
+            "isolation_level": isolation_level,
             "session_id": 0,
             "session_epoch": -1,
             "topics": [
@@ -347,6 +350,30 @@ class KafkaClient:
         batches = []
         if records:
             batches = [a.batch for a in decode_wire_batches(records) if a.batch is not None]
+        if isolation_level != 1:
+            # control batches (tx markers) are transport metadata, never
+            # application records — skipped at EVERY isolation level
+            batches = [b for b in batches if not b.header.is_control]
+        if isolation_level == 1:
+            # Standard read_committed consumer: a pid becomes "aborted" at
+            # its advertised first_offset and stops being aborted at its
+            # control marker — offsets after the marker are a NEW tx.
+            pending = sorted(
+                (a["first_offset"], a["producer_id"])
+                for a in presp.get("aborted_transactions") or []
+            )
+            aborted_active: set[int] = set()
+            visible = []
+            for b in batches:
+                while pending and pending[0][0] <= b.header.base_offset:
+                    aborted_active.add(pending.pop(0)[1])
+                if b.header.is_control:
+                    aborted_active.discard(b.header.producer_id)
+                    continue
+                if b.header.is_transactional and b.header.producer_id in aborted_active:
+                    continue
+                visible.append(b)
+            batches = visible
         return batches, presp["high_watermark"]
 
     # ------------------------------------------------------------ offsets

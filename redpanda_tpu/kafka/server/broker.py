@@ -43,7 +43,10 @@ class Broker:
         self.group_coordinator = GroupManager(self)
         self.metadata_cache = None  # multi-node: cluster.MetadataCache
         self.coproc_api = None  # wired once the transform engine attaches
-        self.tx_coordinator = None  # wired once transactions land
+        from redpanda_tpu.kafka.server.tx_coordinator import TxCoordinator
+
+        self.tx_coordinator = TxCoordinator(self)
+        self._rm_stms: dict = {}  # NTP -> RmStm
         self.quota_manager = None
         self.controller_dispatcher = None  # multi-node: routes security/topic cmds
         # SCRAM credentials + ACLs; cluster-replicated when a controller is
@@ -132,6 +135,9 @@ class Broker:
         md = self.topic_table.remove_topic(name)
         for pa in md.assignments.values():
             await self.partition_manager.remove(pa.ntp)
+            # drop the producer/tx stm: a recreated topic must not inherit
+            # the old incarnation's sequence/transaction state
+            self._rm_stms.pop(pa.ntp, None)
         self.storage.kvs.remove(
             KeySpace.storage, f"topic_cfg/{md.config.ns}/{name}".encode()
         )
@@ -147,6 +153,21 @@ class Broker:
     # ------------------------------------------------------------ lookup
     def get_partition(self, topic: str, partition: int, ns: str = DEFAULT_NAMESPACE) -> Partition | None:
         return self.partition_manager.get(NTP(ns, topic, partition))
+
+    def rm_stm_for(self, partition: Partition):
+        """Producer/tx state machine attached to a partition, created on
+        first touch (partition.h stm_manager hooks). Callers must
+        ``await ensure_rm_recovered`` before first use after restart."""
+        from redpanda_tpu.cluster.rm_stm import RmStm
+
+        stm = self._rm_stms.get(partition.ntp)
+        if stm is None:
+            stm = RmStm(partition)
+            self._rm_stms[partition.ntp] = stm
+        return stm
+
+    async def recovered_rm_stm(self, partition: Partition):
+        return await self.rm_stm_for(partition).ensure_recovered()
 
     def is_internal_topic(self, name: str) -> bool:
         return name.startswith("__") or name.startswith("_redpanda")

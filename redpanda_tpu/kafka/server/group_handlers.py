@@ -222,9 +222,9 @@ async def handle_offset_fetch(ctx) -> dict:
     gm = _gm(ctx)
     if not _group_authorized(ctx, AclOperation.describe, r["group_id"]):
         return {"throttle_time_ms": 0, "topics": [], "error_code": int(E.group_authorization_failed)}
+    await gm.start()
     if not gm.is_coordinator(r["group_id"]):
         return {"throttle_time_ms": 0, "topics": [], "error_code": int(E.not_coordinator)}
-    await gm.start()
     g = gm.get(r["group_id"])
     requested = r.get("topics")
     out_topics = []

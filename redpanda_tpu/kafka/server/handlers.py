@@ -234,7 +234,25 @@ async def _produce_one(broker, topic: str, p: dict, level: int) -> dict:
         batches.append(a.batch)
     if not batches:
         return _produce_partition_error(index, E.invalid_record)
-    result = await partition.replicate(batches, level)
+    # idempotence / transaction gate (rm_stm on the produce path,
+    # produce_topic_partition → rm_stm path in produce.cc:196): check +
+    # append run atomically inside the stm
+    if any(b.header.producer_id >= 0 for b in batches):
+        stm = await broker.recovered_rm_stm(partition)
+        code, result = await stm.replicate(batches, level)
+        if code != E.none:
+            return _produce_partition_error(index, code)
+        if result is None:
+            # every batch was an idempotent duplicate: ack, nothing appended
+            return {
+                "partition_index": index,
+                "error_code": 0,
+                "base_offset": -1,
+                "log_append_time_ms": -1,
+                "log_start_offset": partition.start_offset,
+            }
+    else:
+        result = await partition.replicate(batches, level)
     return {
         "partition_index": index,
         "error_code": 0,
@@ -317,12 +335,27 @@ async def _fetch_once(ctx, max_bytes: int) -> tuple[list, int, bool]:
                 parts.append(_fetch_partition_error(index, E.offset_out_of_range, hwm=hwm))
                 any_error = True
                 continue
+            # read_committed: clamp to the LSO and surface aborted ranges so
+            # clients drop aborted records (rm_stm LSO + tx_range snapshots)
+            read_committed = ctx.request.get("isolation_level", 0) == 1
+            lso = partition.last_stable_offset
+            max_read = hwm - 1
+            aborted = None
+            if read_committed:
+                stm = await ctx.broker.recovered_rm_stm(partition)
+                lso = stm.last_stable_offset
+                max_read = lso - 1
             take = min(p.get("partition_max_bytes", budget), max(budget, 0))
             batches = (
-                await partition.make_reader(fetch_offset, take, max_offset=hwm - 1)
-                if take > 0
+                await partition.make_reader(fetch_offset, take, max_offset=max_read)
+                if take > 0 and fetch_offset <= max_read
                 else []
             )
+            if read_committed and batches:
+                aborted = [
+                    {"producer_id": a.producer_id, "first_offset": a.first_offset}
+                    for a in stm.aborted_ranges(fetch_offset, batches[-1].last_offset)
+                ] or None
             records = encode_wire_batches(batches) if batches else b""
             total += len(records)
             budget -= len(records)
@@ -331,9 +364,9 @@ async def _fetch_once(ctx, max_bytes: int) -> tuple[list, int, bool]:
                     "partition_index": index,
                     "error_code": 0,
                     "high_watermark": hwm,
-                    "last_stable_offset": partition.last_stable_offset,
+                    "last_stable_offset": lso,
                     "log_start_offset": partition.start_offset,
-                    "aborted_transactions": None,
+                    "aborted_transactions": aborted,
                     "preferred_read_replica": -1,
                     "records": records or None,
                 }

@@ -260,8 +260,10 @@ class KafkaServer:
         self.handlers = h.build_dispatch_table()
         sh.register_security_handlers(self.handlers)
         from redpanda_tpu.kafka.server import group_handlers as gh
+        from redpanda_tpu.kafka.server import tx_handlers as th
 
         gh.register_group_handlers(self.handlers)
+        th.register_tx_handlers(self.handlers)
         self._server: asyncio.AbstractServer | None = None
         self._conn_tasks: set[asyncio.Task] = set()
 
@@ -270,6 +272,9 @@ class KafkaServer:
         # (cluster mode repopulates the table via controller replay instead)
         if getattr(self.broker, "controller_dispatcher", None) is None:
             await self.broker.recover_topics()
+        tx = getattr(self.broker, "tx_coordinator", None)
+        if tx is not None:
+            tx.start_expiry()
         self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
@@ -295,6 +300,9 @@ class KafkaServer:
         gm = getattr(self.broker, "group_coordinator", None)
         if gm is not None:
             await gm.stop()
+        tx = getattr(self.broker, "tx_coordinator", None)
+        if tx is not None:
+            await tx.stop()
 
     async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         task = asyncio.current_task()
