@@ -1,0 +1,5 @@
+"""Admin API server (redpanda/admin_server.cc parity)."""
+
+from redpanda_tpu.admin.server import AdminServer
+
+__all__ = ["AdminServer"]

@@ -1,0 +1,258 @@
+"""Admin HTTP API.
+
+Parity with redpanda/admin_server.cc:
+- GET  /v1/config                      (:218 config get; secrets redacted)
+- PUT  /v1/config/log_level/{logger}   (:226-263 runtime log level w/ expiry)
+- GET  /v1/brokers                     (broker membership view)
+- GET  /v1/partitions                  (local partition inventory)
+- POST /v1/raft/{group}/transfer_leadership             (:301)
+- POST /v1/partitions/kafka/{t}/{p}/transfer_leadership (:486)
+- GET/POST/DELETE /v1/security/users   (:401-483 SCRAM CRUD)
+- GET  /v1/failure-probes, PUT /v1/failure-probes/{m}/{p}/{type} (:948)
+- GET  /metrics                        (:148-151 prometheus)
+- GET  /v1/status/ready
+Served on aiohttp (the reference uses seastar httpd with swagger routes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from aiohttp import web
+
+from redpanda_tpu.finjector import honey_badger
+from redpanda_tpu.metrics import registry
+
+logger = logging.getLogger("rptpu.admin")
+
+
+class AdminServer:
+    def __init__(
+        self,
+        broker,
+        config=None,  # config.Configuration
+        group_manager=None,  # raft.GroupManager (multi-node)
+        controller=None,  # cluster.Controller (multi-node)
+        host: str = "127.0.0.1",
+        port: int = 9644,
+    ) -> None:
+        self.broker = broker
+        self.config = config
+        self.gm = group_manager
+        self.controller = controller
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+        self._log_level_restores: dict[str, tuple[int, asyncio.TimerHandle]] = {}
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> "AdminServer":
+        app = web.Application()
+        app.add_routes([
+            web.get("/v1/config", self._get_config),
+            web.put("/v1/config/log_level/{name}", self._set_log_level),
+            web.get("/v1/brokers", self._get_brokers),
+            web.get("/v1/partitions", self._get_partitions),
+            web.post("/v1/raft/{group}/transfer_leadership", self._raft_transfer),
+            web.post(
+                "/v1/partitions/kafka/{topic}/{partition}/transfer_leadership",
+                self._partition_transfer,
+            ),
+            web.get("/v1/security/users", self._list_users),
+            web.post("/v1/security/users", self._create_user),
+            web.delete("/v1/security/users/{user}", self._delete_user),
+            web.put("/v1/security/users/{user}", self._update_user),
+            web.get("/v1/failure-probes", self._list_probes),
+            web.put("/v1/failure-probes/{module}/{probe}/{type}", self._set_probe),
+            web.delete("/v1/failure-probes/{module}/{probe}", self._unset_probe),
+            web.get("/metrics", self._metrics),
+            web.get("/v1/status/ready", self._ready),
+        ])
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        logger.info("admin api listening on %s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        for _, handle in self._log_level_restores.values():
+            handle.cancel()
+        self._log_level_restores.clear()
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ------------------------------------------------------------ config
+    async def _get_config(self, req: web.Request) -> web.Response:
+        if self.config is not None:
+            return web.json_response(self.config.to_dict(redact=True))
+        cfg = self.broker.config
+        return web.json_response({k: v for k, v in vars(cfg).items() if not k.startswith("_")})
+
+    async def _set_log_level(self, req: web.Request) -> web.Response:
+        name = req.match_info["name"]
+        level_name = req.query.get("level", "info").upper()
+        expiry_s = int(req.query.get("expires", "300"))
+        level = getattr(logging, level_name, None)
+        if not isinstance(level, int):
+            return web.json_response({"error": f"unknown level {level_name}"}, status=400)
+        lg = logging.getLogger(name)
+        old = lg.level
+        lg.setLevel(level)
+        # auto-restore, like admin_server.cc's expiring override (:226-263)
+        existing = self._log_level_restores.pop(name, None)
+        if existing is not None:
+            old = existing[0]
+            existing[1].cancel()
+        loop = asyncio.get_running_loop()
+        handle = loop.call_later(expiry_s, self._restore_level, name)
+        self._log_level_restores[name] = (old, handle)
+        return web.json_response({"logger": name, "level": level_name, "expires_s": expiry_s})
+
+    def _restore_level(self, name: str) -> None:
+        entry = self._log_level_restores.pop(name, None)
+        if entry is not None:
+            logging.getLogger(name).setLevel(entry[0])
+
+    # ------------------------------------------------------------ views
+    async def _get_brokers(self, req: web.Request) -> web.Response:
+        if self.controller is not None:
+            return web.json_response([
+                {
+                    "node_id": b.node_id, "host": b.host, "port": b.port,
+                    "kafka_host": b.kafka_host, "kafka_port": b.kafka_port,
+                    "membership_status": b.state.name,
+                }
+                for b in self.controller.members.all_brokers()
+            ])
+        cfg = self.broker.config
+        return web.json_response([
+            {
+                "node_id": cfg.node_id, "host": cfg.advertised_host,
+                "port": cfg.advertised_port, "kafka_host": cfg.advertised_host,
+                "kafka_port": cfg.advertised_port, "membership_status": "active",
+            }
+        ])
+
+    async def _get_partitions(self, req: web.Request) -> web.Response:
+        out = []
+        for ntp, p in self.broker.partition_manager.partitions().items():
+            out.append({
+                "ns": ntp.ns, "topic": ntp.topic, "partition": ntp.partition,
+                "leader": p.leader_id, "is_leader": p.is_leader(),
+                "high_watermark": p.high_watermark,
+                "start_offset": p.start_offset,
+            })
+        return web.json_response(out)
+
+    async def _ready(self, req: web.Request) -> web.Response:
+        return web.json_response({"status": "ready"})
+
+    # ------------------------------------------------------------ leadership
+    async def _raft_transfer(self, req: web.Request) -> web.Response:
+        if self.gm is None:
+            return web.json_response({"error": "not clustered"}, status=400)
+        group = int(req.match_info["group"])
+        target = int(req.query.get("target", "-1"))
+        c = self.gm.consensus_for(group)
+        if c is None:
+            return web.json_response({"error": f"unknown group {group}"}, status=404)
+        ok = await c.do_transfer_leadership(target)
+        return web.json_response({"success": bool(ok)})
+
+    async def _partition_transfer(self, req: web.Request) -> web.Response:
+        if self.gm is None:
+            return web.json_response({"error": "not clustered"}, status=400)
+        topic = req.match_info["topic"]
+        partition = int(req.match_info["partition"])
+        target = int(req.query.get("target", "-1"))
+        p = self.broker.get_partition(topic, partition)
+        consensus = getattr(p, "consensus", None)
+        if p is None or not hasattr(consensus, "do_transfer_leadership"):
+            return web.json_response({"error": "unknown or non-raft partition"}, status=404)
+        ok = await consensus.do_transfer_leadership(target)
+        return web.json_response({"success": bool(ok)})
+
+    # ------------------------------------------------------------ users
+    async def _list_users(self, req: web.Request) -> web.Response:
+        return web.json_response(self.broker.security.credentials.users())
+
+    async def _create_user(self, req: web.Request) -> web.Response:
+        from redpanda_tpu.security import SecurityManager
+
+        body = await req.json()
+        try:
+            cmd = SecurityManager.create_user_cmd(
+                body["username"], body["password"],
+                body.get("algorithm", "SCRAM-SHA-256"),
+            )
+        except KeyError as e:
+            return web.json_response({"error": f"missing field {e}"}, status=400)
+        try:
+            await self.broker.replicate_security_cmd(cmd)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"created": body["username"]})
+
+    async def _update_user(self, req: web.Request) -> web.Response:
+        from redpanda_tpu.security import SecurityManager
+
+        body = await req.json()
+        cmd = SecurityManager.update_user_cmd(
+            req.match_info["user"], body["password"],
+            body.get("algorithm", "SCRAM-SHA-256"),
+        )
+        try:
+            await self.broker.replicate_security_cmd(cmd)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"updated": req.match_info["user"]})
+
+    async def _delete_user(self, req: web.Request) -> web.Response:
+        from redpanda_tpu.security import SecurityManager
+
+        try:
+            await self.broker.replicate_security_cmd(
+                SecurityManager.delete_user_cmd(req.match_info["user"])
+            )
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"deleted": req.match_info["user"]})
+
+    # ------------------------------------------------------------ failure probes
+    async def _list_probes(self, req: web.Request) -> web.Response:
+        return web.json_response(
+            {"enabled": honey_badger.enabled, "modules": honey_badger.modules()}
+        )
+
+    async def _set_probe(self, req: web.Request) -> web.Response:
+        module = req.match_info["module"]
+        probe = req.match_info["probe"]
+        typ = req.match_info["type"]
+        honey_badger.enable()
+        if typ == "exception":
+            honey_badger.set_exception(module, probe)
+        elif typ == "delay":
+            honey_badger.set_delay(module, probe)
+        elif typ == "terminate":
+            honey_badger.set_termination(module, probe)
+        else:
+            return web.json_response({"error": f"unknown type {typ}"}, status=400)
+        return web.json_response({"armed": f"{module}.{probe}", "type": typ})
+
+    async def _unset_probe(self, req: web.Request) -> web.Response:
+        honey_badger.unset(req.match_info["module"], req.match_info["probe"])
+        return web.json_response({"disarmed": f"{req.match_info['module']}.{req.match_info['probe']}"})
+
+    # ------------------------------------------------------------ metrics
+    async def _metrics(self, req: web.Request) -> web.Response:
+        return web.Response(
+            text=registry.render_prometheus(),
+            content_type="text/plain",
+            charset="utf-8",
+        )

@@ -1,0 +1,229 @@
+"""Application assembly: wire and start every service.
+
+Parity with redpanda/application.cc (wire_up_services :492-882, start
+:884-1060): construct the service graph in dependency order, start it, and
+stop in reverse on shutdown. Two modes, like the reference's single-broker
+vs clustered deployments:
+
+- single-node: storage → broker (direct-consensus partitions) → kafka
+  server → admin server.
+- clustered: + internal rpc server, raft group manager, controller (raft0),
+  controller backend, metadata dissemination; the broker routes mutations
+  through the controller dispatcher.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from redpanda_tpu import rpc
+from redpanda_tpu.admin import AdminServer
+from redpanda_tpu.config import Configuration
+from redpanda_tpu.kafka.server.broker import Broker, BrokerConfig
+from redpanda_tpu.kafka.server.protocol import KafkaServer
+from redpanda_tpu.metrics import registry
+from redpanda_tpu.storage.log_manager import StorageApi
+
+logger = logging.getLogger("rptpu.app")
+
+
+class Application:
+    def __init__(self, config: Configuration) -> None:
+        self.config = config
+        self.storage: StorageApi | None = None
+        self.broker: Broker | None = None
+        self.kafka_server: KafkaServer | None = None
+        self.admin: AdminServer | None = None
+        # clustered-mode services
+        self.rpc_server = None
+        self.group_manager = None
+        self.controller = None
+        self.backend = None
+        self.md_dissemination = None
+        self.connections = None
+        self.coproc = None
+        self._stop_order: list = []
+
+    # ------------------------------------------------------------ wiring
+    def _broker_config(self) -> BrokerConfig:
+        c = self.config
+        return BrokerConfig(
+            node_id=c.node_id,
+            cluster_id=c.cluster_id,
+            advertised_host=c.advertised_kafka_api_host,
+            advertised_port=c.advertised_kafka_api_port,
+            data_dir=c.data_directory,
+            auto_create_topics=c.auto_create_topics_enabled,
+            default_partitions=c.default_topic_partitions,
+            default_replication=c.default_topic_replication,
+            fetch_poll_interval_s=c.fetch_poll_interval_ms / 1000.0,
+            sasl_enabled=c.enable_sasl,
+            superusers=[u for u in c.superusers.split(",") if u],
+        )
+
+    async def start(self) -> "Application":
+        c = self.config
+        self.storage = await StorageApi(c.data_directory).start()
+        self._stop_order.append(self.storage)
+        self.broker = Broker(self._broker_config(), self.storage)
+
+        is_clustered = bool(c.seed_servers)
+        if is_clustered:
+            await self._start_cluster_services()
+
+        self.kafka_server = await KafkaServer(
+            self.broker, c.kafka_api_host, c.kafka_api_port
+        ).start()
+        # ephemeral bind (port 0, tests) must advertise the real port or
+        # metadata sends clients to a dead address
+        adv = c.advertised_kafka_api_port
+        if c.kafka_api_port == 0 or adv == 0:
+            adv = self.kafka_server.port
+        self.broker.config.advertised_port = adv
+        self._stop_order.append(self.kafka_server)
+
+        self.admin = await AdminServer(
+            self.broker,
+            config=c,
+            group_manager=self.group_manager,
+            controller=self.controller,
+            host=c.admin_api_host,
+            port=c.admin_api_port,
+        ).start()
+        self._stop_order.append(self.admin)
+
+        if c.coproc_enable:
+            await self._start_coproc()
+
+        self._register_metrics()
+        await self.storage.log_mgr.start_housekeeping(
+            c.log_compaction_interval_ms / 1000.0
+        )
+        logger.info("application started (node %d)", c.node_id)
+        return self
+
+    async def _start_cluster_services(self) -> None:
+        """Internal RPC + raft + controller (application.cc :521-610)."""
+        from redpanda_tpu.cluster import (
+            Controller,
+            ControllerBackend,
+            ControllerDispatcher,
+            ClusterService,
+            MetadataCache,
+            MetadataDisseminationService,
+            PartitionLeadersTable,
+            ShardTable,
+        )
+        from redpanda_tpu.cluster import commands as ccmds
+        from redpanda_tpu.cluster.metadata_dissemination import md_dissemination_service
+        from redpanda_tpu.raft.consensus import RaftTimings
+        from redpanda_tpu.raft.group_manager import GroupManager
+        from redpanda_tpu.raft.types import VNode
+
+        c = self.config
+        self.connections = rpc.ConnectionCache()
+        self_vnode = VNode(c.node_id, 0)
+        self.group_manager = GroupManager(
+            self_vnode, self.storage, self.connections,
+            timings=RaftTimings(
+                election_timeout_ms=c.raft_election_timeout_ms,
+                heartbeat_interval_ms=c.raft_heartbeat_interval_ms,
+            ),
+            recovery_concurrency=c.raft_recovery_concurrency,
+        )
+        self.controller = Controller(self_vnode, self.group_manager, self.connections)
+        dispatcher = ControllerDispatcher(self.controller, self.connections)
+        leaders = PartitionLeadersTable()
+        self.md_dissemination = MetadataDisseminationService(
+            c.node_id, leaders, self.controller.members, self.connections
+        )
+        self.backend = ControllerBackend(
+            self_vnode, self.controller.topic_table, self.group_manager,
+            self.broker.partition_manager, leaders_table=leaders,
+            shard_table=ShardTable(),
+            finish_move=lambda ntp, reps: dispatcher.replicate(
+                ccmds.finish_moving_cmd(ntp, reps)
+            ),
+        )
+        self.group_manager.register_leadership_notification(
+            lambda cons: self.md_dissemination.notify_leadership(
+                cons.ntp, cons.leader_id, cons.term
+            )
+        )
+        proto = rpc.SimpleProtocol()
+        self.group_manager.register_service(proto)
+        ClusterService(self.controller, dispatcher).register(proto)
+        proto.register_service(
+            rpc.ServiceHandler(md_dissemination_service, self.md_dissemination)
+        )
+        self.rpc_server = rpc.Server(c.rpc_server_host, c.rpc_server_port)
+        self.rpc_server.set_protocol(proto)
+        await self.rpc_server.start()
+        await self.group_manager.start()
+        self._stop_order += [self.rpc_server, self.group_manager]
+
+        seeds = []
+        for hp in c.seed_servers.split(","):
+            if not hp:
+                continue
+            node_str, _, addr = hp.partition("@")
+            host, _, port = addr.partition(":")
+            seeds.append((int(node_str), host, int(port)))
+        for node_id, host, port in seeds:
+            if node_id != c.node_id:
+                self.connections.register(node_id, host, port)
+        seed_vnodes = [VNode(nid, 0) for nid, _, _ in seeds]
+        await self.controller.start(seed_vnodes)
+        await self.backend.start()
+        await self.md_dissemination.start()
+        self._stop_order += [self.md_dissemination, self.backend, self.controller]
+
+        self.broker.controller_dispatcher = dispatcher
+        self.broker.security.attach(self.controller)
+        self.broker.metadata_cache = MetadataCache(
+            self.controller.topic_table, self.controller.members, leaders
+        )
+        # announce ourselves through the controller once a leader exists
+        await dispatcher.replicate(
+            ccmds.register_node_cmd(
+                c.node_id, c.rpc_server_host, self.rpc_server.port,
+                c.advertised_kafka_api_host, c.advertised_kafka_api_port,
+            )
+        )
+
+    async def _start_coproc(self) -> None:
+        from redpanda_tpu.coproc.api import CoprocApi
+
+        self.coproc = await CoprocApi(self.broker, self.config).start()
+        self.broker.coproc_api = self.coproc
+        self._stop_order.append(self.coproc)
+
+    def _register_metrics(self) -> None:
+        b = self.broker
+        registry.gauge(
+            "partitions_total", lambda: len(b.partition_manager.partitions()),
+            "Local partition replicas",
+        )
+        registry.gauge(
+            "topics_total", lambda: len(b.topic_table.topics()), "Known topics"
+        )
+
+    # ------------------------------------------------------------ shutdown
+    async def stop(self) -> None:
+        """Reverse-order stop (application.cc:179-185)."""
+        for svc in reversed(self._stop_order):
+            try:
+                await svc.stop()
+            except Exception:
+                logger.exception("stopping %s failed", type(svc).__name__)
+        self._stop_order.clear()
+        if self.connections is not None:
+            await self.connections.close()
+
+    async def run_forever(self) -> None:
+        stop_event = asyncio.Event()
+        try:
+            await stop_event.wait()
+        finally:
+            await self.stop()

@@ -177,13 +177,19 @@ class RmStm:
         if hdr.is_transactional and pid not in self._ongoing and pid not in self._pending_begin:
             # transactional produce requires AddPartitionsToTxn first
             return E.invalid_txn_state
-        if hdr.base_sequence >= 0 and st is not None and hdr.producer_epoch == st.epoch:
-            last = sim.get(pid, st.last_seq)
-            if last == -1 or hdr.base_sequence == last + 1:
-                return E.none
-            if hdr.base_sequence <= last:
-                return E.duplicate_sequence_number
-            return E.out_of_order_sequence_number
+        if hdr.base_sequence >= 0:
+            # earlier batches of THIS request count even for a brand-new
+            # producer (st None) — a retried duplicate inside one request
+            # must still dedup
+            last = sim.get(pid)
+            if last is None and st is not None and hdr.producer_epoch == st.epoch:
+                last = st.last_seq if st.last_seq != -1 else None
+            if last is not None:
+                if hdr.base_sequence == last + 1:
+                    return E.none
+                if hdr.base_sequence <= last:
+                    return E.duplicate_sequence_number
+                return E.out_of_order_sequence_number
         return E.none
 
     def _note_appended(self, batch: RecordBatch, base_offset: int) -> None:

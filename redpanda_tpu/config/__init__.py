@@ -1,0 +1,19 @@
+"""Typed configuration store.
+
+Parity with src/v/config: ``Property`` mirrors base_property.h:30 /
+property.h:25 (name, description, default, validator, YAML/JSON (de)ser)
+and ``Configuration`` mirrors configuration.cc's `shard_local_cfg()`
+singleton — the property groups below cover the key knobs the reference
+exposes (kafka/rpc/admin endpoints, raft timings, storage sizing and
+retention, coproc_* from configuration.h:57-61, quotas, tx). Unknown keys
+are preserved so configs written by newer versions round-trip.
+"""
+
+from redpanda_tpu.config.properties import (
+    Configuration,
+    Property,
+    ValidationError,
+    shard_local_cfg,
+)
+
+__all__ = ["Configuration", "Property", "ValidationError", "shard_local_cfg"]

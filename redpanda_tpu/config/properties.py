@@ -1,0 +1,188 @@
+"""Property tables + the broker configuration.
+
+See package docstring. Reference: config/base_property.h:30 (metadata +
+validation), config/property.h:25 (typed), config/configuration.cc (the
+property set), application.cc:312-362 (YAML hydration to every shard).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+class ValidationError(ValueError):
+    pass
+
+
+@dataclass
+class Property:
+    name: str
+    description: str
+    default: Any
+    type: type = str
+    validator: Callable[[Any], str | None] | None = None  # returns error or None
+    needs_restart: bool = True
+
+    def coerce(self, value: Any) -> Any:
+        if value is None:
+            return None
+        if self.type is bool and isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        try:
+            return self.type(value)
+        except (TypeError, ValueError) as e:
+            raise ValidationError(f"{self.name}: {e}") from e
+
+    def validate(self, value: Any) -> None:
+        if self.validator is not None:
+            err = self.validator(value)
+            if err:
+                raise ValidationError(f"{self.name}: {err}")
+
+
+def _positive(v) -> str | None:
+    return None if v is None or v > 0 else "must be positive"
+
+
+def _non_negative(v) -> str | None:
+    return None if v is None or v >= 0 else "must be >= 0"
+
+
+def _port(v) -> str | None:
+    if v is None:
+        return "port may not be empty"
+    return None if 0 <= v <= 65535 else "not a port"
+
+
+# The reference's property groups (configuration.cc), trimmed to the knobs
+# this build actually consumes plus the well-known ones operators expect.
+PROPERTIES: list[Property] = [
+    # --- identity / listeners
+    Property("node_id", "Unique broker id", 0, int, _non_negative),
+    Property("cluster_id", "Cluster identity string", "redpanda_tpu"),
+    Property("data_directory", "Data directory", "/var/lib/redpanda_tpu"),
+    Property("kafka_api_host", "Kafka API bind host", "127.0.0.1"),
+    Property("kafka_api_port", "Kafka API port", 9092, int, _port),
+    Property("advertised_kafka_api_host", "Advertised kafka host", "127.0.0.1"),
+    Property("advertised_kafka_api_port", "Advertised kafka port", 9092, int, _port),
+    Property("rpc_server_host", "Internal RPC bind host", "127.0.0.1"),
+    Property("rpc_server_port", "Internal RPC port", 33145, int, _port),
+    Property("admin_api_host", "Admin API bind host", "127.0.0.1"),
+    Property("admin_api_port", "Admin API port", 9644, int, _port),
+    Property("seed_servers", "Seed broker list host:port,...", ""),
+    # --- raft timings (configuration.cc raft group)
+    Property("raft_election_timeout_ms", "Election timeout", 1500, int, _positive, needs_restart=False),
+    Property("raft_heartbeat_interval_ms", "Leader heartbeat interval", 150, int, _positive, needs_restart=False),
+    Property("raft_recovery_concurrency", "Parallel follower recoveries", 4, int, _positive),
+    # --- storage (log_config application.cc:421-443)
+    Property("log_segment_size", "Segment roll size bytes", 128 * 1024 * 1024, int, _positive),
+    Property("log_retention_bytes", "Default retention bytes (-1 none)", -1, int),
+    Property("log_retention_ms", "Default retention ms (-1 none)", 7 * 24 * 3600 * 1000, int),
+    Property("log_compaction_interval_ms", "Housekeeping cadence", 10_000, int, _positive),
+    Property("fsync_on_append", "Flush to disk on quorum writes", True, bool),
+    # --- kafka server
+    Property("auto_create_topics_enabled", "Auto-create topics on metadata", True, bool),
+    Property("default_topic_partitions", "Default partition count", 1, int, _positive),
+    Property("default_topic_replication", "Default replication factor", 1, int, _positive),
+    Property("group_topic_partitions", "__consumer_offsets partitions", 16, int, _positive),
+    Property("fetch_poll_interval_ms", "Long-poll re-check cadence", 20, int, _positive, needs_restart=False),
+    Property("target_quota_byte_rate", "Per-client produce quota B/s (0 off)", 0, int, _non_negative, needs_restart=False),
+    # --- security
+    Property("enable_sasl", "Require SASL on the kafka listener", False, bool),
+    Property("superusers", "Comma-separated superuser principals", ""),
+    # --- tx / idempotence
+    Property("enable_idempotence", "Accept idempotent producers", True, bool),
+    Property("enable_transactions", "Accept transactional producers", True, bool),
+    Property("transactional_id_expiration_ms", "Idle tx expiry", 15 * 60 * 1000, int, _positive),
+    # --- coproc (configuration.h:57-61)
+    Property("coproc_enable", "Enable the TPU transform engine", False, bool),
+    Property("coproc_max_batch_size", "Max read per ntp per tick", 32 * 1024, int, _positive),
+    Property("coproc_max_inflight_bytes", "Read semaphore budget", 10 * 1024 * 1024, int, _positive),
+    Property("coproc_offset_flush_interval_ms", "Offset snapshot cadence", 300_000, int, _positive),
+    # --- tiered storage (cloud_storage_* group)
+    Property("cloud_storage_enabled", "Enable tiered storage", False, bool),
+    Property("cloud_storage_bucket", "S3 bucket", ""),
+    Property("cloud_storage_region", "S3 region", "us-east-1"),
+    Property("cloud_storage_api_endpoint", "S3 endpoint override", ""),
+    Property("cloud_storage_access_key", "S3 access key", ""),
+    Property("cloud_storage_secret_key", "S3 secret key", ""),
+    Property("cloud_storage_segment_max_upload_interval_sec", "Upload cadence", 30, int, _positive),
+]
+
+
+class Configuration:
+    """Runtime store over the property table (config_store semantics)."""
+
+    def __init__(self) -> None:
+        self._props: dict[str, Property] = {p.name: p for p in PROPERTIES}
+        self._values: dict[str, Any] = {p.name: p.default for p in PROPERTIES}
+        self._extra: dict[str, Any] = {}  # unknown keys, preserved
+
+    # ------------------------------------------------------------ access
+    def get(self, name: str) -> Any:
+        if name in self._values:
+            return self._values[name]
+        if name in self._extra:
+            return self._extra[name]
+        raise KeyError(name)
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self.get(name)
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def set(self, name: str, value: Any) -> None:
+        prop = self._props.get(name)
+        if prop is None:
+            self._extra[name] = value
+            return
+        value = prop.coerce(value)
+        prop.validate(value)
+        self._values[name] = value
+
+    def property(self, name: str) -> Property | None:
+        return self._props.get(name)
+
+    def properties(self) -> list[Property]:
+        return list(self._props.values())
+
+    # ------------------------------------------------------------ io
+    def to_dict(self, redact: bool = True) -> dict:
+        out = dict(self._values)
+        out.update(self._extra)
+        if redact:
+            for k in list(out):
+                if "secret" in k or "password" in k:
+                    if out[k]:
+                        out[k] = "[secret]"
+        return out
+
+    def load_dict(self, data: dict) -> None:
+        # the reference nests under a `redpanda:` section in redpanda.yaml
+        section = data.get("redpanda", data)
+        for k, v in section.items():
+            self.set(k, v)
+
+    def load_yaml(self, path: str) -> "Configuration":
+        import yaml
+
+        with open(path) as f:
+            self.load_dict(yaml.safe_load(f) or {})
+        return self
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+
+_cfg: Configuration | None = None
+
+
+def shard_local_cfg() -> Configuration:
+    """Process-wide configuration (configuration.cc shard_local_cfg())."""
+    global _cfg
+    if _cfg is None:
+        _cfg = Configuration()
+    return _cfg

@@ -109,6 +109,12 @@ class TxCoordinator:
     async def _expire_loop(self) -> None:
         import asyncio
 
+        # load (and re-drive crashed prepare_* transactions) even if no
+        # client ever issues a tx API call after restart
+        try:
+            await self._load()
+        except Exception:
+            logger.exception("tx state load failed")
         while True:
             await asyncio.sleep(self.expire_interval_s)
             try:
@@ -176,9 +182,18 @@ class TxCoordinator:
         if md is None:
             md = TxMetadata(tx_id, self._alloc_pid(), 0, timeout_ms)
         else:
-            if md.state == TxState.ongoing:
-                # fence the previous incarnation: abort its open tx
-                await self._finish(md, commit=False)
+            # fence the previous incarnation: finish whatever it left open
+            # BEFORE handing out a new epoch — clearing partitions with
+            # markers unwritten would pin those partitions' LSO forever
+            pending = {
+                TxState.ongoing: False,
+                TxState.prepare_abort: False,
+                TxState.prepare_commit: True,
+            }
+            if md.state in pending:
+                code = await self._finish(md, commit=pending[md.state])
+                if code != E.none:
+                    return E.concurrent_transactions, -1, -1  # retriable
             md.epoch += 1
             md.timeout_ms = timeout_ms
             if md.epoch > 0x7FFF - 1:
@@ -242,6 +257,9 @@ class TxCoordinator:
         if group_id not in md.staged_offsets:
             return E.invalid_txn_state  # AddOffsetsToTxn must come first
         md.staged_offsets[group_id].update(commits)
+        # durable BEFORE the ack: a crash between this ack and EndTxn must
+        # not lose offsets the app was told are part of the transaction
+        self._persist_tx(md)
         return E.none
 
     async def end_txn(self, tx_id: str, pid: int, epoch: int, commit: bool) -> E:

@@ -92,9 +92,6 @@ async def handle_add_offsets_to_txn(ctx) -> dict:
 
 async def handle_txn_offset_commit(ctx) -> dict:
     r = ctx.request
-    ok = _txn_authorized(ctx, r["transactional_id"]) and authorize(
-        ctx, ResourceType.group, r["group_id"], AclOperation.read
-    )
     commits: dict[tuple[str, int], OffsetCommit] = {}
     for t in r.get("topics") or []:
         for p in t["partitions"]:
@@ -102,8 +99,10 @@ async def handle_txn_offset_commit(ctx) -> dict:
                 p["committed_offset"], p.get("committed_leader_epoch", -1),
                 p.get("committed_metadata"),
             )
-    if not ok:
+    if not _txn_authorized(ctx, r["transactional_id"]):
         code = E.transactional_id_authorization_failed
+    elif not authorize(ctx, ResourceType.group, r["group_id"], AclOperation.read):
+        code = E.group_authorization_failed
     else:
         code = await ctx.broker.tx_coordinator.txn_offset_commit(
             r["transactional_id"], r["producer_id"], r["producer_epoch"],

@@ -1,0 +1,124 @@
+"""Metrics registry with prometheus text exposition.
+
+Parity with the reference's probe pattern: every subsystem registers a
+"probe" of counters/gauges/histograms (storage/probe.h, raft/probe.cc,
+kafka/latency_probe.h) and the admin server exports them all at /metrics in
+prometheus format (admin_server.cc:148-151). Gauges may be callables so
+live state (partition counts, HWMs) is sampled at scrape time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from redpanda_tpu.utils.hdr import HdrHist
+
+PREFIX = "redpanda_tpu"
+
+
+@dataclass
+class Counter:
+    name: str
+    help: str
+    labels: tuple[tuple[str, str], ...] = ()
+    value: float = 0
+
+    def inc(self, n: float = 1) -> None:
+        self.value += n
+
+
+@dataclass
+class Gauge:
+    name: str
+    help: str
+    fn: Callable[[], float]
+    labels: tuple[tuple[str, str], ...] = ()
+
+
+@dataclass
+class Histogram:
+    name: str
+    help: str
+    hist: HdrHist = field(default_factory=HdrHist)
+    labels: tuple[tuple[str, str], ...] = ()
+
+    def record(self, value: int) -> None:
+        self.hist.record(value)
+
+
+def _labelstr(labels: tuple[tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._hists: dict[str, Histogram] = {}
+
+    def _key(self, name: str, labels) -> str:
+        return name + repr(sorted(labels))
+
+    def counter(self, name: str, help: str = "", **labels: str) -> Counter:
+        key = self._key(name, labels.items())
+        c = self._counters.get(key)
+        if c is None:
+            c = Counter(name, help, tuple(sorted(labels.items())))
+            self._counters[key] = c
+        return c
+
+    def gauge(self, name: str, fn: Callable[[], float], help: str = "", **labels: str) -> Gauge:
+        key = self._key(name, labels.items())
+        g = Gauge(name, help, fn, tuple(sorted(labels.items())))
+        self._gauges[key] = g
+        return g
+
+    def histogram(self, name: str, help: str = "", **labels: str) -> Histogram:
+        key = self._key(name, labels.items())
+        h = self._hists.get(key)
+        if h is None:
+            h = Histogram(name, help, labels=tuple(sorted(labels.items())))
+            self._hists[key] = h
+        return h
+
+    # ------------------------------------------------------------ exposition
+    def render_prometheus(self) -> str:
+        lines: list[str] = []
+        seen_help: set[str] = set()
+
+        def _head(name: str, help: str, typ: str) -> None:
+            if name not in seen_help:
+                lines.append(f"# HELP {PREFIX}_{name} {help}")
+                lines.append(f"# TYPE {PREFIX}_{name} {typ}")
+                seen_help.add(name)
+
+        for c in self._counters.values():
+            _head(c.name, c.help, "counter")
+            lines.append(f"{PREFIX}_{c.name}{_labelstr(c.labels)} {c.value}")
+        for g in self._gauges.values():
+            _head(g.name, g.help, "gauge")
+            try:
+                v = g.fn()
+            except Exception:
+                v = float("nan")
+            lines.append(f"{PREFIX}_{g.name}{_labelstr(g.labels)} {v}")
+        for h in self._hists.values():
+            _head(h.name, h.help, "histogram")
+            for upper, cum in h.hist.cumulative_buckets():
+                lines.append(
+                    f"{PREFIX}_{h.name}_bucket{_labelstr(h.labels, f'le=\"{upper}\"')} {cum}"
+                )
+            lines.append(
+                f"{PREFIX}_{h.name}_bucket{_labelstr(h.labels, 'le=\"+Inf\"')} {h.hist.count}"
+            )
+            lines.append(f"{PREFIX}_{h.name}_sum{_labelstr(h.labels)} {h.hist.sum}")
+            lines.append(f"{PREFIX}_{h.name}_count{_labelstr(h.labels)} {h.hist.count}")
+        return "\n".join(lines) + "\n"
+
+
+# process-wide registry, like the seastar metrics singleton
+registry = MetricsRegistry()

@@ -1,0 +1,235 @@
+"""Admin API + config + metrics tests.
+
+Mirrors the reference's admin_server coverage (config get, log level
+override with expiry, SCRAM user CRUD, failure probes, /metrics) plus the
+config property table and histogram/prometheus exposition units.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import aiohttp
+import pytest
+
+from redpanda_tpu.admin import AdminServer
+from redpanda_tpu.config import Configuration, ValidationError
+from redpanda_tpu.kafka.server.broker import Broker, BrokerConfig
+from redpanda_tpu.kafka.server.protocol import KafkaServer
+from redpanda_tpu.metrics import MetricsRegistry
+from redpanda_tpu.storage.log_manager import StorageApi
+from redpanda_tpu.utils.hdr import HdrHist
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# ------------------------------------------------------------------ config
+def test_config_properties_validate_and_coerce():
+    cfg = Configuration()
+    assert cfg.kafka_api_port == 9092
+    cfg.set("kafka_api_port", "9095")  # coerced from string
+    assert cfg.kafka_api_port == 9095
+    with pytest.raises(ValidationError):
+        cfg.set("kafka_api_port", 99999)
+    cfg.set("enable_sasl", "true")
+    assert cfg.enable_sasl is True
+    # unknown keys preserved, secrets redacted
+    cfg.set("mystery_knob", 42)
+    cfg.set("cloud_storage_secret_key", "hunter2")
+    d = cfg.to_dict()
+    assert d["mystery_knob"] == 42
+    assert d["cloud_storage_secret_key"] == "[secret]"
+
+
+def test_config_yaml_roundtrip(tmp_path):
+    p = tmp_path / "redpanda.yaml"
+    p.write_text(
+        "redpanda:\n  node_id: 3\n  kafka_api_port: 9095\n  enable_sasl: true\n"
+    )
+    cfg = Configuration().load_yaml(str(p))
+    assert cfg.node_id == 3 and cfg.kafka_api_port == 9095 and cfg.enable_sasl
+
+
+# ------------------------------------------------------------------ hdr / metrics
+def test_hdr_histogram_percentiles():
+    h = HdrHist()
+    for v in range(1, 1001):
+        h.record(v)
+    assert h.count == 1000
+    assert h.mean() == pytest.approx(500.5)
+    # ≤ ~19% relative error for the log-bucketed layout
+    assert abs(h.percentile(50) - 500) / 500 < 0.25
+    assert abs(h.percentile(99) - 990) / 990 < 0.25
+    assert h.max == 1000
+    buckets = h.cumulative_buckets()
+    assert buckets[-1][1] == 1000
+    assert all(b1[1] <= b2[1] for b1, b2 in zip(buckets, buckets[1:]))
+
+
+def test_hdr_small_value_bounds():
+    # regression: bucket upper bounds must never undercut recorded values
+    for v in (1, 2, 3, 5, 7):
+        h = HdrHist()
+        h.record(v)
+        (upper, count), = h.cumulative_buckets()
+        assert count == 1
+        assert upper >= v
+        assert h.percentile(100) >= v
+
+
+def test_prometheus_exposition():
+    r = MetricsRegistry()
+    c = r.counter("requests_total", "Requests", api="produce")
+    c.inc(3)
+    r.gauge("partitions", lambda: 7, "Partitions")
+    h = r.histogram("latency_us", "Latency")
+    h.record(100)
+    h.record(200)
+    text = r.render_prometheus()
+    assert 'redpanda_tpu_requests_total{api="produce"} 3' in text
+    assert "redpanda_tpu_partitions 7" in text
+    assert "redpanda_tpu_latency_us_count 2" in text
+    assert "redpanda_tpu_latency_us_sum 300" in text
+    assert 'le="+Inf"} 2' in text
+
+
+# ------------------------------------------------------------------ admin api
+async def _start_stack(tmp_path):
+    storage = await StorageApi(str(tmp_path)).start()
+    cfg = BrokerConfig(data_dir=str(tmp_path))
+    broker = Broker(cfg, storage)
+    kserver = await KafkaServer(broker, "127.0.0.1", 0).start()
+    cfg.advertised_port = kserver.port
+    admin = await AdminServer(broker, port=0).start()
+    return storage, broker, kserver, admin
+
+
+async def _stop_stack(storage, kserver, admin):
+    await admin.stop()
+    await kserver.stop()
+    await storage.stop()
+
+
+def test_admin_endpoints(tmp_path):
+    async def main():
+        storage, broker, kserver, admin = await _start_stack(tmp_path)
+        base = f"http://127.0.0.1:{admin.port}"
+        async with aiohttp.ClientSession() as s:
+            # ready + config + brokers
+            assert (await (await s.get(f"{base}/v1/status/ready")).json())["status"] == "ready"
+            cfg = await (await s.get(f"{base}/v1/config")).json()
+            assert cfg["node_id"] == 0
+            brokers = await (await s.get(f"{base}/v1/brokers")).json()
+            assert len(brokers) == 1 and brokers[0]["membership_status"] == "active"
+            # partitions view reflects topic creation
+            from redpanda_tpu.cluster import TopicConfig
+
+            await broker.create_topic(TopicConfig("adm", 2))
+            parts = await (await s.get(f"{base}/v1/partitions")).json()
+            assert {(p["topic"], p["partition"]) for p in parts} == {("adm", 0), ("adm", 1)}
+            # users CRUD
+            r = await s.post(
+                f"{base}/v1/security/users",
+                json={"username": "op", "password": "pw", "algorithm": "SCRAM-SHA-256"},
+            )
+            assert r.status == 200
+            users = await (await s.get(f"{base}/v1/security/users")).json()
+            assert users == ["op"]
+            r = await s.delete(f"{base}/v1/security/users/op")
+            assert r.status == 200
+            assert await (await s.get(f"{base}/v1/security/users")).json() == []
+            # deleting a missing user is a clean 400, not a 500
+            r = await s.delete(f"{base}/v1/security/users/ghost")
+            assert r.status == 400
+            # metrics exposition includes the app gauges once registered
+            from redpanda_tpu.metrics import registry
+
+            registry.gauge("admin_test_gauge", lambda: 1.5, "test")
+            text = await (await s.get(f"{base}/metrics")).text()
+            assert "redpanda_tpu_admin_test_gauge 1.5" in text
+        await _stop_stack(storage, kserver, admin)
+
+    run(main())
+
+
+def test_admin_log_level_override_and_expiry(tmp_path):
+    async def main():
+        storage, broker, kserver, admin = await _start_stack(tmp_path)
+        base = f"http://127.0.0.1:{admin.port}"
+        lg = logging.getLogger("rptpu.test.leveler")
+        lg.setLevel(logging.INFO)
+        async with aiohttp.ClientSession() as s:
+            r = await s.put(
+                f"{base}/v1/config/log_level/rptpu.test.leveler?level=debug&expires=1"
+            )
+            assert r.status == 200
+            assert lg.level == logging.DEBUG
+            await asyncio.sleep(1.2)
+            assert lg.level == logging.INFO  # auto-restored
+            r = await s.put(f"{base}/v1/config/log_level/x?level=bogus")
+            assert r.status == 400
+        await _stop_stack(storage, kserver, admin)
+
+    run(main())
+
+
+def test_admin_failure_probes(tmp_path):
+    async def main():
+        from redpanda_tpu.finjector import honey_badger
+
+        storage, broker, kserver, admin = await _start_stack(tmp_path)
+        base = f"http://127.0.0.1:{admin.port}"
+        honey_badger.register_probe("storage", "append")
+        async with aiohttp.ClientSession() as s:
+            probes = await (await s.get(f"{base}/v1/failure-probes")).json()
+            assert "append" in probes["modules"]["storage"]
+            r = await s.put(f"{base}/v1/failure-probes/storage/append/exception")
+            assert r.status == 200
+            from redpanda_tpu.finjector import ProbeTriggered
+
+            with pytest.raises(ProbeTriggered):
+                honey_badger.inject_sync("storage", "append")
+            await s.delete(f"{base}/v1/failure-probes/storage/append")
+            honey_badger.inject_sync("storage", "append")  # disarmed: no raise
+            honey_badger.disable()
+        await _stop_stack(storage, kserver, admin)
+
+    run(main())
+
+
+def test_application_assembly_single_node(tmp_path):
+    """application.cc parity: config → full service graph → clean stop."""
+
+    async def main():
+        from redpanda_tpu.app import Application
+        from redpanda_tpu.kafka.client.client import KafkaClient
+
+        cfg = Configuration()
+        cfg.set("data_directory", str(tmp_path))
+        cfg.set("kafka_api_port", 0)
+        cfg.set("admin_api_port", 0)
+        app = await Application(cfg).start()
+        try:
+            cfg.set("advertised_kafka_api_port", app.kafka_server.port)
+            client = await KafkaClient([("127.0.0.1", app.kafka_server.port)]).connect()
+            await client.create_topic("apptest", partitions=1)
+            await client.produce("apptest", 0, [b"via-app"])
+            batches, hwm = await client.fetch("apptest", 0, 0)
+            assert hwm == 1
+            async with aiohttp.ClientSession() as s:
+                parts = await (
+                    await s.get(f"http://127.0.0.1:{app.admin.port}/v1/partitions")
+                ).json()
+                assert any(p["topic"] == "apptest" for p in parts)
+                text = await (
+                    await s.get(f"http://127.0.0.1:{app.admin.port}/metrics")
+                ).text()
+                assert "redpanda_tpu_partitions_total" in text
+            await client.close()
+        finally:
+            await app.stop()
+
+    run(main())

@@ -231,6 +231,33 @@ def test_multi_batch_request_and_partial_duplicate(tmp_path):
     run(main())
 
 
+def test_new_producer_in_request_duplicate(tmp_path):
+    """A brand-new pid's first request carrying a retried copy of its own
+    batch must still dedup (the sim map applies even with no stored state)."""
+
+    async def main():
+        from redpanda_tpu.models.record import Record, RecordBatch
+
+        broker, server = await _start_broker(tmp_path)
+        client = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        await client.create_topic("nd", partitions=1)
+        prod = await TransactionalProducer(client).init()
+
+        def batch(vals, seq):
+            return RecordBatch.build(
+                [Record(value=v, offset_delta=i) for i, v in enumerate(vals)],
+                producer_id=prod.producer_id, producer_epoch=prod.epoch,
+                base_sequence=seq,
+            )
+
+        await client.produce_batches("nd", 0, [batch([b"a", b"b"], 0), batch([b"a", b"b"], 0)])
+        batches, hwm = await client.fetch("nd", 0, 0)
+        assert _values(batches) == [b"a", b"b"] and hwm == 2
+        await _stop(server, broker, client)
+
+    run(main())
+
+
 def test_tx_timeout_auto_aborts(tmp_path):
     async def main():
         broker, server = await _start_broker(tmp_path)
