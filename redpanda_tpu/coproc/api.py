@@ -1,0 +1,168 @@
+"""Coproc API: event listener + script dispatcher + pacemaker + engine.
+
+Parity with coproc/api.h (api.cc:19-49 owns pacemaker + event listener),
+wasm/event_listener (event_listener.cc:139-156 polls the internal topic),
+and script_dispatcher.cc:166 enable_coprocessors (register with the engine
+AND the pacemaker). The reference's listener is an in-proc kafka::client
+over loopback; running inside the broker process, this listener reads the
+internal topic's partition directly — same log, no socket hop.
+
+Deploy surface (used by the CLI's `wasm deploy` and tests): produce a
+validated deploy/remove event to ``coprocessor_internal_topic``; the
+listener reconciles events in log order on every node that hosts it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from redpanda_tpu.coproc import wasm_event
+from redpanda_tpu.coproc.engine import EnableResponseCode, TpuEngine
+from redpanda_tpu.coproc.pacemaker import Pacemaker
+from redpanda_tpu.models.fundamental import COPROC_INTERNAL_TOPIC, NTP
+from redpanda_tpu.cluster.topic_table import TopicConfig
+
+logger = logging.getLogger("rptpu.coproc.api")
+
+
+class CoprocApi:
+    def __init__(self, broker, config=None) -> None:
+        self.broker = broker
+
+        def _knob(name, default):
+            return getattr(config, name, default) if config is not None else default
+
+        max_batch = _knob("coproc_max_batch_size", 32 * 1024)
+        inflight_bytes = _knob("coproc_max_inflight_bytes", 10 * 1024 * 1024)
+        flush_ms = _knob("coproc_offset_flush_interval_ms", 300_000)
+        self.engine = TpuEngine()
+        self.pacemaker = Pacemaker(
+            broker, self.engine,
+            max_batch_size=max_batch,
+            # the byte budget bounds concurrent reads: each read holds at
+            # most max_batch_size bytes (configuration.h:57-61 semantics)
+            max_inflight_reads=max(1, inflight_bytes // max(max_batch, 1)),
+            offset_flush_interval_s=flush_ms / 1000.0,
+        )
+        self._listener_task: asyncio.Task | None = None
+        self._listen_offset = 0
+        self._active: dict[str, wasm_event.WasmEvent] = {}
+        self.poll_interval_s = 0.05
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> "CoprocApi":
+        if not self.broker.topic_table.contains(COPROC_INTERNAL_TOPIC):
+            try:
+                await self.broker.create_topic(TopicConfig(COPROC_INTERNAL_TOPIC, 1, 1))
+            except ValueError:
+                pass
+        await self.pacemaker.start()
+        self._listener_task = asyncio.create_task(self._listen_loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._listener_task is not None:
+            self._listener_task.cancel()
+            try:
+                await self._listener_task
+            except asyncio.CancelledError:
+                pass
+            self._listener_task = None
+        await self.pacemaker.stop()
+
+    # ------------------------------------------------------------ deploy surface
+    async def deploy(self, name: str, spec_json: str, input_topics: list[str]) -> None:
+        from redpanda_tpu.models.fundamental import MaterializedNTP
+
+        for t in input_topics:
+            if not self.broker.topic_table.contains(t):
+                raise ValueError(f"input topic does not exist: {t}")
+            # one canonical predicate: internal topics and materialized
+            # topics (MaterializedNTP convention) cannot be inputs
+            if self.broker.is_internal_topic(t) or MaterializedNTP.parse(NTP("kafka", t, 0)):
+                raise ValueError(f"invalid input topic: {t}")
+        await self._produce_event(
+            wasm_event.make_deploy_record(name, spec_json, input_topics)
+        )
+
+    async def remove(self, name: str) -> None:
+        await self._produce_event(wasm_event.make_remove_record(name))
+
+    async def _produce_event(self, rec) -> None:
+        p = self.broker.get_partition(COPROC_INTERNAL_TOPIC, 0)
+        if p is None:
+            raise RuntimeError("coproc internal topic missing")
+        await p.replicate([wasm_event.deploy_batch([rec])], 0)
+
+    # ------------------------------------------------------------ listener
+    async def _listen_loop(self) -> None:
+        """do_ingest (event_listener.cc:139): poll, validate, reconcile,
+        dispatch enable/disable to engine + pacemaker."""
+        while True:
+            try:
+                await self._ingest_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("coproc event ingest failed")
+            await asyncio.sleep(self.poll_interval_s)
+
+    async def _ingest_once(self) -> None:
+        p = self.broker.get_partition(COPROC_INTERNAL_TOPIC, 0)
+        if p is None:
+            return
+        hwm = p.high_watermark
+        if self._listen_offset >= hwm:
+            return
+        events = []
+        next_offset = self._listen_offset
+        while next_offset < hwm:
+            batches = await p.make_reader(next_offset, 1 << 20, max_offset=hwm - 1)
+            if not batches:
+                break
+            for b in batches:
+                for rec in b.records():
+                    ev = wasm_event.parse_event(rec)
+                    if ev is not None:
+                        events.append(ev)
+                    else:
+                        logger.warning("ignoring malformed coproc event")
+                next_offset = b.last_offset + 1
+        # dispatch BEFORE advancing the cursor: a failure here must retry
+        # the chunk on the next poll, not silently drop the deploys
+        for name, ev in wasm_event.reconcile(events).items():
+            if ev.action == wasm_event.DEPLOY:
+                await self._enable(ev)
+            else:
+                await self._disable(name)
+        self._listen_offset = next_offset
+
+    async def _enable(self, ev: wasm_event.WasmEvent) -> None:
+        """script_dispatcher::enable_coprocessors: engine first, then the
+        pacemaker source (script_dispatcher.cc:166)."""
+        if ev.name in self._active and self._active[ev.name].checksum == ev.checksum:
+            return  # unchanged redeploy
+        if ev.name in self._active:
+            await self._disable(ev.name)
+        codes = self.engine.enable_coprocessors(
+            [(ev.script_id, ev.spec_json, ev.input_topics)]
+        )
+        if codes[0] != EnableResponseCode.success:
+            logger.error("enable %s failed: %s", ev.name, codes[0].name)
+            return
+        await self.pacemaker.add_source(ev.name, ev.script_id, ev.input_topics)
+        self._active[ev.name] = ev
+        logger.info("coprocessor %s enabled on %s", ev.name, list(ev.input_topics))
+
+    async def _disable(self, name: str) -> None:
+        ev = self._active.pop(name, None)
+        if ev is None:
+            return
+        await self.pacemaker.remove_script(name)
+        self.engine.disable_coprocessors([ev.script_id])
+        logger.info("coprocessor %s disabled", name)
+
+    # ------------------------------------------------------------ views
+    def active_scripts(self) -> list[str]:
+        return sorted(self._active)

@@ -1,0 +1,275 @@
+"""Coproc pacemaker + script contexts: the steady-state transform loop.
+
+Parity with coproc/pacemaker.h:41-145 and script_context.cc:47-135:
+one ``ScriptContext`` fiber per deployed script runs
+  read_from_inputs (per-ntp, from last_acked+1 up to the LSO, bounded by
+  coproc_max_batch_size and the shared inflight-bytes semaphore,
+  script_context_frontend.cc:80-117)
+  → engine.process_batch (the TPU engine replaces the Node.js RPC hop)
+  → write_materialized (CRC-checked, recompressed batches appended
+  DIRECTLY to the materialized storage log, bypassing raft —
+  script_context_backend.cc:40-68)
+  → advance last_acked.
+Offsets are snapshotted per flush interval into the kvstore's coproc
+keyspace and recovered on startup (offset_storage_utils.cc:36-104).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from redpanda_tpu.coproc.engine import (
+    ProcessBatchItem,
+    ProcessBatchRequest,
+    TpuEngine,
+)
+from redpanda_tpu.models.fundamental import NTP, MaterializedNTP
+from redpanda_tpu.storage.kvstore import KeySpace
+
+logger = logging.getLogger("rptpu.coproc.pacemaker")
+
+
+class _StopScript(Exception):
+    """Raised inside a script's own fiber to end it (deregistration from
+    within tick — the fiber cannot await its own cancellation)."""
+
+
+class ScriptContext:
+    def __init__(
+        self,
+        pacemaker: "Pacemaker",
+        script_id: int,
+        name: str,
+        input_topics: tuple[str, ...],
+    ) -> None:
+        self.pacemaker = pacemaker
+        self.script_id = script_id
+        self.name = name
+        self.input_topics = input_topics
+        # per input ntp: offsets {last_read, last_acked}
+        # (ntp_context.h:54-60 offset_tracker)
+        self.offsets: dict[NTP, int] = {}
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        """do_execute (script_context.cc:66): run ticks until cancelled;
+        jittered idle sleep when no input advanced."""
+        pm = self.pacemaker
+        while True:
+            try:
+                moved = await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except _StopScript:
+                return
+            except Exception:
+                logger.exception("script %s tick failed", self.name)
+                moved = False
+            if not moved:
+                await asyncio.sleep(pm.idle_sleep_s)
+
+    async def tick(self) -> bool:
+        """One read → transform → write round; True if any offset moved.
+
+        Offsets advance ONLY after the materialized write lands
+        (script_context.cc's read → process → write → last_acked order) —
+        advancing at read time would drop records on any write failure.
+        """
+        pm = self.pacemaker
+        items = []
+        read_high: dict[NTP, int] = {}
+        for ntp in self._input_ntps():
+            batches = await self._read_ntp(ntp)
+            if batches:
+                items.append(ProcessBatchItem(self.script_id, ntp, batches))
+                read_high[ntp] = batches[-1].last_offset
+        if not items:
+            return False
+        reply = pm.engine.process_batch(ProcessBatchRequest(items))
+        if self.script_id in reply.deregistered:
+            logger.warning("script %s deregistered by engine policy", self.name)
+            pm.detach_script(self.name)
+            self._task = None
+            raise _StopScript()
+        moved = False
+        for item in reply.items:
+            if await self._write_materialized(item.source, item.batches):
+                self.offsets[item.source] = read_high[item.source]
+                moved = True
+        return moved
+
+    def _input_ntps(self) -> list[NTP]:
+        out = []
+        for topic in self.input_topics:
+            md = self.pacemaker.broker.topic_table.get(topic)
+            if md is None:
+                continue
+            out.extend(pa.ntp for pa in md.assignments.values())
+        return out
+
+    async def _read_ntp(self, ntp: NTP) -> list:
+        """read_ntp (script_context_frontend.cc:80-98): from last_acked+1 up
+        to the LSO, bounded by max batch size + the read semaphore."""
+        pm = self.pacemaker
+        p = pm.broker.partition_manager.get(ntp)
+        if p is None or not p.is_leader():
+            return []
+        start = self.offsets.get(ntp, p.start_offset - 1) + 1
+        lso = p.last_stable_offset  # exclusive
+        if start >= lso:
+            return []
+        async with pm.read_sem:
+            return await p.make_reader(start, pm.max_batch_size, max_offset=lso - 1)
+
+    async def _write_materialized(self, source: NTP, batches: list) -> bool:
+        """do_write_materialized_partition (script_context_backend.cc:40-68):
+        CRC check + append directly to the materialized log, no raft.
+        Returns True when the source's offset may advance."""
+        if not batches:
+            return True  # everything filtered out: the read is still acked
+        pm = self.pacemaker
+        mntp = MaterializedNTP(source, self.name).ntp
+        partition = await pm.ensure_materialized(source, mntp)
+        if partition is None:
+            return False  # create raced/failed: retry this read next tick
+        good = []
+        for b in batches:
+            if b.verify_kafka_crc():
+                good.append(b)
+            else:
+                logger.error("dropping corrupt transformed batch for %s", mntp)
+        if good:
+            await partition.replicate(good, 2)  # no_ack: direct log write
+        return True
+
+
+class Pacemaker:
+    def __init__(
+        self,
+        broker,
+        engine: TpuEngine,
+        *,
+        max_batch_size: int = 32 * 1024,
+        max_inflight_reads: int = 8,
+        offset_flush_interval_s: float = 5.0,
+        idle_sleep_s: float = 0.05,
+    ) -> None:
+        self.broker = broker
+        self.engine = engine
+        self.max_batch_size = max_batch_size
+        self.read_sem = asyncio.Semaphore(max_inflight_reads)
+        self.offset_flush_interval_s = offset_flush_interval_s
+        self.idle_sleep_s = idle_sleep_s
+        self._scripts: dict[str, ScriptContext] = {}
+        self._flush_task: asyncio.Task | None = None
+        self._materialized_locks: dict[NTP, asyncio.Lock] = {}
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> "Pacemaker":
+        self._recover_offsets()
+        self._flush_task = asyncio.create_task(self._flush_loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            try:
+                await self._flush_task
+            except asyncio.CancelledError:
+                pass
+            self._flush_task = None
+        for ctx in list(self._scripts.values()):
+            await ctx.stop()
+        self._save_offsets()
+        self._scripts.clear()
+
+    # ------------------------------------------------------------ scripts
+    async def add_source(self, name: str, script_id: int, input_topics: tuple[str, ...]) -> None:
+        """pacemaker.h:75 add_source: one fiber per script."""
+        if name in self._scripts:
+            return
+        ctx = ScriptContext(self, script_id, name, input_topics)
+        for key, off in self._saved_offsets().get(name, {}).items():
+            ns, topic, part = key.rsplit("/", 2)
+            ctx.offsets[NTP(ns, topic, int(part))] = off
+        self._scripts[name] = ctx
+        ctx.start()
+
+    async def remove_script(self, name: str) -> None:
+        ctx = self._scripts.pop(name, None)
+        if ctx is not None:
+            await ctx.stop()
+
+    def detach_script(self, name: str) -> None:
+        """Unregister without awaiting the fiber (used from INSIDE the
+        fiber, which then exits via _StopScript)."""
+        self._scripts.pop(name, None)
+
+    def scripts(self) -> dict[str, ScriptContext]:
+        return dict(self._scripts)
+
+    # ------------------------------------------------------------ materialized logs
+    async def ensure_materialized(self, source: NTP, mntp: NTP):
+        """Create the materialized topic/partition on demand under a
+        per-ntp mutex (script_context_backend.cc:70-78)."""
+        lock = self._materialized_locks.setdefault(mntp, asyncio.Lock())
+        async with lock:
+            p = self.broker.partition_manager.get(mntp)
+            if p is not None:
+                return p
+            if not self.broker.topic_table.contains(mntp.topic):
+                from redpanda_tpu.cluster.topic_table import TopicConfig
+
+                src_md = self.broker.topic_table.get(source.topic)
+                n_parts = src_md.config.partition_count if src_md else 1
+                try:
+                    await self.broker.create_topic(
+                        TopicConfig(mntp.topic, n_parts, 1, ns=mntp.ns)
+                    )
+                except ValueError:
+                    pass
+            return self.broker.partition_manager.get(mntp)
+
+    # ------------------------------------------------------------ offsets
+    def _kvs(self):
+        return self.broker.storage.kvs
+
+    def _saved_offsets(self) -> dict[str, dict[str, int]]:
+        raw = self._kvs().get(KeySpace.coproc, b"offsets")
+        return json.loads(raw.decode()) if raw else {}
+
+    def _save_offsets(self) -> None:
+        data = {
+            name: {
+                f"{ntp.ns}/{ntp.topic}/{ntp.partition}": off
+                for ntp, off in ctx.offsets.items()
+            }
+            for name, ctx in self._scripts.items()
+        }
+        self._kvs().put(KeySpace.coproc, b"offsets", json.dumps(data).encode())
+
+    def _recover_offsets(self) -> None:
+        # contexts pick their saved offsets up in add_source
+        pass
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.offset_flush_interval_s)
+            try:
+                self._save_offsets()
+            except Exception:
+                logger.exception("coproc offset flush failed")
