@@ -70,13 +70,11 @@ class AdminServer:
             web.get("/metrics", self._metrics),
             web.get("/v1/status/ready", self._ready),
         ])
-        self._runner = web.AppRunner(app, access_log=None)
-        await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
-        await site.start()
-        if self.port == 0:
-            self.port = self._runner.addresses[0][1]
-        logger.info("admin api listening on %s:%d", self.host, self.port)
+        from redpanda_tpu.utils.http_server import start_site
+
+        self._runner, self.port = await start_site(
+            app, self.host, self.port, logger, "admin api"
+        )
         return self
 
     async def stop(self) -> None:

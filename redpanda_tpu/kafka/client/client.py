@@ -217,8 +217,13 @@ class KafkaClient:
             await self._bootstrap_conn.close()
 
     # ------------------------------------------------------------ metadata
-    async def refresh_metadata(self, topics: list[str] | None = None) -> dict:
-        body = {"topics": None if topics is None else [{"name": t} for t in topics]}
+    async def refresh_metadata(
+        self, topics: list[str] | None = None, *, auto_create: bool = True
+    ) -> dict:
+        body = {
+            "topics": None if topics is None else [{"name": t} for t in topics],
+            "allow_auto_topic_creation": auto_create,
+        }
         md = await self._bootstrap_conn.request(m.METADATA, body)
         for b in md["brokers"]:
             self._brokers[b["node_id"]] = (b["host"], b["port"])

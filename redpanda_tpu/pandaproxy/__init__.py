@@ -1,0 +1,12 @@
+"""HTTP APIs: REST proxy + schema registry (src/v/pandaproxy parity).
+
+Both are pure Kafka clients of the local broker (the reference's proxy is
+an in-proc kafka::client user — pandaproxy/rest, schema_registry share
+``pandaproxy::server``); here each is an aiohttp app over the embedded
+``KafkaClient``.
+"""
+
+from redpanda_tpu.pandaproxy.rest import RestProxy
+from redpanda_tpu.pandaproxy.schema_registry import SchemaRegistry
+
+__all__ = ["RestProxy", "SchemaRegistry"]

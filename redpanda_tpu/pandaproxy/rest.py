@@ -1,0 +1,269 @@
+"""Kafka REST proxy.
+
+Parity with pandaproxy/rest (api/api-doc/rest.json:1-468):
+- GET  /brokers
+- GET  /topics                      · GET /topics/{topic}
+- POST /topics/{topic}              (produce; records may carry partition)
+- GET  /topics/{topic}/partitions
+- POST /consumers/{group}                          (create instance)
+- DELETE /consumers/{group}/instances/{name}
+- POST /consumers/{group}/instances/{name}/subscription
+- GET  /consumers/{group}/instances/{name}/records
+- POST /consumers/{group}/instances/{name}/offsets
+Payload format: the Kafka REST v2 JSON embedded format (base64 for binary
+keys/values, like the reference's json/requests parsing).
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+import uuid
+
+from aiohttp import web
+
+from redpanda_tpu.kafka.client.client import KafkaClient
+from redpanda_tpu.kafka.client.consumer import GroupConsumer
+from redpanda_tpu.kafka.protocol.errors import KafkaError
+
+logger = logging.getLogger("rptpu.pandaproxy")
+
+JSON_V2 = "application/vnd.kafka.json.v2+json"
+BINARY_V2 = "application/vnd.kafka.binary.v2+json"
+
+
+class EmbeddedFormatError(ValueError):
+    pass
+
+
+def _decode_value(v, json_format: bool) -> bytes | None:
+    """Embedded-format value. The CONTENT TYPE picks the codec (like the
+    reference's vnd.kafka.{json,binary}.v2 handling): json format stores the
+    JSON literal; binary format requires base64 strings — guessing from the
+    value shape would corrupt strings that happen to parse as base64."""
+    import json
+
+    if v is None:
+        return None
+    if json_format:
+        return json.dumps(v, separators=(",", ":")).encode()
+    if not isinstance(v, str):
+        raise EmbeddedFormatError("binary format requires base64 string values")
+    try:
+        return base64.b64decode(v, validate=True)
+    except Exception as e:
+        raise EmbeddedFormatError(f"invalid base64: {e}") from e
+
+
+def _encode_value(v: bytes | None):
+    return None if v is None else base64.b64encode(v).decode()
+
+
+class RestProxy:
+    def __init__(
+        self,
+        bootstrap: list[tuple[str, int]],
+        host: str = "127.0.0.1",
+        port: int = 8082,
+        sasl: tuple[str, str] | None = None,
+    ) -> None:
+        self.bootstrap = bootstrap
+        self.host = host
+        self.port = port
+        self.sasl = sasl
+        self.client: KafkaClient | None = None
+        self._consumers: dict[tuple[str, str], GroupConsumer] = {}
+        self._runner: web.AppRunner | None = None
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> "RestProxy":
+        self.client = await KafkaClient(self.bootstrap, sasl=self.sasl).connect()
+        app = web.Application()
+        app.add_routes([
+            web.get("/brokers", self._brokers),
+            web.get("/topics", self._topics),
+            web.get("/topics/{topic}", self._topic),
+            web.post("/topics/{topic}", self._produce),
+            web.get("/topics/{topic}/partitions", self._partitions),
+            web.post("/consumers/{group}", self._create_consumer),
+            web.delete("/consumers/{group}/instances/{name}", self._delete_consumer),
+            web.post("/consumers/{group}/instances/{name}/subscription", self._subscribe),
+            web.get("/consumers/{group}/instances/{name}/records", self._records),
+            web.post("/consumers/{group}/instances/{name}/offsets", self._commit),
+        ])
+        from redpanda_tpu.utils.http_server import start_site
+
+        self._runner, self.port = await start_site(
+            app, self.host, self.port, logger, "rest proxy"
+        )
+        return self
+
+    async def stop(self) -> None:
+        for consumer in self._consumers.values():
+            try:
+                await consumer.leave()
+            except Exception:
+                pass
+        self._consumers.clear()
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        if self.client is not None:
+            await self.client.close()
+            self.client = None
+
+    # ------------------------------------------------------------ metadata
+    async def _brokers(self, req: web.Request) -> web.Response:
+        md = await self.client.refresh_metadata()
+        return web.json_response({"brokers": [b["node_id"] for b in md["brokers"]]})
+
+    async def _topics(self, req: web.Request) -> web.Response:
+        md = await self.client.refresh_metadata()
+        return web.json_response(
+            sorted(t["name"] for t in md["topics"] if t["error_code"] == 0)
+        )
+
+    async def _topic_payload(self, name: str) -> dict | None:
+        # pure lookup: must not auto-create (the reference proxy's metadata
+        # queries pass allow_auto_topic_creation=false)
+        md = await self.client.refresh_metadata([name], auto_create=False)
+        t = next((t for t in md["topics"] if t["name"] == name), None)
+        if t is None or t["error_code"] != 0:
+            return None
+        return {
+            "name": name,
+            "partitions": [
+                {
+                    "partition": p["partition_index"],
+                    "leader": p["leader_id"],
+                    "replicas": [
+                        {"broker": r, "leader": r == p["leader_id"], "in_sync": True}
+                        for r in p["replica_nodes"]
+                    ],
+                }
+                for p in t.get("partitions") or []
+            ],
+        }
+
+    async def _topic(self, req: web.Request) -> web.Response:
+        payload = await self._topic_payload(req.match_info["topic"])
+        if payload is None:
+            return web.json_response(
+                {"error_code": 40401, "message": "topic not found"}, status=404
+            )
+        return web.json_response(payload)
+
+    async def _partitions(self, req: web.Request) -> web.Response:
+        payload = await self._topic_payload(req.match_info["topic"])
+        if payload is None:
+            return web.json_response(
+                {"error_code": 40401, "message": "topic not found"}, status=404
+            )
+        return web.json_response(payload["partitions"])
+
+    # ------------------------------------------------------------ produce
+    async def _produce(self, req: web.Request) -> web.Response:
+        topic = req.match_info["topic"]
+        json_format = "json.v2" in (req.content_type or "")
+        body = await req.json()
+        records = body.get("records", [])
+        # one produce per partition, not per record (produce_batcher shape)
+        by_partition: dict[int, list[tuple[int, tuple]]] = {}
+        try:
+            for i, rec in enumerate(records):
+                partition = rec.get("partition", 0)
+                kv = (
+                    _decode_value(rec.get("key"), json_format),
+                    _decode_value(rec.get("value"), json_format),
+                )
+                by_partition.setdefault(partition, []).append((i, kv))
+        except EmbeddedFormatError as e:
+            return web.json_response(
+                {"error_code": 42201, "message": str(e)}, status=422
+            )
+        results: dict[int, dict] = {}
+        for partition, entries in by_partition.items():
+            try:
+                base = await self.client.produce(
+                    topic, partition, [kv for _, kv in entries]
+                )
+                for j, (i, _) in enumerate(entries):
+                    results[i] = {"partition": partition, "offset": base + j, "error_code": None}
+            except KafkaError as e:
+                for i, _ in entries:
+                    results[i] = {
+                        "partition": partition, "offset": -1,
+                        "error_code": int(e.code), "error": str(e),
+                    }
+        return web.json_response({"offsets": [results[i] for i in range(len(records))]})
+
+    # ------------------------------------------------------------ consumers
+    def _instance(self, req: web.Request) -> GroupConsumer | None:
+        return self._consumers.get(
+            (req.match_info["group"], req.match_info["name"])
+        )
+
+    async def _create_consumer(self, req: web.Request) -> web.Response:
+        group = req.match_info["group"]
+        body = await req.json() if req.can_read_body else {}
+        name = body.get("name") or f"rest-{uuid.uuid4().hex[:12]}"
+        if (group, name) in self._consumers:
+            return web.json_response(
+                {"error_code": 40902, "message": "consumer instance exists"}, status=409
+            )
+        consumer = GroupConsumer(self.client, group, topics=[])
+        self._consumers[(group, name)] = consumer
+        return web.json_response({
+            "instance_id": name,
+            "base_uri": f"http://{self.host}:{self.port}/consumers/{group}/instances/{name}",
+        })
+
+    async def _delete_consumer(self, req: web.Request) -> web.Response:
+        consumer = self._consumers.pop(
+            (req.match_info["group"], req.match_info["name"]), None
+        )
+        if consumer is None:
+            return web.json_response(
+                {"error_code": 40403, "message": "unknown instance"}, status=404
+            )
+        await consumer.leave()
+        return web.Response(status=204)
+
+    async def _subscribe(self, req: web.Request) -> web.Response:
+        consumer = self._instance(req)
+        if consumer is None:
+            return web.json_response(
+                {"error_code": 40403, "message": "unknown instance"}, status=404
+            )
+        body = await req.json()
+        consumer.topics = list(body.get("topics", []))
+        await consumer.join()
+        return web.Response(status=204)
+
+    async def _records(self, req: web.Request) -> web.Response:
+        consumer = self._instance(req)
+        if consumer is None:
+            return web.json_response(
+                {"error_code": 40403, "message": "unknown instance"}, status=404
+            )
+        got = await consumer.poll()
+        out = []
+        for (topic, partition), recs in sorted(got.items()):
+            for off, r in recs:
+                out.append({
+                    "topic": topic,
+                    "partition": partition,
+                    "offset": off,
+                    "key": _encode_value(r.key),
+                    "value": _encode_value(r.value),
+                })
+        return web.json_response(out, content_type="application/json")
+
+    async def _commit(self, req: web.Request) -> web.Response:
+        consumer = self._instance(req)
+        if consumer is None:
+            return web.json_response(
+                {"error_code": 40403, "message": "unknown instance"}, status=404
+            )
+        await consumer.commit()
+        return web.Response(status=204)

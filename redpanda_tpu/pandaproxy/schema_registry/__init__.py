@@ -1,0 +1,5 @@
+"""Schema registry (pandaproxy/schema_registry parity)."""
+
+from redpanda_tpu.pandaproxy.schema_registry.api import SchemaRegistry
+
+__all__ = ["SchemaRegistry"]

@@ -1,0 +1,268 @@
+"""Schema registry HTTP API.
+
+Parity with pandaproxy/schema_registry (api-doc/schema_registry.json):
+- POST /subjects/{subject}/versions          (register)
+- POST /subjects/{subject}                   (lookup by schema)
+- GET  /subjects                             · DELETE /subjects/{subject}
+- GET  /subjects/{subject}/versions
+- GET  /subjects/{subject}/versions/{v}      (v = number | "latest")
+- GET  /schemas/ids/{id}
+- GET/PUT /config · GET/PUT /config/{subject}
+- POST /compatibility/subjects/{subject}/versions/{v}
+Mutations append to the ``_schemas`` topic through a sequenced writer and
+the store replays the log (seq_writer.h pattern) — restart-safe and
+cluster-convergent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from aiohttp import web
+
+from redpanda_tpu.kafka.client.client import KafkaClient
+from redpanda_tpu.pandaproxy.schema_registry import avro_compat
+from redpanda_tpu.pandaproxy.schema_registry.store import (
+    SCHEMAS_TOPIC,
+    IncompatibleSchema,
+    SchemaStore,
+)
+
+logger = logging.getLogger("rptpu.schema_registry")
+
+CT = "application/vnd.schemaregistry.v1+json"
+
+
+class SchemaRegistry:
+    def __init__(
+        self,
+        bootstrap: list[tuple[str, int]],
+        host: str = "127.0.0.1",
+        port: int = 8081,
+        sasl: tuple[str, str] | None = None,
+    ) -> None:
+        self.bootstrap = bootstrap
+        self.host = host
+        self.port = port
+        self.sasl = sasl
+        self.client: KafkaClient | None = None
+        self.store = SchemaStore()
+        self._runner: web.AppRunner | None = None
+        self._replayed = 0
+        self._write_lock = asyncio.Lock()
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> "SchemaRegistry":
+        self.client = await KafkaClient(self.bootstrap, sasl=self.sasl).connect()
+        try:
+            await self.client.create_topic(SCHEMAS_TOPIC, partitions=1, configs={"cleanup.policy": "compact"})
+        except Exception:
+            pass  # exists
+        await self._replay()
+        app = web.Application()
+        app.add_routes([
+            web.get("/subjects", self._subjects),
+            web.post("/subjects/{subject}", self._lookup),
+            web.delete("/subjects/{subject}", self._delete_subject),
+            web.get("/subjects/{subject}/versions", self._versions),
+            web.post("/subjects/{subject}/versions", self._register),
+            web.get("/subjects/{subject}/versions/{version}", self._get_version),
+            web.get("/schemas/ids/{id}", self._by_id),
+            web.get("/config", self._get_config),
+            web.put("/config", self._put_config),
+            web.get("/config/{subject}", self._get_config),
+            web.put("/config/{subject}", self._put_config),
+            web.post(
+                "/compatibility/subjects/{subject}/versions/{version}", self._check_compat
+            ),
+        ])
+        from redpanda_tpu.utils.http_server import start_site
+
+        self._runner, self.port = await start_site(
+            app, self.host, self.port, logger, "schema registry"
+        )
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        if self.client is not None:
+            await self.client.close()
+            self.client = None
+
+    # ------------------------------------------------------------ log io
+    async def _replay(self) -> None:
+        offset = self._replayed
+        while True:
+            batches, hwm = await self.client.fetch(
+                SCHEMAS_TOPIC, 0, offset, max_wait_ms=0
+            )
+            if not batches:
+                break
+            for b in batches:
+                for r in b.records():
+                    if r.key is not None:
+                        self.store.apply(r.key, r.value)
+                offset = b.header.base_offset + b.header.record_count
+        self._replayed = offset
+
+    async def _append(self, records: list[tuple[bytes, bytes | None]]) -> None:
+        if records:
+            await self.client.produce(SCHEMAS_TOPIC, 0, records)
+        await self._replay()
+
+    # ------------------------------------------------------------ handlers
+    def _err(self, status: int, code: int, message: str) -> web.Response:
+        return web.json_response(
+            {"error_code": code, "message": message}, status=status, content_type=CT
+        )
+
+    async def _subjects(self, req: web.Request) -> web.Response:
+        await self._replay()
+        subs = sorted(s for s in self.store.subjects if self.store.live_versions(s))
+        return web.json_response(subs, content_type=CT)
+
+    @staticmethod
+    def _schema_text(body: dict) -> str | None:
+        """Accept both a JSON-string schema and an inline JSON object."""
+        schema = body.get("schema")
+        if isinstance(schema, (dict, list)):
+            import json
+
+            return json.dumps(schema)
+        return schema or None
+
+    async def _register(self, req: web.Request) -> web.Response:
+        subject = req.match_info["subject"]
+        body = await req.json()
+        schema = self._schema_text(body)
+        if not schema:
+            return self._err(422, 42201, "schema field required")
+        if body.get("schemaType", "AVRO") != "AVRO":
+            return self._err(422, 42204, "only AVRO schemas supported")
+        # seq_writer semantics: append, re-replay, and verify OUR schema owns
+        # the version we claimed — a concurrent registry instance may have
+        # won the offset race, in which case we retry against the new state.
+        for _ in range(5):
+            async with self._write_lock:
+                await self._replay()
+                try:
+                    records, schema_id = self.store.register_records(subject, schema)
+                except IncompatibleSchema as e:
+                    return self._err(409, 409, str(e))
+                except avro_compat.SchemaParseError as e:
+                    return self._err(422, 42201, f"invalid avro schema: {e}")
+                await self._append(records)
+                winner = self.store.find_schema(subject, schema)
+                if winner is not None:
+                    return web.json_response({"id": winner.schema_id}, content_type=CT)
+            await asyncio.sleep(0.01)
+        return self._err(500, 50001, "write conflict; retry")
+
+    async def _lookup(self, req: web.Request) -> web.Response:
+        subject = req.match_info["subject"]
+        body = await req.json()
+        await self._replay()
+        v = self.store.find_schema(subject, self._schema_text(body) or "")
+        if v is None:
+            return self._err(404, 40403, "schema not found")
+        return web.json_response(
+            {"subject": subject, "version": v.version, "id": v.schema_id, "schema": v.schema},
+            content_type=CT,
+        )
+
+    async def _delete_subject(self, req: web.Request) -> web.Response:
+        subject = req.match_info["subject"]
+        async with self._write_lock:
+            await self._replay()
+            versions = [v.version for v in self.store.live_versions(subject)]
+            if not versions:
+                return self._err(404, 40401, f"subject not found: {subject}")
+            await self._append(self.store.delete_subject_records(subject))
+        return web.json_response(versions, content_type=CT)
+
+    async def _versions(self, req: web.Request) -> web.Response:
+        subject = req.match_info["subject"]
+        await self._replay()
+        live = self.store.live_versions(subject)
+        if not live:
+            return self._err(404, 40401, f"subject not found: {subject}")
+        return web.json_response([v.version for v in live], content_type=CT)
+
+    def _resolve_version(self, subject: str, version: str):
+        live = self.store.live_versions(subject)
+        if not live:
+            return None
+        if version == "latest":
+            return live[-1]
+        try:
+            n = int(version)
+        except ValueError:
+            return None
+        return next((v for v in live if v.version == n), None)
+
+    async def _get_version(self, req: web.Request) -> web.Response:
+        await self._replay()
+        v = self._resolve_version(req.match_info["subject"], req.match_info["version"])
+        if v is None:
+            return self._err(404, 40402, "version not found")
+        return web.json_response(
+            {"subject": v.subject, "version": v.version, "id": v.schema_id, "schema": v.schema},
+            content_type=CT,
+        )
+
+    async def _by_id(self, req: web.Request) -> web.Response:
+        await self._replay()
+        try:
+            schema_id = int(req.match_info["id"])
+        except ValueError:
+            return self._err(404, 40403, "schema id must be an integer")
+        schema = self.store.by_id.get(schema_id)
+        if schema is None:
+            return self._err(404, 40403, "schema not found")
+        return web.json_response({"schema": schema}, content_type=CT)
+
+    async def _get_config(self, req: web.Request) -> web.Response:
+        await self._replay()
+        subject = req.match_info.get("subject")
+        if subject:
+            level = self.store.compatibility_of(subject)
+        else:
+            level = self.store.global_compatibility
+        return web.json_response({"compatibilityLevel": level}, content_type=CT)
+
+    async def _put_config(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        level = body.get("compatibility", "").upper()
+        if level not in avro_compat.LEVELS:
+            return self._err(422, 42203, f"invalid compatibility level: {level}")
+        subject = req.match_info.get("subject")
+        async with self._write_lock:
+            key, value = self.store.config_record(subject, level)
+            await self._append([(key, value)])
+        return web.json_response({"compatibility": level}, content_type=CT)
+
+    async def _check_compat(self, req: web.Request) -> web.Response:
+        subject = req.match_info["subject"]
+        body = await req.json()
+        await self._replay()
+        try:
+            new = avro_compat.parse(self._schema_text(body) or "")
+        except avro_compat.SchemaParseError as e:
+            return self._err(422, 42201, str(e))
+        version = req.match_info["version"]
+        if version == "latest":
+            live = self.store.live_versions(subject)
+            if not live:
+                return self._err(404, 40401, f"subject not found: {subject}")
+            olds = [avro_compat.parse(v.schema) for v in live]
+        else:
+            v = self._resolve_version(subject, version)
+            if v is None:
+                return self._err(404, 40402, "version not found")
+            olds = [avro_compat.parse(v.schema)]
+        level = self.store.compatibility_of(subject)
+        ok = avro_compat.compatible(new, olds, level)
+        return web.json_response({"is_compatible": ok}, content_type=CT)

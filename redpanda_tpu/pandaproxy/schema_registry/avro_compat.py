@@ -1,0 +1,171 @@
+"""Avro schema parsing + compatibility checking.
+
+Parity with pandaproxy/schema_registry/avro.h + schema_util: the registry
+(2021 snapshot) supports Avro schemas with the standard compatibility
+levels. This implements the Avro spec's schema-resolution subset the
+registry needs:
+
+- canonical parse of {primitive, record, enum, array, map, union, fixed}
+- reader/writer compatibility: name match for named types, field-by-field
+  record rules (missing writer field needs a reader default; extra writer
+  fields ignored), enum symbol subset, union member resolution, and the
+  numeric promotion chain int → long → float → double (+ string↔bytes).
+
+Levels: BACKWARD (new reads old), FORWARD (old reads new), FULL (both),
+NONE, and the *_TRANSITIVE variants checked against all prior versions.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+PRIMITIVES = {"null", "boolean", "int", "long", "float", "double", "bytes", "string"}
+_PROMOTIONS = {
+    "int": {"long", "float", "double"},
+    "long": {"float", "double"},
+    "float": {"double"},
+    "string": {"bytes"},
+    "bytes": {"string"},
+}
+
+
+class SchemaParseError(ValueError):
+    pass
+
+
+@dataclass
+class AvroSchema:
+    type: str
+    name: str | None = None
+    fields: list[dict] = field(default_factory=list)  # record: {name, schema, has_default}
+    symbols: list[str] = field(default_factory=list)  # enum
+    items: "AvroSchema | None" = None  # array
+    values: "AvroSchema | None" = None  # map
+    branches: list["AvroSchema"] = field(default_factory=list)  # union
+    size: int = 0  # fixed
+
+
+def parse(schema_json: str | dict | list) -> AvroSchema:
+    if isinstance(schema_json, str):
+        try:
+            schema_json = json.loads(schema_json)
+        except json.JSONDecodeError:
+            # bare primitive like `"string"` already decoded by caller? no:
+            # a raw primitive name without quotes is invalid JSON
+            raise SchemaParseError("schema is not valid JSON")
+    return _parse(schema_json, names={})
+
+
+def _parse(node, names: dict) -> AvroSchema:
+    if isinstance(node, str):
+        if node in PRIMITIVES:
+            return AvroSchema(node)
+        if node in names:
+            return names[node]
+        raise SchemaParseError(f"unknown type reference: {node}")
+    if isinstance(node, list):
+        return AvroSchema("union", branches=[_parse(b, names) for b in node])
+    if not isinstance(node, dict) or "type" not in node:
+        raise SchemaParseError(f"malformed schema node: {node!r}")
+    t = node["type"]
+    if t in PRIMITIVES:
+        return AvroSchema(t)
+    if t == "record" or t == "error":
+        name = node.get("name")
+        if not name:
+            raise SchemaParseError("record needs a name")
+        rec = AvroSchema("record", name=name)
+        names[name] = rec
+        for f in node.get("fields", []):
+            if "name" not in f or "type" not in f:
+                raise SchemaParseError(f"malformed field: {f!r}")
+            rec.fields.append({
+                "name": f["name"],
+                "schema": _parse(f["type"], names),
+                "has_default": "default" in f,
+            })
+        return rec
+    if t == "enum":
+        if not node.get("name"):
+            raise SchemaParseError("enum needs a name")
+        return AvroSchema("enum", name=node["name"], symbols=list(node.get("symbols", [])))
+    if t == "array":
+        return AvroSchema("array", items=_parse(node["items"], names))
+    if t == "map":
+        return AvroSchema("map", values=_parse(node["values"], names))
+    if t == "fixed":
+        if not node.get("name"):
+            raise SchemaParseError("fixed needs a name")
+        return AvroSchema("fixed", name=node["name"], size=int(node.get("size", 0)))
+    # {"type": [...]} union wrapper or nested named reference
+    if isinstance(t, (list, dict)):
+        return _parse(t, names)
+    raise SchemaParseError(f"unknown type: {t}")
+
+
+def reader_can_read(reader: AvroSchema, writer: AvroSchema, _seen=None) -> bool:
+    """Avro schema-resolution rules: can data written with `writer` be read
+    with `reader`?"""
+    if _seen is None:
+        _seen = set()
+    key = (id(reader), id(writer))
+    if key in _seen:
+        return True  # recursive types: assume ok at the cycle point
+    _seen.add(key)
+
+    # union handling first (spec: resolve unions before other rules)
+    if writer.type == "union":
+        return all(reader_can_read(reader, b, _seen) for b in writer.branches)
+    if reader.type == "union":
+        return any(reader_can_read(b, writer, _seen) for b in reader.branches)
+
+    if reader.type in PRIMITIVES or writer.type in PRIMITIVES:
+        if reader.type == writer.type:
+            return True
+        return reader.type in _PROMOTIONS.get(writer.type, set())
+
+    if reader.type != writer.type:
+        return False
+    if reader.type == "record":
+        if reader.name != writer.name:
+            return False
+        writer_fields = {f["name"]: f for f in writer.fields}
+        for rf in reader.fields:
+            wf = writer_fields.get(rf["name"])
+            if wf is None:
+                if not rf["has_default"]:
+                    return False  # reader field absent in writer, no default
+            elif not reader_can_read(rf["schema"], wf["schema"], _seen):
+                return False
+        return True
+    if reader.type == "enum":
+        return reader.name == writer.name and set(writer.symbols) <= set(reader.symbols)
+    if reader.type == "array":
+        return reader_can_read(reader.items, writer.items, _seen)
+    if reader.type == "map":
+        return reader_can_read(reader.values, writer.values, _seen)
+    if reader.type == "fixed":
+        return reader.name == writer.name and reader.size == writer.size
+    return False
+
+
+LEVELS = {
+    "NONE", "BACKWARD", "FORWARD", "FULL",
+    "BACKWARD_TRANSITIVE", "FORWARD_TRANSITIVE", "FULL_TRANSITIVE",
+}
+
+
+def compatible(new: AvroSchema, olds: list[AvroSchema], level: str) -> bool:
+    """Check `new` against prior versions under the given level. `olds` is
+    ordered oldest→newest; non-transitive levels check only the latest."""
+    if level == "NONE" or not olds:
+        return True
+    check = olds if level.endswith("_TRANSITIVE") else olds[-1:]
+    base = level.replace("_TRANSITIVE", "")
+    for old in check:
+        if base in ("BACKWARD", "FULL") and not reader_can_read(new, old):
+            return False
+        if base in ("FORWARD", "FULL") and not reader_can_read(old, new):
+            return False
+    return True

@@ -1,0 +1,161 @@
+"""Schema storage in the ``_schemas`` topic.
+
+Parity with pandaproxy/schema_registry seq_writer.h + sharded_store.h: every
+mutation is a record appended to a single-partition replicated topic
+(key = {keytype, subject, version}, value = the schema envelope), and the
+in-memory store is rebuilt by replaying that log — so registry state
+survives restarts and, in a cluster, every proxy instance converges by
+reading the same topic.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+
+from redpanda_tpu.pandaproxy.schema_registry import avro_compat
+
+logger = logging.getLogger("rptpu.schema_registry")
+
+SCHEMAS_TOPIC = "_schemas"
+DEFAULT_COMPAT = "BACKWARD"
+
+
+@dataclass
+class SchemaVersion:
+    subject: str
+    version: int
+    schema_id: int
+    schema: str  # canonical JSON text
+    deleted: bool = False
+
+
+@dataclass
+class SubjectState:
+    versions: list[SchemaVersion] = field(default_factory=list)
+    compatibility: str | None = None
+
+
+class SchemaStore:
+    """In-memory state + the log-replay apply function."""
+
+    def __init__(self) -> None:
+        self.subjects: dict[str, SubjectState] = {}
+        self.by_id: dict[int, str] = {}
+        self.global_compatibility = DEFAULT_COMPAT
+        self.next_id = 1
+
+    # ------------------------------------------------------------ apply (log replay)
+    def apply(self, key: bytes, value: bytes | None) -> None:
+        try:
+            k = json.loads(key.decode())
+        except Exception:
+            return
+        kt = k.get("keytype")
+        if kt == "SCHEMA":
+            if value is None:
+                # tombstone: hard-delete the version
+                st = self.subjects.get(k["subject"])
+                if st:
+                    st.versions = [v for v in st.versions if v.version != k["version"]]
+                return
+            v = json.loads(value.decode())
+            sv = SchemaVersion(
+                v["subject"], v["version"], v["id"], v["schema"], v.get("deleted", False)
+            )
+            st = self.subjects.setdefault(sv.subject, SubjectState())
+            st.versions = [x for x in st.versions if x.version != sv.version]
+            st.versions.append(sv)
+            st.versions.sort(key=lambda x: x.version)
+            self.by_id[sv.schema_id] = sv.schema
+            self.next_id = max(self.next_id, sv.schema_id + 1)
+        elif kt == "CONFIG":
+            if value is None:
+                return
+            v = json.loads(value.decode())
+            if k.get("subject"):
+                self.subjects.setdefault(k["subject"], SubjectState()).compatibility = v[
+                    "compatibilityLevel"
+                ]
+            else:
+                self.global_compatibility = v["compatibilityLevel"]
+
+    # ------------------------------------------------------------ queries
+    def live_versions(self, subject: str) -> list[SchemaVersion]:
+        st = self.subjects.get(subject)
+        return [v for v in st.versions if not v.deleted] if st else []
+
+    def compatibility_of(self, subject: str) -> str:
+        st = self.subjects.get(subject)
+        return (st.compatibility if st and st.compatibility else None) or self.global_compatibility
+
+    def find_schema(self, subject: str, schema: str) -> SchemaVersion | None:
+        canon = _canonical(schema)
+        for v in self.live_versions(subject):
+            if _canonical(v.schema) == canon:
+                return v
+        return None
+
+    # ------------------------------------------------------------ mutations (return records)
+    def register_records(self, subject: str, schema: str) -> tuple[list[tuple[bytes, bytes | None]], int]:
+        """Validates + builds the records to append; returns (records, id).
+        Raises on incompatibility / parse errors."""
+        parsed = avro_compat.parse(schema)
+        existing = self.find_schema(subject, schema)
+        if existing is not None:
+            return [], existing.schema_id
+        olds = [avro_compat.parse(v.schema) for v in self.live_versions(subject)]
+        level = self.compatibility_of(subject)
+        if not avro_compat.compatible(parsed, olds, level):
+            raise IncompatibleSchema(
+                f"schema is not {level}-compatible with subject {subject}"
+            )
+        live = self.live_versions(subject)
+        version = (live[-1].version + 1) if live else 1
+        schema_id = self.next_id
+        key = json.dumps(
+            {"keytype": "SCHEMA", "subject": subject, "version": version},
+            separators=(",", ":"),
+        ).encode()
+        value = json.dumps(
+            {"subject": subject, "version": version, "id": schema_id,
+             "schema": schema, "deleted": False},
+            separators=(",", ":"),
+        ).encode()
+        return [(key, value)], schema_id
+
+    def delete_subject_records(self, subject: str) -> list[tuple[bytes, bytes | None]]:
+        out = []
+        for v in self.live_versions(subject):
+            key = json.dumps(
+                {"keytype": "SCHEMA", "subject": subject, "version": v.version},
+                separators=(",", ":"),
+            ).encode()
+            value = json.dumps(
+                {"subject": subject, "version": v.version, "id": v.schema_id,
+                 "schema": v.schema, "deleted": True},
+                separators=(",", ":"),
+            ).encode()
+            out.append((key, value))
+        return out
+
+    def config_record(self, subject: str | None, level: str) -> tuple[bytes, bytes]:
+        key = json.dumps(
+            {"keytype": "CONFIG", "subject": subject}, separators=(",", ":")
+        ).encode()
+        value = json.dumps({"compatibilityLevel": level}, separators=(",", ":")).encode()
+        return key, value
+
+
+class IncompatibleSchema(ValueError):
+    pass
+
+
+def _canonical(schema) -> str:
+    try:
+        if not isinstance(schema, str):
+            return json.dumps(schema, sort_keys=True, separators=(",", ":"))
+        return json.dumps(json.loads(schema), sort_keys=True, separators=(",", ":"))
+    except (json.JSONDecodeError, TypeError):
+        return schema if isinstance(schema, str) else repr(schema)

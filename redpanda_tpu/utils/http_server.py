@@ -1,0 +1,24 @@
+"""Shared aiohttp server lifecycle (admin, REST proxy, schema registry).
+
+One place for runner setup, ephemeral-port resolution, and the listen log —
+the reference's analogous shared piece is ``pandaproxy::server``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+
+async def start_site(
+    app: web.Application, host: str, port: int, logger: logging.Logger, name: str
+) -> tuple[web.AppRunner, int]:
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    if port == 0:
+        port = runner.addresses[0][1]
+    logger.info("%s listening on %s:%d", name, host, port)
+    return runner, port

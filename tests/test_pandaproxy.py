@@ -1,0 +1,240 @@
+"""REST proxy + schema registry tests.
+
+Mirrors ducktape pandaproxy_test.py + schema_registry_test.py shapes:
+topic/produce/consume over HTTP with the embedded-format JSON, consumer
+instance lifecycle, schema registration/lookup/compat/config/delete, and
+registry state surviving a restart via the _schemas topic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+
+import aiohttp
+import pytest
+
+from redpanda_tpu.kafka.client.client import KafkaClient
+from redpanda_tpu.kafka.server.broker import Broker, BrokerConfig
+from redpanda_tpu.kafka.server.protocol import KafkaServer
+from redpanda_tpu.pandaproxy import RestProxy, SchemaRegistry
+from redpanda_tpu.pandaproxy.schema_registry import avro_compat
+from redpanda_tpu.storage.log_manager import StorageApi
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _start_broker(tmp_path):
+    storage = await StorageApi(str(tmp_path)).start()
+    cfg = BrokerConfig(data_dir=str(tmp_path))
+    broker = Broker(cfg, storage)
+    server = await KafkaServer(broker, "127.0.0.1", 0).start()
+    cfg.advertised_port = server.port
+    return storage, broker, server
+
+
+RECORD_V1 = json.dumps({
+    "type": "record", "name": "User",
+    "fields": [{"name": "id", "type": "int"}],
+})
+RECORD_V2_OK = json.dumps({  # adds a defaulted field: BACKWARD compatible
+    "type": "record", "name": "User",
+    "fields": [
+        {"name": "id", "type": "int"},
+        {"name": "email", "type": "string", "default": ""},
+    ],
+})
+RECORD_V2_BAD = json.dumps({  # adds a required field: NOT backward compatible
+    "type": "record", "name": "User",
+    "fields": [
+        {"name": "id", "type": "int"},
+        {"name": "email", "type": "string"},
+    ],
+})
+
+
+# ------------------------------------------------------------------ avro unit
+def test_avro_compat_rules():
+    v1 = avro_compat.parse(RECORD_V1)
+    v2 = avro_compat.parse(RECORD_V2_OK)
+    bad = avro_compat.parse(RECORD_V2_BAD)
+    # new reader w/ defaulted extra field reads old data
+    assert avro_compat.reader_can_read(v2, v1)
+    # required extra field cannot read old data
+    assert not avro_compat.reader_can_read(bad, v1)
+    # promotions
+    assert avro_compat.reader_can_read(avro_compat.parse('"long"'), avro_compat.parse('"int"'))
+    assert not avro_compat.reader_can_read(avro_compat.parse('"int"'), avro_compat.parse('"long"'))
+    # unions
+    u = avro_compat.parse('["null", "string"]')
+    assert avro_compat.reader_can_read(u, avro_compat.parse('"string"'))
+    assert not avro_compat.reader_can_read(avro_compat.parse('"string"'), u)
+    # enum symbol subset
+    e1 = avro_compat.parse(json.dumps({"type": "enum", "name": "E", "symbols": ["A"]}))
+    e2 = avro_compat.parse(json.dumps({"type": "enum", "name": "E", "symbols": ["A", "B"]}))
+    assert avro_compat.reader_can_read(e2, e1)
+    assert not avro_compat.reader_can_read(e1, e2)
+    # levels
+    assert avro_compat.compatible(v2, [v1], "BACKWARD")
+    assert not avro_compat.compatible(bad, [v1], "BACKWARD")
+    assert avro_compat.compatible(bad, [v1], "NONE")
+    # FORWARD: old reader must read new data; dropping a field w/o default ok forward
+    assert avro_compat.compatible(v1, [v2], "BACKWARD")  # v1 reads v2 (ignores extra)
+
+
+# ------------------------------------------------------------------ rest proxy
+def test_rest_proxy_e2e(tmp_path):
+    async def main():
+        storage, broker, server = await _start_broker(tmp_path)
+        proxy = await RestProxy([("127.0.0.1", server.port)], port=0).start()
+        base = f"http://127.0.0.1:{proxy.port}"
+        client = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        await client.create_topic("rest-t", partitions=2)
+        async with aiohttp.ClientSession() as s:
+            # metadata
+            topics = await (await s.get(f"{base}/topics")).json()
+            assert "rest-t" in topics
+            t = await (await s.get(f"{base}/topics/rest-t")).json()
+            assert len(t["partitions"]) == 2
+            assert (await s.get(f"{base}/topics/nope")).status == 404
+            # produce: content type selects the embedded format
+            from redpanda_tpu.pandaproxy.rest import JSON_V2
+
+            r = await s.post(
+                f"{base}/topics/rest-t",
+                data=json.dumps({"records": [{"value": {"n": 1}, "partition": 0}]}),
+                headers={"Content-Type": JSON_V2},
+            )
+            offs = (await r.json())["offsets"]
+            assert offs[0]["offset"] == 0
+            r = await s.post(f"{base}/topics/rest-t", json={"records": [
+                {"value": base64.b64encode(b"\x00raw").decode(), "partition": 1},
+            ]})
+            offs = (await r.json())["offsets"]
+            assert offs[0]["offset"] == 0
+            # binary format rejects non-base64 cleanly
+            r = await s.post(f"{base}/topics/rest-t", json={"records": [
+                {"value": "not base64!!", "partition": 0},
+            ]})
+            assert r.status == 422
+            # multi-record single-partition produce gets contiguous offsets
+            r = await s.post(
+                f"{base}/topics/rest-t",
+                data=json.dumps({"records": [
+                    {"value": i, "partition": 0} for i in range(3)
+                ]}),
+                headers={"Content-Type": JSON_V2},
+            )
+            offs = (await r.json())["offsets"]
+            assert [o["offset"] for o in offs] == [1, 2, 3]
+            # consumer instance lifecycle
+            r = await s.post(f"{base}/consumers/cg-rest", json={"name": "i1"})
+            assert r.status == 200
+            inst = f"{base}/consumers/cg-rest/instances/i1"
+            r = await s.post(f"{inst}/subscription", json={"topics": ["rest-t"]})
+            assert r.status == 204
+            records = await (await s.get(f"{inst}/records")).json()
+            values = sorted(base64.b64decode(rec["value"]) for rec in records)
+            assert values == sorted(
+                [b'{"n":1}', b"\x00raw", b"0", b"1", b"2"]
+            )
+            r = await s.post(f"{inst}/offsets")
+            assert r.status == 204
+            # duplicate instance name rejected; delete works
+            assert (await s.post(f"{base}/consumers/cg-rest", json={"name": "i1"})).status == 409
+            assert (await s.delete(inst)).status == 204
+            assert (await s.get(f"{inst}/records")).status == 404
+        await client.close()
+        await proxy.stop()
+        await server.stop()
+        await storage.stop()
+
+    run(main())
+
+
+# ------------------------------------------------------------------ schema registry
+def test_schema_registry_e2e(tmp_path):
+    async def main():
+        storage, broker, server = await _start_broker(tmp_path)
+        sr = await SchemaRegistry([("127.0.0.1", server.port)], port=0).start()
+        base = f"http://127.0.0.1:{sr.port}"
+        async with aiohttp.ClientSession() as s:
+            # register v1
+            r = await s.post(f"{base}/subjects/user-value/versions", json={"schema": RECORD_V1})
+            assert r.status == 200
+            id1 = (await r.json())["id"]
+            # re-register identical → same id, no new version
+            r = await s.post(f"{base}/subjects/user-value/versions", json={"schema": RECORD_V1})
+            assert (await r.json())["id"] == id1
+            # incompatible (required field added vs v1) rejected with 409
+            r = await s.post(f"{base}/subjects/user-value/versions", json={"schema": RECORD_V2_BAD})
+            assert r.status == 409
+            # compat check endpoint agrees
+            r = await s.post(
+                f"{base}/compatibility/subjects/user-value/versions/latest",
+                json={"schema": RECORD_V2_BAD},
+            )
+            assert (await r.json())["is_compatible"] is False
+            # compatible evolution (defaulted field)
+            r = await s.post(f"{base}/subjects/user-value/versions", json={"schema": RECORD_V2_OK})
+            id2 = (await r.json())["id"]
+            assert id2 != id1
+            assert await (await s.get(f"{base}/subjects/user-value/versions")).json() == [1, 2]
+            # lookup by schema + by id + by version
+            r = await s.post(f"{base}/subjects/user-value", json={"schema": RECORD_V2_OK})
+            assert (await r.json())["version"] == 2
+            assert json.loads((await (await s.get(f"{base}/schemas/ids/{id1}")).json())["schema"])["name"] == "User"
+            latest = await (await s.get(f"{base}/subjects/user-value/versions/latest")).json()
+            assert latest["version"] == 2
+            # config: switch to NONE, the bad schema now registers
+            r = await s.put(f"{base}/config/user-value", json={"compatibility": "NONE"})
+            assert r.status == 200
+            r = await s.post(f"{base}/subjects/user-value/versions", json={"schema": RECORD_V2_BAD})
+            assert r.status == 200
+            # invalid schema → 422
+            r = await s.post(f"{base}/subjects/x/versions", json={"schema": "{nope"})
+            assert r.status == 422
+            # subjects list + delete
+            assert "user-value" in await (await s.get(f"{base}/subjects")).json()
+            r = await s.delete(f"{base}/subjects/user-value")
+            assert (await r.json()) == [1, 2, 3]
+            assert await (await s.get(f"{base}/subjects")).json() == []
+        await sr.stop()
+        await server.stop()
+        await storage.stop()
+
+    run(main())
+
+
+def test_schema_registry_survives_restart(tmp_path):
+    """Registry state lives in the _schemas topic: a fresh registry instance
+    on the same broker replays it (seq_writer/sharded_store semantics)."""
+
+    async def main():
+        storage, broker, server = await _start_broker(tmp_path)
+        sr = await SchemaRegistry([("127.0.0.1", server.port)], port=0).start()
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{sr.port}/subjects/ev-value/versions",
+                json={"schema": RECORD_V1},
+            )
+            id1 = (await r.json())["id"]
+        await sr.stop()
+        sr2 = await SchemaRegistry([("127.0.0.1", server.port)], port=0).start()
+        async with aiohttp.ClientSession() as s:
+            got = await (
+                await s.get(f"http://127.0.0.1:{sr2.port}/schemas/ids/{id1}")
+            ).json()
+            assert json.loads(got["schema"])["name"] == "User"
+            vs = await (
+                await s.get(f"http://127.0.0.1:{sr2.port}/subjects/ev-value/versions")
+            ).json()
+            assert vs == [1]
+        await sr2.stop()
+        await server.stop()
+        await storage.stop()
+
+    run(main())
