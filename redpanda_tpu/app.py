@@ -96,6 +96,9 @@ class Application:
         if c.coproc_enable:
             await self._start_coproc()
 
+        if c.cloud_storage_enabled:
+            await self._start_archival()
+
         self._register_metrics()
         await self.storage.log_mgr.start_housekeeping(
             c.log_compaction_interval_ms / 1000.0
@@ -199,6 +202,27 @@ class Application:
         self.broker.coproc_api = self.coproc
         self._stop_order.append(self.coproc)
 
+    async def _start_archival(self) -> None:
+        """Tiered storage, wired only when enabled (application.cc:630-649)."""
+        from redpanda_tpu.archival import ArchivalScheduler
+        from redpanda_tpu.cloud_storage import Remote
+        from redpanda_tpu.s3 import S3Client
+
+        c = self.config
+        client = S3Client(
+            c.cloud_storage_bucket,
+            region=c.cloud_storage_region,
+            endpoint=c.cloud_storage_api_endpoint or None,
+            access_key=c.cloud_storage_access_key,
+            secret_key=c.cloud_storage_secret_key,
+        )
+        self.archival = await ArchivalScheduler(
+            self.broker, Remote(client),
+            interval_s=c.cloud_storage_segment_max_upload_interval_sec,
+        ).start()
+        self._stop_order.append(self.archival)
+        self._s3_client = client
+
     def _register_metrics(self) -> None:
         b = self.broker
         registry.gauge(
@@ -218,6 +242,9 @@ class Application:
             except Exception:
                 logger.exception("stopping %s failed", type(svc).__name__)
         self._stop_order.clear()
+        if getattr(self, "_s3_client", None) is not None:
+            await self._s3_client.close()
+            self._s3_client = None
         if self.connections is not None:
             await self.connections.close()
 

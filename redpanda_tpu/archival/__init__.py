@@ -1,0 +1,6 @@
+"""Tiered-storage upload side (src/v/archival parity)."""
+
+from redpanda_tpu.archival.archiver import NtpArchiver
+from redpanda_tpu.archival.scheduler import ArchivalScheduler
+
+__all__ = ["ArchivalScheduler", "NtpArchiver"]

@@ -1,0 +1,73 @@
+"""Per-ntp segment archiver.
+
+Parity with archival/ntp_archiver_service.h:72 + archival_policy: on each
+pass, pick upload candidates — CLOSED segments (everything but the active
+head) whose offsets are not yet in the remote manifest — upload them, then
+upload the refreshed partition manifest. Restart-safe: the remote manifest
+is the source of truth for what's already uploaded (the reference
+re-downloads it on startup).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from redpanda_tpu.cloud_storage.manifest import PartitionManifest, SegmentMeta
+from redpanda_tpu.cloud_storage.remote import Remote
+from redpanda_tpu.models.fundamental import NTP
+
+logger = logging.getLogger("rptpu.archival")
+
+
+class NtpArchiver:
+    def __init__(self, ntp: NTP, log, remote: Remote, revision: int = 0) -> None:
+        self.ntp = ntp
+        self.log = log  # storage.DiskLog
+        self.remote = remote
+        self.manifest = PartitionManifest(ntp, revision)
+        self._synced = False
+        # set when segment uploads landed but the manifest upload failed:
+        # the next pass must retry the manifest even with nothing new
+        self._manifest_dirty = False
+
+    async def sync_manifest(self) -> None:
+        """Seed local state from the remote manifest (startup/recovery)."""
+        remote_manifest = await self.remote.download_partition_manifest(self.manifest)
+        if remote_manifest is not None:
+            self.manifest = remote_manifest
+        self._synced = True
+
+    def upload_candidates(self) -> list:
+        """archival_policy: closed segments not yet uploaded."""
+        segs = self.log.segments
+        if not segs:
+            return []
+        closed = [s for s in segs if not s.writable]
+        return [
+            s for s in closed
+            if not self.manifest.contains(os.path.basename(s.data_path))
+            and s.dirty_offset >= s.base_offset  # non-empty
+        ]
+
+    async def upload_next_candidates(self) -> int:
+        """One reconciliation pass; returns the number of uploads."""
+        if not self._synced:
+            await self.sync_manifest()
+        uploaded = 0
+        for seg in self.upload_candidates():
+            name = os.path.basename(seg.data_path)
+            with open(seg.data_path, "rb") as f:
+                data = f.read()
+            key = self.manifest.segment_key(name)
+            await self.remote.upload_segment(key, data)
+            self.manifest.add(
+                SegmentMeta(name, seg.base_offset, seg.dirty_offset, len(data), seg.term)
+            )
+            uploaded += 1
+            logger.info("uploaded %s (%d bytes) for %s", name, len(data), self.ntp)
+        if uploaded or self._manifest_dirty:
+            self._manifest_dirty = True
+            await self.remote.upload_manifest(self.manifest)
+            self._manifest_dirty = False
+        return uploaded

@@ -1,0 +1,108 @@
+"""Archival scheduler: reconcile archivers with the partition set.
+
+Parity with archival/service.h:96-186 scheduler_service: a periodic fiber
+(re)builds the ntp → archiver map from the partitions this node leads,
+runs each archiver's upload pass, and uploads topic manifests for new
+topics. Only wired when cloud_storage_enabled (application.cc:630-649).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from redpanda_tpu.archival.archiver import NtpArchiver
+from redpanda_tpu.cloud_storage.manifest import TopicManifest
+from redpanda_tpu.cloud_storage.remote import Remote
+from redpanda_tpu.models.fundamental import NTP
+
+logger = logging.getLogger("rptpu.archival")
+
+
+class ArchivalScheduler:
+    def __init__(
+        self, broker, remote: Remote, *, interval_s: float = 30.0
+    ) -> None:
+        self.broker = broker
+        self.remote = remote
+        self.interval_s = interval_s
+        self.archivers: dict[NTP, NtpArchiver] = {}
+        self._uploaded_topic_manifests: set[str] = set()
+        self._task: asyncio.Task | None = None
+        self._bg_tasks: set[asyncio.Task] = set()
+
+    async def start(self) -> "ArchivalScheduler":
+        self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        for t in list(self._bg_tasks) + ([self._task] if self._task else []):
+            t.cancel()
+        tasks = list(self._bg_tasks) + ([self._task] if self._task else [])
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        self._bg_tasks.clear()
+        self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.run_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("archival pass failed")
+            await asyncio.sleep(self.interval_s)
+
+    async def run_once(self) -> int:
+        """One reconcile + upload pass; returns total segment uploads.
+        Failures are isolated per ntp so one poisoned partition cannot
+        starve the rest (the reference's per-archiver fibers)."""
+        self._reconcile()
+        total = 0
+        for ntp, archiver in list(self.archivers.items()):
+            try:
+                total += await archiver.upload_next_candidates()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("archival pass failed for %s", ntp)
+        return total
+
+    def _reconcile(self) -> None:
+        """Archive partitions this node leads, skip internal topics."""
+        current: set[NTP] = set()
+        for ntp, p in self.broker.partition_manager.partitions().items():
+            if self.broker.is_internal_topic(ntp.topic) or "$" in ntp.topic:
+                continue
+            if not p.is_leader():
+                continue
+            current.add(ntp)
+            if ntp not in self.archivers:
+                md = self.broker.topic_table.get(ntp.topic)
+                revision = md.config.revision if md else 0
+                self.archivers[ntp] = NtpArchiver(ntp, p.log, self.remote, revision)
+            if ntp.topic not in self._uploaded_topic_manifests:
+                self._uploaded_topic_manifests.add(ntp.topic)
+                t = asyncio.get_running_loop().create_task(
+                    self._upload_topic_manifest(ntp.topic)
+                )
+                self._bg_tasks.add(t)
+                t.add_done_callback(self._bg_tasks.discard)
+        for gone in set(self.archivers) - current:
+            del self.archivers[gone]
+
+    async def _upload_topic_manifest(self, topic: str) -> None:
+        md = self.broker.topic_table.get(topic)
+        if md is None:
+            return
+        tm = TopicManifest(
+            md.config.ns, topic, md.config.partition_count,
+            md.config.replication_factor,
+            {k: v for k, v in md.config.config_map().items() if v is not None},
+        )
+        try:
+            await self.remote.upload_manifest(tm)
+        except Exception:
+            logger.exception("topic manifest upload failed for %s", topic)
+            self._uploaded_topic_manifests.discard(topic)

@@ -1,0 +1,7 @@
+"""Tiered-storage download/read side (src/v/cloud_storage parity)."""
+
+from redpanda_tpu.cloud_storage.cache import CacheService
+from redpanda_tpu.cloud_storage.manifest import PartitionManifest, TopicManifest
+from redpanda_tpu.cloud_storage.remote import Remote
+
+__all__ = ["CacheService", "PartitionManifest", "Remote", "TopicManifest"]

@@ -1,0 +1,80 @@
+"""Local disk cache for downloaded segments.
+
+Parity with cloud_storage/cache_service.h: downloaded objects land under a
+cache dir keyed by their object key; total size is bounded and eviction is
+LRU by access time (the reference walks the dir and trims to the target
+size with recursive_directory_walker).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+logger = logging.getLogger("rptpu.cloud_storage.cache")
+
+
+class CacheService:
+    def __init__(self, cache_dir: str, max_bytes: int = 1 << 30) -> None:
+        self.cache_dir = cache_dir
+        self.max_bytes = max_bytes
+        os.makedirs(cache_dir, exist_ok=True)
+        # in-memory access ordering; seeded from mtimes on restart
+        self._access: dict[str, float] = {}
+        for root, _dirs, files in os.walk(cache_dir):
+            for f in files:
+                p = os.path.join(root, f)
+                self._access[os.path.relpath(p, cache_dir)] = os.path.getmtime(p)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, key.lstrip("/"))
+
+    def get(self, key: str) -> bytes | None:
+        p = self._path(key)
+        if not os.path.exists(p):
+            return None
+        self._access[key.lstrip("/")] = time.time()
+        with open(p, "rb") as f:
+            return f.read()
+
+    def put(self, key: str, data: bytes) -> None:
+        p = self._path(key)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".part"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, p)
+        self._access[key.lstrip("/")] = time.time()
+        self._maybe_evict()
+
+    def contains(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def size_bytes(self) -> int:
+        total = 0
+        for rel in list(self._access):
+            p = os.path.join(self.cache_dir, rel)
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                self._access.pop(rel, None)
+        return total
+
+    def _maybe_evict(self) -> None:
+        total = self.size_bytes()
+        if total <= self.max_bytes:
+            return
+        # oldest-access first until under budget
+        for rel in sorted(self._access, key=self._access.get):
+            p = os.path.join(self.cache_dir, rel)
+            try:
+                sz = os.path.getsize(p)
+                os.remove(p)
+                total -= sz
+            except OSError:
+                pass
+            self._access.pop(rel, None)
+            logger.debug("evicted %s from cache", rel)
+            if total <= self.max_bytes:
+                return

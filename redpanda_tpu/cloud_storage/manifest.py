@@ -1,0 +1,125 @@
+"""Partition + topic manifests.
+
+Parity with cloud_storage/manifest.h: the per-ntp JSON manifest lists
+uploaded segments {name → base_offset, committed_offset, size, term}, and
+the topic manifest records the topic config. Object naming mirrors the
+reference's layout: a hash prefix spreads keys across S3 partitions
+(manifest.cc uses xxhash of the path), then
+``<prefix>/<ns>/<topic>/<partition>_<revision>/...``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from redpanda_tpu.hashing.xx import xxhash64
+from redpanda_tpu.models.fundamental import NTP
+
+MANIFEST_FORMAT_VERSION = 1
+
+
+def _prefix(path: str) -> str:
+    return f"{xxhash64(path.encode()) & 0xFFFFFFFF:08x}"
+
+
+def partition_path(ntp: NTP, revision: int = 0) -> str:
+    base = f"{ntp.ns}/{ntp.topic}/{ntp.partition}_{revision}"
+    return f"{_prefix(base)}/{base}"
+
+
+@dataclass
+class SegmentMeta:
+    name: str  # "<base>-<term>-v1.log"
+    base_offset: int
+    committed_offset: int
+    size_bytes: int
+    term: int
+
+
+@dataclass
+class PartitionManifest:
+    ntp: NTP
+    revision: int = 0
+    segments: dict[str, SegmentMeta] = field(default_factory=dict)
+
+    @property
+    def manifest_key(self) -> str:
+        return f"{partition_path(self.ntp, self.revision)}/manifest.json"
+
+    def segment_key(self, name: str) -> str:
+        return f"{partition_path(self.ntp, self.revision)}/{name}"
+
+    def add(self, meta: SegmentMeta) -> None:
+        self.segments[meta.name] = meta
+
+    def contains(self, name: str) -> bool:
+        return name in self.segments
+
+    @property
+    def last_uploaded_offset(self) -> int:
+        if not self.segments:
+            return -1
+        return max(s.committed_offset for s in self.segments.values())
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "version": MANIFEST_FORMAT_VERSION,
+            "namespace": self.ntp.ns,
+            "topic": self.ntp.topic,
+            "partition": self.ntp.partition,
+            "revision": self.revision,
+            "segments": {
+                name: {
+                    "base_offset": s.base_offset,
+                    "committed_offset": s.committed_offset,
+                    "size_bytes": s.size_bytes,
+                    "term": s.term,
+                }
+                for name, s in sorted(self.segments.items())
+            },
+        }, indent=1).encode()
+
+    @staticmethod
+    def from_json(blob: bytes) -> "PartitionManifest":
+        d = json.loads(blob.decode())
+        m = PartitionManifest(
+            NTP(d["namespace"], d["topic"], d["partition"]), d.get("revision", 0)
+        )
+        for name, s in d.get("segments", {}).items():
+            m.segments[name] = SegmentMeta(
+                name, s["base_offset"], s["committed_offset"], s["size_bytes"], s["term"]
+            )
+        return m
+
+
+@dataclass
+class TopicManifest:
+    ns: str
+    topic: str
+    partition_count: int
+    replication_factor: int
+    config: dict = field(default_factory=dict)
+
+    @property
+    def manifest_key(self) -> str:
+        base = f"{self.ns}/{self.topic}"
+        return f"{_prefix(base)}/{base}/topic_manifest.json"
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "version": MANIFEST_FORMAT_VERSION,
+            "namespace": self.ns,
+            "topic": self.topic,
+            "partition_count": self.partition_count,
+            "replication_factor": self.replication_factor,
+            "config": self.config,
+        }, indent=1).encode()
+
+    @staticmethod
+    def from_json(blob: bytes) -> "TopicManifest":
+        d = json.loads(blob.decode())
+        return TopicManifest(
+            d["namespace"], d["topic"], d["partition_count"],
+            d["replication_factor"], d.get("config", {}),
+        )

@@ -1,0 +1,73 @@
+"""Retrying remote operations over the S3 client.
+
+Parity with cloud_storage/remote.h:33: every upload/download retries with
+exponential backoff inside a time budget (retry_chain_node semantics), and
+manifests get typed (de)serialization.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from redpanda_tpu.cloud_storage.manifest import PartitionManifest, TopicManifest
+from redpanda_tpu.s3 import S3Client, S3Error
+
+logger = logging.getLogger("rptpu.cloud_storage")
+
+
+class Remote:
+    def __init__(
+        self, client: S3Client, *, retries: int = 3, backoff_s: float = 0.1
+    ) -> None:
+        self.client = client
+        self.retries = retries
+        self.backoff_s = backoff_s
+
+    async def _with_retries(self, what: str, fn):
+        delay = self.backoff_s
+        for attempt in range(1, self.retries + 1):
+            try:
+                return await fn()
+            except FileNotFoundError:
+                raise
+            except (S3Error, OSError, asyncio.TimeoutError) as e:
+                logger.warning("%s failed (attempt %d): %s", what, attempt, e)
+                if attempt == self.retries:
+                    raise
+                await asyncio.sleep(delay)
+                delay *= 2
+
+    # ------------------------------------------------------------ segments
+    async def upload_segment(self, key: str, data: bytes) -> None:
+        await self._with_retries(
+            f"upload {key}", lambda: self.client.put_object(key, data)
+        )
+
+    async def download_segment(self, key: str) -> bytes:
+        return await self._with_retries(
+            f"download {key}", lambda: self.client.get_object(key)
+        )
+
+    # ------------------------------------------------------------ manifests
+    async def upload_manifest(self, manifest: PartitionManifest | TopicManifest) -> None:
+        await self._with_retries(
+            f"upload {manifest.manifest_key}",
+            lambda: self.client.put_object(manifest.manifest_key, manifest.to_json()),
+        )
+
+    async def download_partition_manifest(self, manifest: PartitionManifest) -> PartitionManifest | None:
+        """Fetch the remote manifest for the ntp; None when absent."""
+        try:
+            blob = await self._with_retries(
+                f"download {manifest.manifest_key}",
+                lambda: self.client.get_object(manifest.manifest_key),
+            )
+        except FileNotFoundError:
+            return None
+        return PartitionManifest.from_json(blob)
+
+    async def list_prefix(self, prefix: str = "") -> list[dict]:
+        return await self._with_retries(
+            f"list {prefix}", lambda: self.client.list_objects(prefix)
+        )

@@ -121,16 +121,25 @@ class ControllerBackend:
                 if me not in pa.replicas and self.pm.get(d.ntp) is not None:
                     await self._remove_local(d.ntp)
 
+    def _log_overrides(self, ntp: NTP):
+        md = self.topic_table.get(ntp.topic)
+        if md is None:
+            return None
+        return md.config.log_overrides(self.gm.storage.log_mgr.config)
+
     async def _create_local(self, ntp: NTP, pa) -> None:
         if self.pm.get(ntp) is not None:
             return
+        overrides = self._log_overrides(ntp)
         if pa.group < 0:
             # non-replicated (single-node direct log / materialized topic)
-            await self.pm.manage(ntp)
+            await self.pm.manage(ntp, log_overrides=overrides)
             return
         if self.gm.consensus_for(pa.group) is None:
             voters = [VNode(r, 0) for r in pa.replicas]
-            c = await self.gm.create_group(pa.group, ntp, voters)
+            c = await self.gm.create_group(
+                pa.group, ntp, voters, log_overrides=overrides
+            )
             self.pm.attach(ntp, Partition(ntp, c, c.log))
 
     async def _remove_local(self, ntp: NTP) -> None:
@@ -160,7 +169,9 @@ class ControllerBackend:
         if me in target and self.pm.get(ntp) is None:
             if self.gm.consensus_for(pa.group) is None:
                 voters = [VNode(r, 0) for r in pa.replicas]
-                c = await self.gm.create_group(pa.group, ntp, voters)
+                c = await self.gm.create_group(
+                    pa.group, ntp, voters, log_overrides=self._log_overrides(ntp)
+                )
                 self.pm.attach(ntp, Partition(ntp, c, c.log))
         # 2. current leader: run the joint-consensus change + finish
         c = self.gm.consensus_for(pa.group)

@@ -146,10 +146,10 @@ class PartitionManager:
         self.node_id = node_id
         self._partitions: dict[NTP, Partition] = {}
 
-    async def manage(self, ntp: NTP, *, term: int = 0) -> Partition:
+    async def manage(self, ntp: NTP, *, term: int = 0, log_overrides=None) -> Partition:
         if ntp in self._partitions:
             return self._partitions[ntp]
-        log = await self.storage.log_mgr.manage(ntp)
+        log = await self.storage.log_mgr.manage(ntp, overrides=log_overrides)
         consensus = DirectConsensus(log, self.node_id, term)
         p = Partition(ntp, consensus, log)
         self._partitions[ntp] = p

@@ -27,7 +27,25 @@ class TopicConfig:
     retention_ms: int | None = None
     segment_size: int | None = None
     compression: str = "producer"
+    # incarnation id: bumped on recreate so tiered-storage object paths
+    # never collide with a deleted topic's uploads (partition_path _<rev>)
+    revision: int = 0
     extra: dict[str, str] = field(default_factory=dict)
+
+    def log_overrides(self, base):
+        """Per-topic storage knobs → a LogConfig for this topic's logs
+        (log_config overrides in log_manager::manage). Kafka's -1 sentinel
+        means UNLIMITED retention, never 'delete everything'."""
+        import dataclasses
+
+        overrides = {}
+        if self.segment_size is not None and self.segment_size > 0:
+            overrides["max_segment_size"] = self.segment_size
+        if self.retention_bytes is not None and self.retention_bytes >= 0:
+            overrides["retention_bytes"] = self.retention_bytes
+        if self.retention_ms is not None and self.retention_ms >= 0:
+            overrides["retention_ms"] = self.retention_ms
+        return dataclasses.replace(base, **overrides) if overrides else None
 
     def apply_override(self, key: str, value: str | None) -> None:
         """Kafka config key → typed field (alter_configs / controller

@@ -75,7 +75,7 @@ class Broker:
         from redpanda_tpu.storage.kvstore import KeySpace
 
         payload = {"ns": cfg.ns, "partitions": cfg.partition_count,
-                   "config": cfg.config_map()}
+                   "revision": cfg.revision, "config": cfg.config_map()}
         self.storage.kvs.put(
             KeySpace.storage, f"topic_cfg/{cfg.ns}/{cfg.name}".encode(),
             json.dumps(payload).encode(),
@@ -114,19 +114,54 @@ class Broker:
                 KeySpace.storage, f"topic_cfg/{ns}/{topic}".encode()
             )
             if saved is not None:
-                for k, v in json.loads(saved.decode()).get("config", {}).items():
+                payload = json.loads(saved.decode())
+                cfg.revision = payload.get("revision", 0)
+                for k, v in payload.get("config", {}).items():
                     cfg.apply_override(k, v)
             elif topic == "__consumer_offsets":
                 cfg.cleanup_policy = "compact"
             await self.create_topic(cfg)
 
+    def _log_overrides(self, config: TopicConfig):
+        return config.log_overrides(self.storage.log_mgr.config)
+
+    def update_log_configs(self, name: str) -> None:
+        """Push altered topic storage configs into LIVE logs so retention /
+        segment-size changes apply without a restart."""
+        md = self.topic_table.get(name)
+        if md is None:
+            return
+        new_cfg = md.config.log_overrides(self.storage.log_mgr.config)
+        if new_cfg is None:
+            new_cfg = self.storage.log_mgr.config
+        for pa in md.assignments.values():
+            p = self.partition_manager.get(pa.ntp)
+            if p is not None:
+                p.log.config = new_cfg
+
+    def _next_revision(self) -> int:
+        """Monotonic topic-incarnation counter (kvstore-durable), so a
+        recreate never reuses a prior incarnation's archival paths."""
+        from redpanda_tpu.storage.kvstore import KeySpace
+
+        raw = self.storage.kvs.get(KeySpace.storage, b"topic_revision_counter")
+        rev = (int(raw.decode()) if raw else 0) + 1
+        self.storage.kvs.put(
+            KeySpace.storage, b"topic_revision_counter", str(rev).encode()
+        )
+        return rev
+
     # ------------------------------------------------------------ topics
     async def create_topic(self, config: TopicConfig) -> None:
+        if config.revision == 0:
+            config.revision = self._next_revision()
         md = self.topic_table.add_topic(
             config, replicas_for=lambda p: [self.config.node_id]
         )
         for pa in md.assignments.values():
-            await self.partition_manager.manage(pa.ntp)
+            await self.partition_manager.manage(
+                pa.ntp, log_overrides=self._log_overrides(config)
+            )
         self._persist_topic_config(config)
 
     async def delete_topic(self, name: str) -> None:
@@ -148,7 +183,9 @@ class Broker:
         )
         md = self.topic_table.get(name)
         for pa in md.assignments.values():
-            await self.partition_manager.manage(pa.ntp)
+            await self.partition_manager.manage(
+                pa.ntp, log_overrides=self._log_overrides(md.config)
+            )
 
     # ------------------------------------------------------------ lookup
     def get_partition(self, topic: str, partition: int, ns: str = DEFAULT_NAMESPACE) -> Partition | None:

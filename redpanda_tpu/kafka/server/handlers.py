@@ -680,6 +680,7 @@ async def handle_alter_configs(ctx) -> dict:
                 for c in res.get("configs") or []:
                     _apply_topic_config(md.config, c["name"], c["value"])
                 broker._persist_topic_config(md.config)
+                broker.update_log_configs(rname)
         else:
             code = E.invalid_request
         responses.append(
@@ -713,6 +714,7 @@ async def handle_incremental_alter_configs(ctx) -> dict:
                     elif op == 1:  # DELETE
                         md.config.extra.pop(c["name"], None)
                 broker._persist_topic_config(md.config)
+                broker.update_log_configs(rname)
         else:
             code = E.invalid_request
         responses.append(

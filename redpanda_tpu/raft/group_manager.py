@@ -90,9 +90,10 @@ class GroupManager:
         nodes: list[VNode],
         *,
         timings: RaftTimings | None = None,
+        log_overrides=None,
     ) -> Consensus:
         assert group not in self._groups, f"group {group} already exists"
-        log = await self.storage.log_mgr.manage(ntp)
+        log = await self.storage.log_mgr.manage(ntp, overrides=log_overrides)
         cfg = GroupConfiguration(voters=list(nodes))
         c = Consensus(
             group,

@@ -1,0 +1,5 @@
+"""Minimal S3 client (src/v/s3 parity)."""
+
+from redpanda_tpu.s3.client import S3Client, S3Error, sigv4_headers
+
+__all__ = ["S3Client", "S3Error", "sigv4_headers"]

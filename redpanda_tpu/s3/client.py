@@ -1,0 +1,186 @@
+"""S3 client with AWS Signature V4.
+
+Parity with s3/client.h:95-227 + signature.h: request_creator signs
+GET/PUT/DeleteObject/ListObjectsV2 with SigV4 (canonical request →
+string-to-sign → derived signing key), and the client rides the http layer
+(the reference's own Beast-based http::client; here aiohttp, the build's
+http client). ListObjectsV2's XML is parsed with the stdlib ElementTree.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import logging
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+import aiohttp
+
+logger = logging.getLogger("rptpu.s3")
+
+
+class S3Error(Exception):
+    def __init__(self, status: int, body: str = "") -> None:
+        super().__init__(f"s3 error {status}: {body[:200]}")
+        self.status = status
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sigv4_headers(
+    method: str,
+    host: str,
+    path: str,
+    query: dict[str, str],
+    payload: bytes,
+    access_key: str,
+    secret_key: str,
+    region: str,
+    *,
+    now: datetime.datetime | None = None,
+    service: str = "s3",
+) -> dict[str, str]:
+    """AWS SigV4 (signature.h): returns the headers to attach."""
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(payload).hexdigest()
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(str(v), safe='')}"
+        for k, v in sorted(query.items())
+    )
+    headers = {
+        "host": host,
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-date": amz_date,
+    }
+    signed_headers = ";".join(sorted(headers))
+    canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in sorted(headers))
+    canonical_request = "\n".join([
+        method,
+        urllib.parse.quote(path),
+        canonical_query,
+        canonical_headers,
+        signed_headers,
+        payload_hash,
+    ])
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256",
+        amz_date,
+        scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest(),
+    ])
+    k_date = _hmac(("AWS4" + secret_key).encode(), datestamp)
+    k_region = _hmac(k_date, region)
+    k_service = _hmac(k_region, service)
+    k_signing = _hmac(k_service, "aws4_request")
+    signature = hmac.new(k_signing, string_to_sign.encode(), hashlib.sha256).hexdigest()
+    headers["authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_headers}, Signature={signature}"
+    )
+    return headers
+
+
+class S3Client:
+    """GET/PUT/DeleteObject + ListObjectsV2 (s3/client.h:150)."""
+
+    def __init__(
+        self,
+        bucket: str,
+        *,
+        region: str = "us-east-1",
+        endpoint: str | None = None,  # e.g. http://127.0.0.1:9000 (minio/imposter)
+        access_key: str = "",
+        secret_key: str = "",
+    ) -> None:
+        self.bucket = bucket
+        self.region = region
+        self.endpoint = endpoint or f"https://{bucket}.s3.{region}.amazonaws.com"
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self._session: aiohttp.ClientSession | None = None
+        # path-style for custom endpoints (minio), virtual-host for AWS
+        self._path_style = endpoint is not None
+
+    async def _sess(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    def _url_path(self, key: str) -> str:
+        key = key.lstrip("/")
+        return f"/{self.bucket}/{key}" if self._path_style else f"/{key}"
+
+    async def _request(
+        self, method: str, path: str, query: dict[str, str] | None = None,
+        payload: bytes = b"",
+    ) -> tuple[int, bytes]:
+        query = query or {}
+        host = urllib.parse.urlparse(self.endpoint).netloc
+        headers = sigv4_headers(
+            method, host, path, query, payload,
+            self.access_key, self.secret_key, self.region,
+        )
+        url = self.endpoint + path
+        if query:
+            url += "?" + urllib.parse.urlencode(sorted(query.items()))
+        sess = await self._sess()
+        async with sess.request(method, url, data=payload or None, headers=headers) as resp:
+            body = await resp.read()
+            return resp.status, body
+
+    # ------------------------------------------------------------ object ops
+    async def put_object(self, key: str, data: bytes) -> None:
+        status, body = await self._request("PUT", self._url_path(key), payload=data)
+        if status not in (200, 201):
+            raise S3Error(status, body.decode("utf-8", "replace"))
+
+    async def get_object(self, key: str) -> bytes:
+        status, body = await self._request("GET", self._url_path(key))
+        if status == 404:
+            raise FileNotFoundError(key)
+        if status != 200:
+            raise S3Error(status, body.decode("utf-8", "replace"))
+        return body
+
+    async def delete_object(self, key: str) -> None:
+        status, body = await self._request("DELETE", self._url_path(key))
+        if status not in (200, 204, 404):
+            raise S3Error(status, body.decode("utf-8", "replace"))
+
+    async def list_objects(self, prefix: str = "") -> list[dict]:
+        """ListObjectsV2; returns [{key, size}] (continuation handled)."""
+        out: list[dict] = []
+        token: str | None = None
+        base = f"/{self.bucket}" if self._path_style else "/"
+        while True:
+            query = {"list-type": "2"}
+            if prefix:
+                query["prefix"] = prefix
+            if token:
+                query["continuation-token"] = token
+            status, body = await self._request("GET", base, query=query)
+            if status != 200:
+                raise S3Error(status, body.decode("utf-8", "replace"))
+            root = ET.fromstring(body)
+            ns = ""
+            if root.tag.startswith("{"):
+                ns = root.tag.split("}")[0] + "}"
+            for item in root.findall(f"{ns}Contents"):
+                out.append({
+                    "key": item.findtext(f"{ns}Key"),
+                    "size": int(item.findtext(f"{ns}Size") or 0),
+                })
+            token = root.findtext(f"{ns}NextContinuationToken")
+            if not token:
+                return out

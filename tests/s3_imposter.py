@@ -1,0 +1,75 @@
+"""In-process S3 imposter for tiered-storage tests.
+
+Mirrors cloud_storage/tests' s3_imposter fixture: an aiohttp server
+implementing path-style PUT/GET/DELETE object + ListObjectsV2 over an
+in-memory dict, so the whole archival stack runs hermetically.
+"""
+
+from __future__ import annotations
+
+import xml.sax.saxutils as sx
+
+from aiohttp import web
+
+
+class S3Imposter:
+    def __init__(self) -> None:
+        self.objects: dict[str, bytes] = {}  # "<bucket>/<key>" -> data
+        self.requests: list[tuple[str, str]] = []  # (method, path)
+        self.fail_next = 0  # inject N failures (500) for retry tests
+        self._runner: web.AppRunner | None = None
+        self.port = 0
+
+    async def start(self) -> "S3Imposter":
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = self._runner.addresses[0][1]
+        return self
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+            self._runner = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    async def _handle(self, req: web.Request) -> web.Response:
+        path = req.path.lstrip("/")
+        self.requests.append((req.method, path))
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            return web.Response(status=500, text="injected")
+        if req.method == "GET" and req.query.get("list-type") == "2":
+            bucket = path.split("/")[0]
+            prefix = f"{bucket}/" + req.query.get("prefix", "")
+            items = sorted(
+                (k[len(bucket) + 1 :], len(v))
+                for k, v in self.objects.items()
+                if k.startswith(prefix)
+            )
+            xml = "".join(
+                f"<Contents><Key>{sx.escape(k)}</Key><Size>{n}</Size></Contents>"
+                for k, n in items
+            )
+            return web.Response(
+                text=f'<?xml version="1.0"?><ListBucketResult>{xml}</ListBucketResult>',
+                content_type="application/xml",
+            )
+        if req.method == "PUT":
+            self.objects[path] = await req.read()
+            return web.Response(status=200)
+        if req.method == "GET":
+            data = self.objects.get(path)
+            if data is None:
+                return web.Response(status=404, text="NoSuchKey")
+            return web.Response(body=data)
+        if req.method == "DELETE":
+            self.objects.pop(path, None)
+            return web.Response(status=204)
+        return web.Response(status=400)
