@@ -1,0 +1,8 @@
+"""``python -m redpanda_tpu`` → the rpk CLI (main.cc:33 analogue: the same
+binary is both the broker (`start`) and the operator tool)."""
+
+import sys
+
+from redpanda_tpu.cli.rpk import main
+
+sys.exit(main())

@@ -1,0 +1,5 @@
+"""Operator CLI (src/go/rpk parity)."""
+
+from redpanda_tpu.cli.rpk import main
+
+__all__ = ["main"]

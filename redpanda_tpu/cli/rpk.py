@@ -1,0 +1,446 @@
+"""rpk — the operator CLI.
+
+Parity with src/go/rpk (pkg/cli/cmd): broker lifecycle, topic CRUD +
+produce/consume, ACLs, users, wasm (transform) deploy/remove/generate,
+cluster info, config get/set, debug bundle, generate
+grafana-dashboard/prometheus-config, and tune (the autotune story —
+reported as informational here: kernel tuning is outside this runtime's
+scope, docs/www/autotune.md).
+
+Usage: python -m redpanda_tpu <command> ...   (or the `rpk` console entry)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import base64
+import json
+import sys
+
+DEFAULT_BROKERS = "127.0.0.1:9092"
+DEFAULT_ADMIN = "127.0.0.1:9644"
+
+
+def _parse_brokers(s: str) -> list[tuple[str, int]]:
+    out = []
+    for hp in s.split(","):
+        host, _, port = hp.strip().partition(":")
+        out.append((host, int(port or 9092)))
+    return out
+
+
+async def _client(args):
+    from redpanda_tpu.kafka.client.client import KafkaClient
+
+    sasl = (args.user, args.password) if getattr(args, "user", None) else None
+    return await KafkaClient(_parse_brokers(args.brokers), sasl=sasl).connect()
+
+
+async def _admin_request(args, method: str, path: str, body=None):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        url = f"http://{args.admin_api}{path}"
+        async with s.request(method, url, json=body) as resp:
+            try:
+                return resp.status, await resp.json()
+            except Exception:
+                return resp.status, await resp.text()
+
+
+# ================================================================ redpanda start
+async def cmd_start(args) -> int:
+    import logging
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s"
+    )
+    from redpanda_tpu.app import Application
+    from redpanda_tpu.config import Configuration
+
+    cfg = Configuration()
+    if args.config:
+        cfg.load_yaml(args.config)
+    for kv in args.set or []:
+        k, _, v = kv.partition("=")
+        cfg.set(k, v)
+    app = await Application(cfg).start()
+    print(
+        f"redpanda_tpu started: kafka {cfg.kafka_api_host}:{app.kafka_server.port}, "
+        f"admin {cfg.admin_api_host}:{app.admin.port}"
+    )
+    try:
+        await app.run_forever()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    return 0
+
+
+# ================================================================ topics
+async def cmd_topic(args) -> int:
+    client = await _client(args)
+    try:
+        if args.topic_cmd == "create":
+            configs = dict(kv.split("=", 1) for kv in (args.topic_config or []))
+            await client.create_topic(
+                args.name, partitions=args.partitions,
+                replication=args.replicas, configs=configs or None,
+            )
+            print(f"created topic {args.name}")
+        elif args.topic_cmd == "delete":
+            await client.delete_topic(args.name)
+            print(f"deleted topic {args.name}")
+        elif args.topic_cmd == "list":
+            md = await client.refresh_metadata()
+            for t in sorted(md["topics"], key=lambda t: t["name"]):
+                if t["error_code"] == 0:
+                    print(f"{t['name']}\t{len(t.get('partitions') or [])} partitions")
+        elif args.topic_cmd == "describe":
+            md = await client.refresh_metadata([args.name], auto_create=False)
+            t = next((t for t in md["topics"] if t["name"] == args.name), None)
+            if t is None or t["error_code"] != 0:
+                print(f"topic not found: {args.name}", file=sys.stderr)
+                return 1
+            print(json.dumps(t, indent=2))
+        elif args.topic_cmd == "produce":
+            data = sys.stdin.buffer.read() if args.value == "-" else args.value.encode()
+            off = await client.produce(args.name, args.partition, [(args.key.encode() if args.key else None, data)])
+            print(f"produced to {args.name}/{args.partition} at offset {off}")
+        elif args.topic_cmd == "consume":
+            offset = args.offset
+            if offset < 0:
+                offset = await client.earliest_offset(args.name, args.partition)
+            n = 0
+            while n < args.num:
+                batches, hwm = await client.fetch(args.name, args.partition, offset, max_wait_ms=500)
+                if not batches:
+                    if offset >= hwm:
+                        break
+                    continue
+                for b in batches:
+                    for r in b.records():
+                        print(json.dumps({
+                            "offset": b.header.base_offset + r.offset_delta,
+                            "key": r.key.decode("utf-8", "replace") if r.key else None,
+                            "value": r.value.decode("utf-8", "replace") if r.value else None,
+                        }))
+                        n += 1
+                        if n >= args.num:
+                            break
+                    offset = b.last_offset + 1
+        return 0
+    finally:
+        await client.close()
+
+
+# ================================================================ acl
+async def cmd_acl(args) -> int:
+    from redpanda_tpu.kafka.protocol import messages as m
+    from redpanda_tpu.security.acl import (
+        AclOperation, AclPermission, PatternType, ResourceType,
+    )
+
+    client = await _client(args)
+    try:
+        conn = await client.any_connection()
+        if args.acl_cmd == "create":
+            resp = await conn.request(m.CREATE_ACLS, {"creations": [{
+                "resource_type": int(ResourceType[args.resource]),
+                "resource_name": args.resource_name,
+                "resource_pattern_type": int(PatternType.literal),
+                "principal": args.principal if args.principal.startswith("User:") else f"User:{args.principal}",
+                "host": args.host,
+                "operation": int(AclOperation[args.operation]),
+                "permission_type": int(AclPermission.deny if args.deny else AclPermission.allow),
+            }]})
+            code = resp["results"][0]["error_code"]
+            print("created" if code == 0 else f"failed: error {code}")
+            return 0 if code == 0 else 1
+        if args.acl_cmd == "list":
+            resp = await conn.request(m.DESCRIBE_ACLS, {
+                "resource_type_filter": int(ResourceType.any),
+                "resource_name_filter": None,
+                "pattern_type_filter": int(PatternType.any),
+                "principal_filter": None, "host_filter": None,
+                "operation": int(AclOperation.any),
+                "permission_type": int(AclPermission.any),
+            })
+            for res in resp["resources"]:
+                for acl in res["acls"]:
+                    print(
+                        f"{ResourceType(res['resource_type']).name}:{res['resource_name']}\t"
+                        f"{acl['principal']}\t{AclOperation(acl['operation']).name}\t"
+                        f"{AclPermission(acl['permission_type']).name}"
+                    )
+        return 0
+    finally:
+        await client.close()
+
+
+# ================================================================ wasm (transforms)
+_TRANSFORM_TEMPLATE = {
+    "name": "my-transform",
+    "input_topics": ["source-topic"],
+    # TransformSpec wire form (ops/transforms.py to_json); this example
+    # keeps records containing `"level":"error"` and projects two fields
+    "spec": {
+        "name": "errors-only",
+        "ops": [
+            {"op": "filter_contains", "pattern": '"level":"error"',
+             "negate": False, "nonnum_suffix": False},
+            {"op": "map_project", "fields": [
+                {"kind": "int", "key": "code"},
+                {"kind": "str", "key": "msg", "max_len": 32},
+            ]},
+        ],
+    },
+}
+
+
+async def cmd_wasm(args) -> int:
+    if args.wasm_cmd == "generate":
+        print(json.dumps(_TRANSFORM_TEMPLATE, indent=2))
+        return 0
+    from redpanda_tpu.coproc import wasm_event
+    from redpanda_tpu.models.fundamental import COPROC_INTERNAL_TOPIC
+
+    client = await _client(args)
+    try:
+        if args.wasm_cmd == "deploy":
+            with open(args.file) as f:
+                doc = json.load(f)
+            rec = wasm_event.make_deploy_record(
+                doc["name"], json.dumps(doc["spec"]), doc["input_topics"]
+            )
+        else:  # remove
+            rec = wasm_event.make_remove_record(args.name)
+        from redpanda_tpu.models.record import RecordBatch
+
+        batch = wasm_event.deploy_batch([rec])
+        await client.produce_batches(COPROC_INTERNAL_TOPIC, 0, [batch])
+        print(f"{args.wasm_cmd} event produced to {COPROC_INTERNAL_TOPIC}")
+        return 0
+    finally:
+        await client.close()
+
+
+# ================================================================ cluster / user / config
+async def cmd_cluster(args) -> int:
+    status, brokers = await _admin_request(args, "GET", "/v1/brokers")
+    if status != 200:
+        print(f"admin api error {status}", file=sys.stderr)
+        return 1
+    print(f"{'ID':<5}{'HOST':<20}{'KAFKA':<22}{'STATUS':<10}")
+    for b in brokers:
+        print(
+            f"{b['node_id']:<5}{b['host']:<20}"
+            f"{b['kafka_host']}:{b['kafka_port']:<15}{b['membership_status']:<10}"
+        )
+    return 0
+
+
+async def cmd_user(args) -> int:
+    if args.user_cmd == "create":
+        status, body = await _admin_request(
+            args, "POST", "/v1/security/users",
+            {"username": args.name, "password": args.new_password,
+             "algorithm": args.mechanism},
+        )
+    elif args.user_cmd == "delete":
+        status, body = await _admin_request(args, "DELETE", f"/v1/security/users/{args.name}")
+    else:  # list
+        status, body = await _admin_request(args, "GET", "/v1/security/users")
+    print(json.dumps(body, indent=2) if status == 200 else f"error {status}: {body}")
+    return 0 if status == 200 else 1
+
+
+async def cmd_config(args) -> int:
+    if args.config_cmd == "get":
+        status, body = await _admin_request(args, "GET", "/v1/config")
+        if status != 200:
+            return 1
+        if args.key:
+            print(json.dumps(body.get(args.key)))
+        else:
+            print(json.dumps(body, indent=2, sort_keys=True))
+        return 0
+    print("config set requires editing the yaml + restart (needs_restart properties)", file=sys.stderr)
+    return 1
+
+
+# ================================================================ debug / generate / tune
+async def cmd_debug(args) -> int:
+    """debug bundle: gather admin state into a tar.gz (rpk debug bundle)."""
+    import io
+    import tarfile
+    import time
+
+    bundle: dict[str, object] = {}
+    for name, path in [
+        ("config.json", "/v1/config"),
+        ("brokers.json", "/v1/brokers"),
+        ("partitions.json", "/v1/partitions"),
+        ("metrics.txt", "/metrics"),
+    ]:
+        status, body = await _admin_request(args, "GET", path)
+        bundle[name] = body if status == 200 else {"error": status}
+    out = args.output or f"debug-bundle-{int(time.time())}.tar.gz"
+    with tarfile.open(out, "w:gz") as tar:
+        for name, content in bundle.items():
+            data = (
+                content.encode() if isinstance(content, str)
+                else json.dumps(content, indent=2).encode()
+            )
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    print(f"wrote {out}")
+    return 0
+
+
+def cmd_generate(args) -> int:
+    if args.generate_cmd == "prometheus-config":
+        print(json.dumps({
+            "scrape_configs": [{
+                "job_name": "redpanda_tpu",
+                "static_configs": [{"targets": [args.admin_api]}],
+                "metrics_path": "/metrics",
+            }]
+        }, indent=2))
+    else:  # grafana-dashboard
+        print(json.dumps({
+            "title": "redpanda_tpu",
+            "panels": [
+                {"title": "Partitions", "expr": "redpanda_tpu_partitions_total"},
+                {"title": "Topics", "expr": "redpanda_tpu_topics_total"},
+                {"title": "Produce latency", "expr": "redpanda_tpu_produce_latency_us_bucket"},
+            ],
+        }, indent=2))
+    return 0
+
+
+def cmd_tune(args) -> int:
+    """The reference's tuners mutate kernel/device state (aio, irq, cpu
+    governor, hugepages — pkg/tuners). This runtime targets TPU hosts where
+    those knobs are managed by the platform; report what WOULD be tuned."""
+    tuners = [
+        "aio_events", "clocksource", "cpu_governor", "disk_irq",
+        "disk_scheduler", "net_irq", "hugepages", "ballast_file",
+    ]
+    for t in tuners:
+        print(f"{t:<16} skipped (platform-managed on TPU hosts)")
+    return 0
+
+
+# ================================================================ arg parsing
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="rpk", description=__doc__)
+    p.add_argument("--brokers", default=DEFAULT_BROKERS, help="host:port[,host:port]")
+    p.add_argument("--admin-api", default=DEFAULT_ADMIN)
+    p.add_argument("--user", help="SASL username")
+    p.add_argument("--password", help="SASL password")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a broker")
+    sp.add_argument("--config", help="redpanda.yaml path")
+    sp.add_argument("--set", action="append", help="key=value override")
+
+    tp = sub.add_parser("topic", help="topic operations")
+    tsub = tp.add_subparsers(dest="topic_cmd", required=True)
+    tc = tsub.add_parser("create")
+    tc.add_argument("name")
+    tc.add_argument("-p", "--partitions", type=int, default=1)
+    tc.add_argument("-r", "--replicas", type=int, default=1)
+    tc.add_argument("-c", "--topic-config", action="append", help="key=value")
+    td = tsub.add_parser("delete")
+    td.add_argument("name")
+    tsub.add_parser("list")
+    tde = tsub.add_parser("describe")
+    tde.add_argument("name")
+    tpr = tsub.add_parser("produce")
+    tpr.add_argument("name")
+    tpr.add_argument("value", help="record value ('-' = stdin)")
+    tpr.add_argument("-p", "--partition", type=int, default=0)
+    tpr.add_argument("-k", "--key", default=None)
+    tco = tsub.add_parser("consume")
+    tco.add_argument("name")
+    tco.add_argument("-p", "--partition", type=int, default=0)
+    tco.add_argument("-o", "--offset", type=int, default=-1)
+    tco.add_argument("-n", "--num", type=int, default=10)
+
+    ap = sub.add_parser("acl", help="acl operations")
+    asub = ap.add_subparsers(dest="acl_cmd", required=True)
+    ac = asub.add_parser("create")
+    ac.add_argument("--resource", choices=["topic", "group", "cluster", "transactional_id"], required=True)
+    ac.add_argument("--resource-name", required=True)
+    ac.add_argument("--principal", required=True)
+    ac.add_argument("--operation", required=True)
+    ac.add_argument("--host", default="*")
+    ac.add_argument("--deny", action="store_true")
+    asub.add_parser("list")
+
+    wp = sub.add_parser("wasm", help="inline transform operations")
+    wsub = wp.add_subparsers(dest="wasm_cmd", required=True)
+    wsub.add_parser("generate", help="print a transform template")
+    wd = wsub.add_parser("deploy")
+    wd.add_argument("file", help="transform JSON (see wasm generate)")
+    wr = wsub.add_parser("remove")
+    wr.add_argument("name")
+
+    cp = sub.add_parser("cluster", help="cluster info")
+    cp.add_subparsers(dest="cluster_cmd").add_parser("info")
+
+    up = sub.add_parser("user", help="SCRAM users (admin api)")
+    usub = up.add_subparsers(dest="user_cmd", required=True)
+    uc = usub.add_parser("create")
+    uc.add_argument("name")
+    uc.add_argument("--new-password", required=True)
+    uc.add_argument("--mechanism", default="SCRAM-SHA-256")
+    ud = usub.add_parser("delete")
+    ud.add_argument("name")
+    usub.add_parser("list")
+
+    cfp = sub.add_parser("config", help="configuration")
+    cfsub = cfp.add_subparsers(dest="config_cmd", required=True)
+    cg = cfsub.add_parser("get")
+    cg.add_argument("key", nargs="?")
+    cfsub.add_parser("set")
+
+    dp = sub.add_parser("debug", help="diagnostics")
+    dsub = dp.add_subparsers(dest="debug_cmd", required=True)
+    db = dsub.add_parser("bundle")
+    db.add_argument("-o", "--output")
+
+    gp = sub.add_parser("generate", help="monitoring configs")
+    gsub = gp.add_subparsers(dest="generate_cmd", required=True)
+    gsub.add_parser("grafana-dashboard")
+    gsub.add_parser("prometheus-config")
+
+    sub.add_parser("tune", help="report platform tuners")
+    sub.add_parser("iotune", help="report io characterization")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    table = {
+        "start": cmd_start,
+        "topic": cmd_topic,
+        "acl": cmd_acl,
+        "wasm": cmd_wasm,
+        "cluster": cmd_cluster,
+        "user": cmd_user,
+        "config": cmd_config,
+    }
+    if args.cmd == "debug":
+        return asyncio.run(cmd_debug(args))
+    if args.cmd == "generate":
+        return cmd_generate(args)
+    if args.cmd in ("tune", "iotune"):
+        return cmd_tune(args)
+    return asyncio.run(table[args.cmd](args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

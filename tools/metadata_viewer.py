@@ -1,0 +1,139 @@
+#!/usr/bin/env python3
+"""Offline decoder for on-disk state (tools/metadata_viewer parity).
+
+Decodes, without a running broker:
+- segment files: batch headers + records (viewer.py/storage.py analogue)
+- kvstore snapshot + WAL (kvstore.py analogue)
+- the controller log (controller commands decoded by type)
+
+Usage:
+  python tools/metadata_viewer.py segment <path/to/0-1-v1.log> [--records]
+  python tools/metadata_viewer.py log <data_dir> <ns/topic/partition> [--records]
+  python tools/metadata_viewer.py kvstore <base_dir>
+  python tools/metadata_viewer.py controller <data_dir>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from redpanda_tpu.models.record import RecordBatch, RecordBatchType  # noqa: E402
+
+
+def iter_batches(path: str):
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos < len(data):
+        try:
+            batch, consumed = RecordBatch.decode_internal(data[pos:])
+        except Exception as e:
+            print(f"  !! decode stopped at byte {pos}: {e}", file=sys.stderr)
+            return
+        yield batch
+        pos += consumed
+
+
+def show_segment(path: str, show_records: bool) -> None:
+    print(f"segment {path}")
+    for batch in iter_batches(path):
+        h = batch.header
+        ok = "ok" if batch.verify_header_crc() and batch.verify_kafka_crc() else "CRC-MISMATCH"
+        print(
+            f"  batch base={h.base_offset} last={batch.last_offset} "
+            f"type={RecordBatchType(h.type).name} records={h.record_count} "
+            f"bytes={h.size_bytes} term={h.term if hasattr(h, 'term') else '-'} crc={ok}"
+        )
+        if show_records:
+            for r in batch.records():
+                print(
+                    f"    off={h.base_offset + r.offset_delta} "
+                    f"key={r.key!r} value={(r.value or b'')[:80]!r}"
+                )
+
+
+def show_log(data_dir: str, ntp_path: str, show_records: bool) -> None:
+    d = os.path.join(data_dir, "data", ntp_path)
+    if not os.path.isdir(d):
+        d = os.path.join(data_dir, ntp_path)
+    for name in sorted(os.listdir(d)):
+        if name.endswith(".log"):
+            show_segment(os.path.join(d, name), show_records)
+
+
+def show_kvstore(base_dir: str) -> None:
+    from redpanda_tpu.storage.kvstore import KeySpace, KvStore
+
+    for entry in sorted(os.listdir(base_dir)):
+        if not entry.startswith("kvstore"):
+            continue
+        kvs = KvStore(os.path.join(base_dir, entry))
+        kvs.start()
+        print(f"kvstore {entry}:")
+        for space in KeySpace:
+            for key in kvs.keys(space):
+                value = kvs.get(space, key)
+                shown = value[:60] if value else b""
+                print(f"  [{space.name}] {key.decode('utf-8', 'replace')} = {shown!r}")
+        kvs.stop()
+
+
+def show_controller(data_dir: str) -> None:
+    from redpanda_tpu.cluster.commands import Command
+
+    show = os.path.join(data_dir, "data", "redpanda", "controller", "0")
+    if not os.path.isdir(show):
+        print(f"no controller log under {data_dir}", file=sys.stderr)
+        return
+    for name in sorted(os.listdir(show)):
+        if not name.endswith(".log"):
+            continue
+        for batch in iter_batches(os.path.join(show, name)):
+            t = RecordBatchType(batch.header.type)
+            if t == RecordBatchType.raft_configuration:
+                print(f"  @{batch.header.base_offset} raft_configuration")
+                continue
+            for rec in batch.records():
+                try:
+                    cmd = Command.from_record(rec)
+                    print(
+                        f"  @{batch.header.base_offset} {cmd.type.name} "
+                        f"{json.dumps(cmd.data)[:120]}"
+                    )
+                except Exception:
+                    print(f"  @{batch.header.base_offset} <{t.name}>")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("segment")
+    sp.add_argument("path")
+    sp.add_argument("--records", action="store_true")
+    lp = sub.add_parser("log")
+    lp.add_argument("data_dir")
+    lp.add_argument("ntp", help="ns/topic/partition")
+    lp.add_argument("--records", action="store_true")
+    kp = sub.add_parser("kvstore")
+    kp.add_argument("base_dir")
+    cp = sub.add_parser("controller")
+    cp.add_argument("data_dir")
+    args = p.parse_args()
+    if args.cmd == "segment":
+        show_segment(args.path, args.records)
+    elif args.cmd == "log":
+        show_log(args.data_dir, args.ntp, args.records)
+    elif args.cmd == "kvstore":
+        show_kvstore(args.base_dir)
+    else:
+        show_controller(args.data_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
