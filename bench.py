@@ -6,12 +6,25 @@ against a single-core host baseline that mirrors what the reference's
 Node.js sidecar does per record (decode framing, JSON parse, predicate,
 re-encode, re-CRC).
 
+The engine is measured the way a broker drives it: a steady stream of ticks
+with GROUP ticks fused per launch and DEPTH launches in flight
+(submit_group / Ticket.result — coproc/engine.py). Every tick's records are
+exploded, packed, shipped to the device, transformed, fetched, reframed,
+recompressed, and resealed; the clock runs from first submit to the last
+fully-rebuilt reply.
+
+Secondary metrics (BASELINE.md configs 1-3) ride in the same JSON line:
+config 1 = produce-path batch CRC validation (device validator vs host
+crc32c loop), config 2 = 16-partition LZ4 produce codec path, config 3 =
+identity transform through the engine at 16 partitions.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import struct
 import subprocess
 import sys
@@ -23,8 +36,9 @@ P = 64  # partitions
 RECORDS_PER_BATCH = 32
 RECORD_JSON_PAD = 900  # ~1KB records
 ROW_STRIDE = 1152
-WARMUP_TICKS = 3
-MEASURE_TICKS = 20
+GROUP = int(os.environ.get("BENCH_GROUP", "8"))  # ticks fused per launch
+DEPTH = int(os.environ.get("BENCH_DEPTH", "3"))  # launch groups in flight
+MEASURE_TICKS = int(os.environ.get("BENCH_TICKS", "48"))
 BASELINE_TICKS = 2
 
 
@@ -61,14 +75,14 @@ def _pin_cpu():
     force_cpu_platform()
 
 
-def _build_workload():
+def _build_workload(n_partitions=P, topic="bench"):
     from redpanda_tpu.models import Record, RecordBatch, NTP
     from redpanda_tpu.coproc.engine import ProcessBatchItem, ProcessBatchRequest
 
     rng = np.random.default_rng(0)
     levels = ["error", "info", "warn"]
     items = []
-    for p in range(P):
+    for p in range(n_partitions):
         recs = []
         for i in range(RECORDS_PER_BATCH):
             doc = '{"level":"%s","code":%d,"msg":"%s"}' % (
@@ -78,7 +92,7 @@ def _build_workload():
             )
             recs.append(Record(offset_delta=i, timestamp_delta=i, value=doc.encode()))
         batch = RecordBatch.build(recs, base_offset=0, first_timestamp=1_000_000)
-        items.append(ProcessBatchItem(1, NTP.kafka("bench", p), [batch]))
+        items.append(ProcessBatchItem(1, NTP.kafka(topic, p), [batch]))
     return ProcessBatchRequest(items)
 
 
@@ -88,29 +102,46 @@ def _spec():
     return filter_field_eq("level", "error") | map_project(Int("code"), Str("msg", 64))
 
 
+def _run_engine_stream(engine, req, n_ticks, group, depth) -> float:
+    """Steady-state record_batches/sec: GROUP ticks per launch, DEPTH
+    launches in flight, replies fully rebuilt on the critical path."""
+    n_groups = (n_ticks + group - 1) // group
+    pending = []
+    replies = []
+    t0 = time.perf_counter()
+    for g in range(n_groups):
+        k = min(group, n_ticks - g * group)
+        pending.append(engine.submit_group([req] * k))
+        while len(pending) > depth:
+            replies.extend(t.result() for t in pending.pop(0))
+    while pending:
+        replies.extend(t.result() for t in pending.pop(0))
+    elapsed = time.perf_counter() - t0
+    assert len(replies) == n_ticks
+    assert all(len(r.items) == len(req.items) for r in replies)
+    n_batches = sum(len(it.batches) for it in req.items)
+    return n_ticks * n_batches / elapsed
+
+
 def run_tpu_engine(req) -> float:
-    """record_batches/sec through the TPU engine."""
     from redpanda_tpu.coproc import TpuEngine
 
     engine = TpuEngine(row_stride=ROW_STRIDE)
     codes = engine.enable_coprocessors([(1, _spec().to_json(), ("bench",))])
     assert codes[0] == 0
-    for _ in range(WARMUP_TICKS):
-        engine.process_batch(req)
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_TICKS):
-        reply = engine.process_batch(req)
-    elapsed = time.perf_counter() - t0
-    assert len(reply.items) == P
-    return P * MEASURE_TICKS / elapsed
+    # warmup: compile the GROUP-sized shape and, when MEASURE_TICKS is not a
+    # multiple of GROUP, the tail-group shape too (one full group followed
+    # by one tail-sized group), so no XLA compile lands in the timed run.
+    tail = MEASURE_TICKS % GROUP
+    _run_engine_stream(engine, req, GROUP + (tail or min(GROUP, MEASURE_TICKS)), GROUP, DEPTH)
+    return _run_engine_stream(engine, req, MEASURE_TICKS, GROUP, DEPTH)
 
 
 def run_cpu_baseline(req) -> float:
     """Single-core host engine: per-record decode + json.loads + predicate +
     rebuild + re-CRC (the work profile of the reference's JS supervisor)."""
     from redpanda_tpu.models import Record, RecordBatch
-    from redpanda_tpu.compression import compress
-    from redpanda_tpu.models.record import Compression, RecordBatchHeader
+    from redpanda_tpu.models.record import Compression
 
     def tick():
         n_batches = 0
@@ -150,6 +181,93 @@ def run_cpu_baseline(req) -> float:
     return total / elapsed
 
 
+def run_config1_crc_validate() -> dict:
+    """Config 1: produce-path batch CRC validation, 1KB records.
+
+    Device batch validator (ops/pipeline.make_batch_validator — the produce
+    adapter boundary, kafka_batch_adapter.cc:93) vs a single-core host
+    crc32c loop over the same wire regions."""
+    import jax
+
+    from redpanda_tpu.hashing.crc32c import crc32c
+    from redpanda_tpu.models import Record, RecordBatch
+    from redpanda_tpu.ops.pipeline import make_batch_validator
+
+    n, r = 1024, 1536
+    batches = [
+        RecordBatch.build(
+            [Record(offset_delta=i, value=bytes([i % 251]) * 1024) for i in range(1)],
+            base_offset=b,
+        )
+        for b in range(64)
+    ]
+    regions = [b.crc_region() for b in batches] * (n // 64)
+    claimed = np.array(
+        [b.header.crc for b in batches] * (n // 64), dtype=np.uint32
+    )
+    from redpanda_tpu.ops.packing import pack_rows
+
+    rows, lens = pack_rows(regions, r)
+    validate = make_batch_validator(r)
+    ok = np.asarray(validate(rows, lens, claimed))
+    assert ok.all()
+    # steady-state pipelined device throughput
+    reps = 12
+    t0 = time.perf_counter()
+    outs = [validate(rows, lens, claimed) for _ in range(reps)]
+    jax.block_until_ready(outs)
+    dev_rate = reps * n / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    for reg, c in zip(regions, claimed):
+        assert crc32c(reg) == c
+    host_rate = n / (time.perf_counter() - t0)
+    return {
+        "batches_per_sec": round(dev_rate, 1),
+        "vs_host_single_core": round(dev_rate / host_rate, 2),
+    }
+
+
+def run_config2_lz4_produce() -> dict:
+    """Config 2: 16-partition produce with LZ4 — codec-registry throughput
+    (wire batch -> verify CRC -> LZ4 recompress), MB/s."""
+    from redpanda_tpu.compression import compress, uncompress
+    from redpanda_tpu.models import Record, RecordBatch
+    from redpanda_tpu.models.record import Compression
+
+    batches = []
+    rng = np.random.default_rng(1)
+    for p in range(16):
+        recs = [
+            Record(offset_delta=i, value=rng.bytes(512) + b"x" * 512)
+            for i in range(RECORDS_PER_BATCH)
+        ]
+        batches.append(RecordBatch.build(recs, base_offset=0))
+    total_bytes = sum(len(b.payload) for b in batches)
+    reps = 6
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        for b in batches:
+            assert b.verify_kafka_crc()
+            c = compress(b.payload, Compression.lz4)
+            assert uncompress(c, Compression.lz4) == b.payload
+    elapsed = time.perf_counter() - t0
+    return {"mb_per_sec": round(reps * total_bytes / 1e6 / elapsed, 1)}
+
+
+def run_config3_identity(engine_cls) -> dict:
+    """Config 3: identity transform at 16 partitions (engine bridge
+    overhead, the reference's WASM-engine baseline shape)."""
+    from redpanda_tpu.ops.transforms import identity
+
+    req16 = _build_workload(16, topic="bench3")
+    engine = engine_cls(row_stride=ROW_STRIDE)
+    codes = engine.enable_coprocessors([(1, identity().to_json(), ("bench3",))])
+    assert codes[0] == 0
+    _run_engine_stream(engine, req16, GROUP, GROUP, DEPTH)
+    rate = _run_engine_stream(engine, req16, 4 * GROUP, GROUP, DEPTH)
+    return {"record_batches_per_sec": round(rate, 1)}
+
+
 def main():
     tpu_ok = _probe_tpu()
     if not tpu_ok:
@@ -158,6 +276,16 @@ def main():
     value = run_tpu_engine(req)
     baseline = run_cpu_baseline(req)
     import jax
+
+    from redpanda_tpu.coproc import TpuEngine
+
+    extras = {}
+    try:
+        extras["config1_crc_validate"] = run_config1_crc_validate()
+        extras["config2_lz4_produce"] = run_config2_lz4_produce()
+        extras["config3_identity_16p"] = run_config3_identity(TpuEngine)
+    except Exception as exc:  # secondary metrics must never sink the bench
+        extras["configs_error"] = repr(exc)
 
     print(
         json.dumps(
@@ -170,6 +298,9 @@ def main():
                 "device": str(jax.devices()[0]),
                 "partitions": P,
                 "records_per_batch": RECORDS_PER_BATCH,
+                "group_ticks_per_launch": GROUP,
+                "launch_depth": DEPTH,
+                **extras,
             }
         )
     )

@@ -99,7 +99,11 @@ class ScriptContext:
                 read_high[ntp] = batches[-1].last_offset
         if not items:
             return False
-        reply = pm.engine.process_batch(ProcessBatchRequest(items))
+        # Submit is async-dispatch (one H2D + launch, no sync); harvest in a
+        # worker thread so other script fibers overlap with the device.
+        ticket = pm.engine.submit(ProcessBatchRequest(items))
+        loop = asyncio.get_running_loop()
+        reply = await loop.run_in_executor(None, ticket.result)
         if self.script_id in reply.deregistered:
             logger.warning("script %s deregistered by engine policy", self.name)
             pm.detach_script(self.name)

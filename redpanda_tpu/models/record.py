@@ -363,8 +363,14 @@ class RecordBatch:
         return self
 
     # ------------------------------------------------------------ verify
+    def crc_region(self) -> bytes:
+        """The byte region covered by the Kafka CRC (header prefix +
+        payload) — what the device batch validator hashes
+        (kafka_batch_adapter.cc:93 equivalent)."""
+        return self.header.kafka_header_crc_prefix() + self.payload
+
     def verify_kafka_crc(self) -> bool:
-        return self.header.crc == crc32c(self.header.kafka_header_crc_prefix() + self.payload)
+        return self.header.crc == crc32c(self.crc_region())
 
     def verify_header_crc(self) -> bool:
         return self.header.header_crc == self.header.internal_header_only_crc()

@@ -4,15 +4,26 @@ Two device programs cover the engine's steady-state loop (SURVEY §3.4):
 
 1. ``make_batch_validator(r)`` — batch-level Kafka-CRC validation over
    ``[N, r]`` prefixed batch rows (replaces the reference's per-batch
-   record_batch_crc_checker, record.h:699-721).
-2. ``make_record_pipeline(spec, r_in)`` — CRC-agnostic record-value
-   transform: filters + map fused into one XLA program, plus CRC-32C of the
-   transformed values so the host can reseal output batches without
-   re-scanning payload bytes.
+   record_batch_crc_checker, record.h:699-721). This is where the device
+   CRC kernel earns its keep: the produce path ships claimed wire CRCs up
+   with the payload and gets one ok-bit back per batch.
+2. ``make_packed_pipeline(spec, r_in)`` — the engine's record transform as a
+   single-buffer program: one uint8 staging array in, one uint8 packed
+   result out. The tunnel/PCIe link between the broker runtime and the
+   device charges per *transfer*, not per byte, so lengths ride in trailing
+   metadata columns of the input array and (out_len, keep) ride in trailing
+   columns of the output — exactly one H2D and one D2H per launch.
 
-Both are shape-specialized and cached; the bridge calls them with
+The transform output is deliberately CRC-free: output batches are sealed
+host-side after framing + optional compression (the Kafka CRC covers the
+compressed payload, which only exists after the host codec runs —
+script_context_backend.cc:40-68 re-compresses before the CRC for the same
+reason). A per-record value CRC computed on device cannot become the batch
+CRC, so we don't compute one.
+
+Both programs are shape-specialized and cached; the bridge calls them with
 ``[P*B, R]`` staging arrays and overlaps H2D/compute/D2H via JAX async
-dispatch.
+dispatch (see coproc/engine.py).
 """
 
 from __future__ import annotations
@@ -24,6 +35,13 @@ import jax.numpy as jnp
 
 from redpanda_tpu.ops.crc32c_device import make_crc_fn
 from redpanda_tpu.ops.transforms import TransformSpec, compile_transform, transform_out_width
+
+# Trailing metadata columns of the staged input row: int32 LE record length,
+# then 4 pad bytes (keeps the row 8-byte aligned for the host packer).
+IN_META = 8
+# Trailing metadata columns of the packed output row: int32 LE out_len,
+# uint8 keep flag, 3 pad bytes.
+OUT_META = 8
 
 
 @functools.lru_cache(maxsize=16)
@@ -39,23 +57,69 @@ def make_batch_validator(r: int):
     return validate
 
 
+def _le32(cols):
+    """uint8 [N, 4] little-endian columns -> int32 [N]."""
+    c = cols.astype(jnp.int32)
+    return c[:, 0] | (c[:, 1] << 8) | (c[:, 2] << 16) | (c[:, 3] << 24)
+
+
+@functools.lru_cache(maxsize=64)
+def _packed_pipeline_cached(spec_json: str, r_in: int):
+    spec = TransformSpec.from_json(spec_json)
+    tfn = compile_transform(spec, r_in)
+    r_out = transform_out_width(spec, r_in)
+
+    @jax.jit
+    def run(staged):
+        data = staged[:, :r_in]
+        lens = _le32(staged[:, r_in : r_in + 4])
+        out, out_len, keep = tfn(data, lens)
+        masked = jnp.where(keep, out_len, 0).astype(jnp.int32)
+        lenb = jnp.stack(
+            [((masked >> (8 * k)) & 0xFF).astype(jnp.uint8) for k in range(4)], axis=1
+        )
+        keepb = keep.astype(jnp.uint8)[:, None]
+        pad = jnp.zeros((out.shape[0], OUT_META - 5), dtype=jnp.uint8)
+        return jnp.concatenate([out, lenb, keepb, pad], axis=1)
+
+    return run, r_out
+
+
+def make_packed_pipeline(spec: TransformSpec, r_in: int):
+    """fn(staged uint8 [N, r_in+IN_META]) -> packed uint8 [N, r_out+OUT_META]."""
+    return _packed_pipeline_cached(spec.to_json(), int(r_in))
+
+
 @functools.lru_cache(maxsize=64)
 def _record_pipeline_cached(spec_json: str, r_in: int):
     spec = TransformSpec.from_json(spec_json)
     tfn = compile_transform(spec, r_in)
     r_out = transform_out_width(spec, r_in)
-    out_crc_fn = make_crc_fn(r_out)
 
     @jax.jit
     def run(data, lengths):
         out, out_len, keep = tfn(data, lengths)
         masked_len = jnp.where(keep, out_len, 0)
-        out_crc = out_crc_fn(out, masked_len)
-        return out, masked_len, keep, out_crc
+        return out, masked_len, keep
 
     return run, r_out
 
 
 def make_record_pipeline(spec: TransformSpec, r_in: int):
-    """fn(data uint8 [N, r_in], lens [N]) -> (out [N, r_out], out_len, keep, out_crc)."""
+    """fn(data uint8 [N, r_in], lens [N]) -> (out [N, r_out], out_len, keep).
+
+    Unpacked variant for tests and the multichip dryrun; the engine's hot
+    path uses make_packed_pipeline.
+    """
     return _record_pipeline_cached(spec.to_json(), int(r_in))
+
+
+def unpack_result(packed, r_out: int):
+    """Split a fetched packed result (numpy uint8 [N, r_out+OUT_META]) into
+    (out [N, r_out], out_len int32 [N], keep bool [N])."""
+    import numpy as np
+
+    out = packed[:, :r_out]
+    out_len = packed[:, r_out : r_out + 4].copy().view(np.int32).reshape(-1)
+    keep = packed[:, r_out + 4].astype(bool)
+    return out, out_len, keep

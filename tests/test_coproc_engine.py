@@ -165,3 +165,60 @@ def test_multi_batch_multi_partition():
         for ob in it.batches:
             for r in ob.records():
                 assert b'"level":"error"' in r.value
+
+
+# ------------------------------------------------------------ async pipeline
+def test_submit_group_fuses_and_matches_sync():
+    """submit_group must produce byte-identical replies to per-request
+    process_batch, with one launch per script across the whole group."""
+    engine = TpuEngine(row_stride=256, compress_threshold=10**9)
+    _deploy(engine, 1)
+    reqs = [
+        ProcessBatchRequest(
+            [
+                ProcessBatchItem(1, NTP.kafka("orders", p), [_json_batch(6, base_offset=10 * g)])
+                for p in range(3)
+            ]
+        )
+        for g in range(4)
+    ]
+    tickets = engine.submit_group(reqs)
+    group_replies = [t.result() for t in tickets]
+    for req, reply in zip(reqs, group_replies):
+        solo = engine.process_batch(req)
+        assert len(reply.items) == len(solo.items)
+        for a, b in zip(reply.items, solo.items):
+            assert a.source == b.source
+            assert [x.payload for x in a.batches] == [y.payload for y in b.batches]
+            assert [x.header.crc for x in a.batches] == [y.header.crc for y in b.batches]
+
+
+def test_submit_overlapping_tickets_harvest_out_of_order():
+    engine = TpuEngine(row_stride=256, compress_threshold=10**9)
+    _deploy(engine, 1)
+    t1 = engine.submit(
+        ProcessBatchRequest([ProcessBatchItem(1, NTP.kafka("orders", 0), [_json_batch(4)])])
+    )
+    t2 = engine.submit(
+        ProcessBatchRequest([ProcessBatchItem(1, NTP.kafka("orders", 1), [_json_batch(8)])])
+    )
+    r2 = t2.result()
+    r1 = t1.result()
+    assert r1.items[0].batches[0].header.record_count == 2  # 4 records, half "error"
+    assert r2.items[0].batches[0].header.record_count == 4
+
+
+def test_submit_group_unknown_script_gets_empty_reply():
+    engine = TpuEngine(row_stride=256)
+    _deploy(engine, 1)
+    req = ProcessBatchRequest(
+        [
+            ProcessBatchItem(99, NTP.kafka("orders", 0), [_json_batch(2)]),
+            ProcessBatchItem(1, NTP.kafka("orders", 1), [_json_batch(2)]),
+        ]
+    )
+    reply = engine.submit(req).result()
+    assert len(reply.items) == 2
+    by_script = {ri.script_id: ri for ri in reply.items}
+    assert by_script[99].batches == []
+    assert len(by_script[1].batches) == 1

@@ -171,15 +171,28 @@ def test_spec_json_roundtrip():
     assert spec2.to_json() == spec.to_json()
 
 
-def test_record_pipeline_out_crc():
+def test_record_pipeline_matches_packed_pipeline():
+    """The packed single-buffer program (engine hot path) must agree with
+    the unpacked reference pipeline row for row."""
+    from redpanda_tpu.ops.pipeline import IN_META, make_packed_pipeline, unpack_result
+
     data, lens = _packed()
     spec = filter_field_eq("level", "error") | map_project(Int("code"), Str("msg", 16))
     run, r_out = make_record_pipeline(spec, 128)
-    out, out_len, keep, out_crc = map(np.asarray, run(data, lens))
     assert r_out == 22
+    out, out_len, keep = map(np.asarray, run(data, lens))
+
+    prun, pr_out = make_packed_pipeline(spec, 128)
+    assert pr_out == r_out
+    staged = np.zeros((data.shape[0], 128 + IN_META), np.uint8)
+    staged[:, :128] = data
+    staged[:, 128:132] = np.asarray(lens, "<i4").view(np.uint8).reshape(-1, 4)
+    pout, pout_len, pkeep = unpack_result(np.asarray(prun(staged)), pr_out)
+    assert list(pkeep) == list(keep)
+    assert list(pout_len) == list(out_len)
     for i in range(len(JSON_RECORDS)):
         if keep[i]:
-            assert out_crc[i] == crc32c(out[i, : out_len[i]].tobytes())
+            assert pout[i, : out_len[i]].tobytes() == out[i, : out_len[i]].tobytes()
 
 
 # ------------------------------------------------------------------ sharding
