@@ -37,6 +37,8 @@ class AdminServer:
         controller=None,  # cluster.Controller (multi-node)
         host: str = "127.0.0.1",
         port: int = 9644,
+        require_auth: bool = False,
+        auth_token: str | None = None,
     ) -> None:
         self.broker = broker
         self.config = config
@@ -44,12 +46,52 @@ class AdminServer:
         self.controller = controller
         self.host = host
         self.port = port
+        # Auth: when enabled, every mutating/sensitive route needs either
+        # `Authorization: Bearer <auth_token>` or HTTP basic credentials
+        # verified against the broker's SCRAM store. /metrics and
+        # /v1/status/ready stay open (scrapers/probes). When disabled the
+        # admin port MUST NOT be exposed beyond localhost: it can create
+        # superusers and arm failure probes.
+        self.require_auth = require_auth
+        self.auth_token = auth_token
         self._runner: web.AppRunner | None = None
         self._log_level_restores: dict[str, tuple[int, asyncio.TimerHandle]] = {}
 
+    # ------------------------------------------------------------ auth
+    _OPEN_PATHS = ("/metrics", "/v1/status/ready")
+
+    def _authorized(self, req: web.Request) -> bool:
+        if not self.require_auth or req.path in self._OPEN_PATHS:
+            return True
+        hdr = req.headers.get("Authorization", "")
+        if self.auth_token and hdr == f"Bearer {self.auth_token}":
+            return True
+        if hdr.startswith("Basic "):
+            import base64 as _b64
+
+            from redpanda_tpu.security.scram import verify_password
+
+            try:
+                user, _, pw = _b64.b64decode(hdr[6:]).decode().partition(":")
+            except Exception:
+                return False
+            cred = self.broker.security.credentials.get(user)
+            return cred is not None and verify_password(cred, pw)
+        return False
+
+    @web.middleware
+    async def _auth_middleware(self, req: web.Request, handler):
+        if not self._authorized(req):
+            return web.json_response(
+                {"error": "unauthorized"},
+                status=401,
+                headers={"WWW-Authenticate": 'Basic realm="redpanda-admin"'},
+            )
+        return await handler(req)
+
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> "AdminServer":
-        app = web.Application()
+        app = web.Application(middlewares=[self._auth_middleware])
         app.add_routes([
             web.get("/v1/config", self._get_config),
             web.put("/v1/config/log_level/{name}", self._set_log_level),

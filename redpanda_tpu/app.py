@@ -90,6 +90,8 @@ class Application:
             controller=self.controller,
             host=c.admin_api_host,
             port=c.admin_api_port,
+            require_auth=c.admin_api_require_auth,
+            auth_token=c.admin_api_auth_token or None,
         ).start()
         self._stop_order.append(self.admin)
 

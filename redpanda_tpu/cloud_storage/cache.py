@@ -28,7 +28,14 @@ class CacheService:
                 self._access[os.path.relpath(p, cache_dir)] = os.path.getmtime(p)
 
     def _path(self, key: str) -> str:
-        return os.path.join(self.cache_dir, key.lstrip("/"))
+        """Resolve a key under cache_dir, rejecting escapes ('..' segments,
+        absolute keys): the class accepts arbitrary keys, so a hostile key
+        must not be able to read/write/delete outside the cache root."""
+        p = os.path.realpath(os.path.join(self.cache_dir, key.lstrip("/")))
+        root = os.path.realpath(self.cache_dir)
+        if os.path.commonpath([p, root]) != root:
+            raise ValueError(f"cache key escapes cache dir: {key!r}")
+        return p
 
     def get(self, key: str) -> bytes | None:
         p = self._path(key)

@@ -71,6 +71,8 @@ PROPERTIES: list[Property] = [
     Property("rpc_server_port", "Internal RPC port", 33145, int, _port),
     Property("admin_api_host", "Admin API bind host", "127.0.0.1"),
     Property("admin_api_port", "Admin API port", 9644, int, _port),
+    Property("admin_api_require_auth", "Require auth on the admin API", False, bool),
+    Property("admin_api_auth_token", "Static bearer token for the admin API", ""),
     Property("seed_servers", "Seed broker list host:port,...", ""),
     # --- raft timings (configuration.cc raft group)
     Property("raft_election_timeout_ms", "Election timeout", 1500, int, _positive, needs_restart=False),

@@ -365,14 +365,26 @@ class Group:
 
     # ------------------------------------------------------------ offsets
     def commit_offsets(
-        self, member_id: str, generation_id: int, commits: dict[tuple[str, int], OffsetCommit]
+        self,
+        member_id: str,
+        generation_id: int,
+        commits: dict[tuple[str, int], OffsetCommit],
+        *,
+        trusted: bool = False,
     ) -> E:
         if self.state == GroupState.dead:
             return E.coordinator_not_available
         if member_id == "" and generation_id < 0:
-            # simple (non-group) offset storage is always accepted
-            self.offsets.update(commits)
-            return E.none
+            # Simple (non-group) offset storage: only allowed while the
+            # group is Empty (the reference rejects generation<0 commits
+            # against a live group, group.cc:1920) — otherwise a stray
+            # non-member client could overwrite a stable group's offsets.
+            # `trusted` is the internal path (tx coordinator applying
+            # staged offsets at commit time), which bypasses the check.
+            if trusted or self.state == GroupState.empty:
+                self.offsets.update(commits)
+                return E.none
+            return E.illegal_generation
         if member_id not in self.members:
             return E.unknown_member_id
         if generation_id != self.generation:

@@ -136,11 +136,12 @@ class GroupManager:
     async def commit_offsets(
         self, group_id: str, member_id: str, generation_id: int,
         commits: dict[tuple[str, int], OffsetCommit],
+        *, trusted: bool = False,
     ) -> E:
         g = await self.get_or_create(group_id)
         if g is None:
             return E.not_coordinator
-        code = g.commit_offsets(member_id, generation_id, commits)
+        code = g.commit_offsets(member_id, generation_id, commits, trusted=trusted)
         if code == E.none and commits:
             records = [
                 Record(

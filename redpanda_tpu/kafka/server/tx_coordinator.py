@@ -311,7 +311,9 @@ class TxCoordinator:
             gm = self.broker.group_coordinator
             for group_id, commits in md.staged_offsets.items():
                 if commits:
-                    code = await gm.commit_offsets(group_id, "", -1, commits)
+                    code = await gm.commit_offsets(
+                        group_id, "", -1, commits, trusted=True
+                    )
                     if code != E.none:
                         return E.coordinator_not_available
         md.partitions.clear()

@@ -86,6 +86,12 @@ class SchemaStore:
         st = self.subjects.get(subject)
         return [v for v in st.versions if not v.deleted] if st else []
 
+    def all_versions(self, subject: str) -> list[SchemaVersion]:
+        """Every version including soft-deleted ones (version numbers are
+        allocated over this list so they are never reused)."""
+        st = self.subjects.get(subject)
+        return list(st.versions) if st else []
+
     def compatibility_of(self, subject: str) -> str:
         st = self.subjects.get(subject)
         return (st.compatibility if st and st.compatibility else None) or self.global_compatibility
@@ -111,8 +117,12 @@ class SchemaStore:
             raise IncompatibleSchema(
                 f"schema is not {level}-compatible with subject {subject}"
             )
-        live = self.live_versions(subject)
-        version = (live[-1].version + 1) if live else 1
+        # Version numbers are never reused (Confluent semantics): compute
+        # from ALL versions including soft-deleted ones, else a re-register
+        # after soft-deleting the latest would overwrite its tombstoned
+        # SCHEMA record key.
+        all_versions = self.all_versions(subject)
+        version = (max(v.version for v in all_versions) + 1) if all_versions else 1
         schema_id = self.next_id
         key = json.dumps(
             {"keytype": "SCHEMA", "subject": subject, "version": version},

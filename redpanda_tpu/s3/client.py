@@ -31,6 +31,26 @@ def _hmac(key: bytes, msg: str) -> bytes:
     return hmac.new(key, msg.encode(), hashlib.sha256).digest()
 
 
+def canonical_query_string(query: dict[str, str]) -> str:
+    """RFC3986-strict query encoding (space -> %20, nothing else safe).
+
+    Used both for signing AND for the request URL itself — the signature
+    only verifies if the server sees byte-identical encoding, so the client
+    must never re-encode through a different codec (urlencode's quote_plus
+    would turn spaces into '+': SignatureDoesNotMatch from real S3/minio).
+    """
+    return "&".join(
+        f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(str(v), safe='')}"
+        for k, v in sorted(query.items())
+    )
+
+
+def canonical_uri(path: str) -> str:
+    """Canonical URI: each segment URI-encoded, '/' preserved — the exact
+    string signed and sent."""
+    return urllib.parse.quote(path, safe="/")
+
+
 def sigv4_headers(
     method: str,
     host: str,
@@ -49,10 +69,7 @@ def sigv4_headers(
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     datestamp = now.strftime("%Y%m%d")
     payload_hash = hashlib.sha256(payload).hexdigest()
-    canonical_query = "&".join(
-        f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(str(v), safe='')}"
-        for k, v in sorted(query.items())
-    )
+    canonical_query = canonical_query_string(query)
     headers = {
         "host": host,
         "x-amz-content-sha256": payload_hash,
@@ -62,7 +79,7 @@ def sigv4_headers(
     canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in sorted(headers))
     canonical_request = "\n".join([
         method,
-        urllib.parse.quote(path),
+        canonical_uri(path),
         canonical_query,
         canonical_headers,
         signed_headers,
@@ -131,11 +148,17 @@ class S3Client:
             method, host, path, query, payload,
             self.access_key, self.secret_key, self.region,
         )
-        url = self.endpoint + path
+        # The URL carries the exact bytes that were signed (canonical URI +
+        # canonical query); yarl must not re-encode them (encoded=True).
+        url = self.endpoint + canonical_uri(path)
         if query:
-            url += "?" + urllib.parse.urlencode(sorted(query.items()))
+            url += "?" + canonical_query_string(query)
         sess = await self._sess()
-        async with sess.request(method, url, data=payload or None, headers=headers) as resp:
+        from yarl import URL
+
+        async with sess.request(
+            method, URL(url, encoded=True), data=payload or None, headers=headers
+        ) as resp:
             body = await resp.read()
             return resp.status, body
 

@@ -93,6 +93,17 @@ def make_credential(
     return ScramCredential(salt, server_key, stored_key, iterations, algo.name)
 
 
+def verify_password(cred: ScramCredential, password: str) -> bool:
+    """Check a plaintext password against a stored SCRAM verifier
+    (re-derive the client key with the stored salt/iterations and compare
+    H(client_key) to stored_key). Used by HTTP basic auth on the admin
+    API, where no SCRAM conversation happens."""
+    algo = SCRAM_SHA256 if cred.mechanism == SCRAM_SHA256.name else SCRAM_SHA512
+    salted = algo.hi(password.encode(), cred.salt, cred.iterations)
+    client_key = algo.hmac(salted, b"Client Key")
+    return hmac.compare_digest(algo.h(client_key), cred.stored_key)
+
+
 # Per-process seed for unknown-user dummy salts (stable within a broker's
 # lifetime so the same username always sees the same salt).
 _DUMMY_SALT_SEED = os.urandom(16)

@@ -2,23 +2,87 @@
 
 Mirrors cloud_storage/tests' s3_imposter fixture: an aiohttp server
 implementing path-style PUT/GET/DELETE object + ListObjectsV2 over an
-in-memory dict, so the whole archival stack runs hermetically.
+in-memory dict, so the whole archival stack runs hermetically. With
+``verify_creds`` set it acts as a real SigV4 verifier: it re-derives the
+canonical request from the raw wire bytes (the way S3/minio do) and 403s
+on mismatch — catching clients that sign one encoding and send another.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import urllib.parse
 import xml.sax.saxutils as sx
 
 from aiohttp import web
 
 
 class S3Imposter:
-    def __init__(self) -> None:
+    def __init__(self, verify_creds: tuple[str, str] | None = None) -> None:
         self.objects: dict[str, bytes] = {}  # "<bucket>/<key>" -> data
         self.requests: list[tuple[str, str]] = []  # (method, path)
         self.fail_next = 0  # inject N failures (500) for retry tests
+        self.verify_creds = verify_creds  # (access_key, secret_key)
+        self.auth_failures: list[str] = []
         self._runner: web.AppRunner | None = None
         self.port = 0
+
+    # ------------------------------------------------------------ sigv4 verify
+    def _check_signature(self, req: web.Request, payload: bytes) -> str | None:
+        """Returns an error string on auth failure, None when valid."""
+        access_key, secret_key = self.verify_creds
+        auth = req.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256 "):
+            return "missing or non-SigV4 auth header"
+        try:
+            parts = dict(
+                p.strip().split("=", 1) for p in auth[len("AWS4-HMAC-SHA256 "):].split(",")
+            )
+            credential = parts["Credential"]
+            signed_headers = parts["SignedHeaders"]
+            got_sig = parts["Signature"]
+            _ak, datestamp, region, service, _ = credential.split("/")
+        except Exception:
+            return "malformed auth header"
+        if _ak != access_key:
+            return "unknown access key"
+        raw = req.raw_path  # path?query exactly as sent
+        raw_path, _, raw_query = raw.partition("?")
+        # real verifiers decode then strictly re-encode each query pair
+        pairs = []
+        if raw_query:
+            for seg in raw_query.split("&"):
+                k, _, v = seg.partition("=")
+                pairs.append(
+                    (
+                        urllib.parse.quote(urllib.parse.unquote(k), safe=""),
+                        urllib.parse.quote(urllib.parse.unquote(v), safe=""),
+                    )
+                )
+        canonical_query = "&".join(f"{k}={v}" for k, v in sorted(pairs))
+        canonical_uri = urllib.parse.quote(urllib.parse.unquote(raw_path), safe="/")
+        headers = {h: req.headers.get(h, "") for h in signed_headers.split(";")}
+        canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in sorted(headers))
+        payload_hash = req.headers.get(
+            "x-amz-content-sha256", hashlib.sha256(payload).hexdigest()
+        )
+        canonical_request = "\n".join(
+            [req.method, canonical_uri, canonical_query, canonical_headers,
+             signed_headers, payload_hash]
+        )
+        scope = f"{datestamp}/{region}/{service}/aws4_request"
+        string_to_sign = "\n".join(
+            ["AWS4-HMAC-SHA256", req.headers.get("x-amz-date", ""), scope,
+             hashlib.sha256(canonical_request.encode()).hexdigest()]
+        )
+        key = f"AWS4{secret_key}".encode()
+        for msg in (datestamp, region, service, "aws4_request"):
+            key = hmac.new(key, msg.encode(), hashlib.sha256).digest()
+        want = hmac.new(key, string_to_sign.encode(), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, got_sig):
+            return f"SignatureDoesNotMatch for {raw}"
+        return None
 
     async def start(self) -> "S3Imposter":
         app = web.Application()
@@ -42,6 +106,12 @@ class S3Imposter:
     async def _handle(self, req: web.Request) -> web.Response:
         path = req.path.lstrip("/")
         self.requests.append((req.method, path))
+        if self.verify_creds is not None:
+            payload = await req.read()
+            err = self._check_signature(req, payload)
+            if err is not None:
+                self.auth_failures.append(err)
+                return web.Response(status=403, text=err)
         if self.fail_next > 0:
             self.fail_next -= 1
             return web.Response(status=500, text="injected")

@@ -233,3 +233,53 @@ def test_application_assembly_single_node(tmp_path):
             await app.stop()
 
     run(main())
+
+
+def test_admin_auth_token_and_basic(tmp_path):
+    """ADVICE round 1: the admin API can create superusers and arm failure
+    probes; with require_auth it must reject anonymous access (401) and
+    accept Bearer tokens or SCRAM-backed basic credentials. /metrics and
+    the readiness probe stay open for scrapers."""
+    async def main():
+        storage = await StorageApi(str(tmp_path)).start()
+        cfg = BrokerConfig(data_dir=str(tmp_path))
+        broker = Broker(cfg, storage)
+        from redpanda_tpu.security.scram import make_credential
+
+        broker.security.credentials.put("admin", make_credential("sekrit"))
+        admin = await AdminServer(
+            broker, port=0, require_auth=True, auth_token="tok123"
+        ).start()
+        base = f"http://127.0.0.1:{admin.port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(f"{base}/v1/brokers")
+                assert r.status == 401
+                assert r.headers.get("WWW-Authenticate", "").startswith("Basic")
+                r = await s.get(f"{base}/v1/status/ready")
+                assert r.status == 200  # probe stays open
+                r = await s.get(f"{base}/metrics")
+                assert r.status == 200  # scraper stays open
+                r = await s.get(
+                    f"{base}/v1/brokers", headers={"Authorization": "Bearer tok123"}
+                )
+                assert r.status == 200
+                r = await s.get(
+                    f"{base}/v1/brokers", headers={"Authorization": "Bearer nope"}
+                )
+                assert r.status == 401
+                r = await s.get(
+                    f"{base}/v1/brokers",
+                    auth=aiohttp.BasicAuth("admin", "sekrit"),
+                )
+                assert r.status == 200
+                r = await s.get(
+                    f"{base}/v1/brokers",
+                    auth=aiohttp.BasicAuth("admin", "wrong"),
+                )
+                assert r.status == 401
+        finally:
+            await admin.stop()
+            await storage.stop()
+
+    run(main())

@@ -294,3 +294,29 @@ def test_find_coordinator_and_group_topic(tmp_path):
         await _stop(server, broker, client)
 
     run(main())
+
+
+def test_simple_commit_rejected_on_live_group():
+    """ADVICE round 1: generation<0 commits (simple clients) are only legal
+    while the group is Empty (group.cc:1920); a live group's offsets must
+    not be overwritable by non-members. The tx coordinator's staged-offset
+    apply uses the internal trusted flag instead."""
+    async def main():
+        from redpanda_tpu.kafka.server.group import Group, GroupState, OffsetCommit
+        from redpanda_tpu.kafka.protocol.errors import ErrorCode as E
+
+        g = Group("g1", initial_rebalance_delay_s=0)
+        commits = {("t", 0): OffsetCommit(5)}
+        # Empty: accepted
+        assert g.commit_offsets("", -1, commits) == E.none
+        # Fake a live group
+        g.state = GroupState.stable
+        g.generation = 3
+        bad = {("t", 0): OffsetCommit(999)}
+        assert g.commit_offsets("", -1, bad) == E.illegal_generation
+        assert g.offsets[("t", 0)].offset == 5
+        # trusted path (tx coordinator) still lands
+        assert g.commit_offsets("", -1, bad, trusted=True) == E.none
+        assert g.offsets[("t", 0)].offset == 999
+
+    run(main())

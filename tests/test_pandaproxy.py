@@ -238,3 +238,29 @@ def test_schema_registry_survives_restart(tmp_path):
         await storage.stop()
 
     run(main())
+
+
+def test_schema_version_not_reused_after_soft_delete():
+    """ADVICE round 1: version numbers are never reused — registering after
+    soft-deleting the latest version must allocate version N+1, not N."""
+    from redpanda_tpu.pandaproxy.schema_registry.store import SchemaStore
+
+    s1 = '{"type":"record","name":"r","fields":[{"name":"a","type":"string"}]}'
+    s2 = '{"type":"record","name":"r","fields":[{"name":"a","type":"string"},{"name":"b","type":"string","default":"x"}]}'
+    s3 = '{"type":"record","name":"r","fields":[{"name":"a","type":"string"},{"name":"c","type":"string","default":"y"}]}'
+    store = SchemaStore()
+    for schema in (s1, s2):
+        records, _sid = store.register_records("s-value", schema)
+        for k, v in records:
+            store.apply(k, v)
+    assert [v.version for v in store.live_versions("s-value")] == [1, 2]
+    # soft-delete version 2
+    for k, v in store.delete_subject_records("s-value")[-1:]:
+        store.apply(k, v)
+    assert [v.version for v in store.live_versions("s-value")] == [1]
+    records, _sid = store.register_records("s-value", s3)
+    for k, v in records:
+        store.apply(k, v)
+    vs = store.live_versions("s-value")
+    assert vs[-1].version == 3  # not 2: tombstoned version number stays dead
+    assert [v.version for v in store.all_versions("s-value")] == [1, 2, 3]
