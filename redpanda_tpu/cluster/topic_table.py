@@ -25,6 +25,7 @@ class TopicConfig:
     cleanup_policy: str = "delete"
     retention_bytes: int | None = None
     retention_ms: int | None = None
+    delete_retention_ms: int | None = None  # tombstone retention (compact)
     segment_size: int | None = None
     compression: str = "producer"
     # incarnation id: bumped on recreate so tiered-storage object paths
@@ -45,6 +46,10 @@ class TopicConfig:
             overrides["retention_bytes"] = self.retention_bytes
         if self.retention_ms is not None and self.retention_ms >= 0:
             overrides["retention_ms"] = self.retention_ms
+        if self.cleanup_policy != "delete":
+            overrides["cleanup_policy"] = self.cleanup_policy
+        if self.delete_retention_ms is not None:
+            overrides["delete_retention_ms"] = self.delete_retention_ms
         return dataclasses.replace(base, **overrides) if overrides else None
 
     def apply_override(self, key: str, value: str | None) -> None:
@@ -58,6 +63,8 @@ class TopicConfig:
             self.retention_bytes = int(value)
         elif key == "retention.ms":
             self.retention_ms = int(value)
+        elif key == "delete.retention.ms":
+            self.delete_retention_ms = int(value)
         elif key == "segment.bytes":
             self.segment_size = int(value)
         elif key == "compression.type":

@@ -34,6 +34,10 @@ class LogConfig:
     retention_ms: int | None = None
     fsync_on_append: bool = False
     use_device_recovery: bool = False  # batch CRC scan on the TPU
+    # cleanup.policy: "delete", "compact", or "compact,delete"
+    cleanup_policy: str = "delete"
+    delete_retention_ms: int | None = 86_400_000  # tombstone retention
+    compaction_max_keys_in_memory: int = 128 * 1024  # key-index spill bound
 
 
 @dataclass
@@ -281,6 +285,27 @@ class DiskLog:
             ):
                 self.segments.pop(0).remove()
             self._start_offset = max(self._start_offset, offset)
+
+    # ------------------------------------------------------------ compaction
+    @property
+    def is_compacted(self) -> bool:
+        return "compact" in self.config.cleanup_policy
+
+    async def compact(self) -> tuple[int, int]:
+        """Self-compact all closed segments (storage/compaction.py); no-op
+        until new data has arrived since the previous pass."""
+        offs = self.offsets()
+        if getattr(self, "_compacted_through", None) == offs.dirty_offset:
+            return 0, 0
+        from redpanda_tpu.storage.compaction import compact_log
+
+        result = await compact_log(
+            self,
+            delete_retention_ms=self.config.delete_retention_ms,
+            max_keys_in_memory=self.config.compaction_max_keys_in_memory,
+        )
+        self._compacted_through = offs.dirty_offset
+        return result
 
     # ------------------------------------------------------------ retention
     async def apply_retention(self):

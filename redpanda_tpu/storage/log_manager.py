@@ -20,6 +20,7 @@ class LogManager:
         self.config = config
         self._logs: dict[NTP, DiskLog] = {}
         self._housekeeping_task: asyncio.Task | None = None
+        self._compaction_task: asyncio.Task | None = None
 
     async def manage(self, ntp: NTP, *, overrides: LogConfig | None = None) -> DiskLog:
         if ntp in self._logs:
@@ -44,25 +45,53 @@ class LogManager:
         if log:
             await log.remove()
 
-    async def start_housekeeping(self, interval_s: float = 10.0):
+    async def start_housekeeping(
+        self, interval_s: float = 10.0, compaction_interval_s: float | None = None
+    ):
+        """Retention + compaction fibers (log_manager housekeeping; the
+        compaction cadence mirrors log_compaction_interval_ms)."""
+        compaction_interval_s = (
+            compaction_interval_s if compaction_interval_s is not None else interval_s
+        )
+
+        async def housekeep_once(log) -> None:
+            policy = log.config.cleanup_policy
+            if "delete" in policy:
+                await log.apply_retention()
+
         async def loop():
             while True:
                 await asyncio.sleep(interval_s)
                 for log in list(self._logs.values()):
                     try:
-                        await log.apply_retention()
+                        await housekeep_once(log)
+                    except Exception:
+                        pass
+
+        async def compaction_loop():
+            while True:
+                await asyncio.sleep(compaction_interval_s)
+                for log in list(self._logs.values()):
+                    if not log.is_compacted:
+                        continue
+                    try:
+                        await log.compact()
                     except Exception:
                         pass
 
         self._housekeeping_task = asyncio.create_task(loop())
+        self._compaction_task = asyncio.create_task(compaction_loop())
 
     async def stop(self):
-        if self._housekeeping_task:
-            self._housekeeping_task.cancel()
-            try:
-                await self._housekeeping_task
-            except asyncio.CancelledError:
-                pass
+        for task_attr in ("_housekeeping_task", "_compaction_task"):
+            task = getattr(self, task_attr, None)
+            if task:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                setattr(self, task_attr, None)
         for log in self._logs.values():
             await log.close()
         self._logs.clear()

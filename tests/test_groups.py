@@ -320,3 +320,61 @@ def test_simple_commit_rejected_on_live_group():
         assert g.offsets[("t", 0)].offset == 999
 
     run(main())
+
+
+def test_group_topic_compaction_shrinks_and_replays(tmp_path):
+    """VERDICT round 1 acceptance: a group topic with many commits for the
+    same key compacts down to live keys only, and a restart replays the
+    compacted log to the correct offsets."""
+    async def main():
+        from redpanda_tpu.models.fundamental import NTP
+        from redpanda_tpu.kafka.server.group_manager import GROUP_TOPIC
+
+        broker, server = await _start_broker(tmp_path)
+        client = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        await client.create_topic("pt", partitions=1)
+        await client.produce("pt", 0, [b"x"])
+        conn = await client.any_connection()
+        for committed in range(1, 201):  # 200 commits, same (group, tp) key
+            resp = await conn.request(m.OFFSET_COMMIT, {
+                "group_id": "g-compact", "generation_id": -1, "member_id": "",
+                "group_instance_id": None, "retention_time_ms": -1,
+                "topics": [{"name": "pt", "partitions": [
+                    {"partition_index": 0, "committed_offset": committed,
+                     "committed_leader_epoch": -1, "committed_metadata": None}]}],
+            })
+            assert resp["topics"][0]["partitions"][0]["error_code"] == 0
+
+        # find the group-topic partition holding this group and compact it
+        logs = [
+            log for ntp, log in broker.storage.log_mgr.logs().items()
+            if ntp.topic == GROUP_TOPIC
+        ]
+        glogs = [log for log in logs if log.offsets().dirty_offset >= 0]
+        assert glogs, "group topic has no data"
+        glog = max(glogs, key=lambda l: l.offsets().dirty_offset)
+        # roll the active segment so commits become compactible, then compact
+        async with glog._lock:
+            glog.segments[-1].release_appender()
+        before, after = await glog.compact()
+        assert after < before, (before, after)
+        # only the live key survives in the closed segments
+        n_records = sum(
+            b.header.record_count for b in await glog.read(0, 1 << 30)
+        )
+        assert n_records <= 2  # latest commit (+ maybe group metadata)
+        await _stop(server, broker, client)
+
+        # restart: replay of the compacted log yields the last commit
+        broker2, server2 = await _start_broker(tmp_path)
+        client2 = await KafkaClient([("127.0.0.1", server2.port)]).connect()
+        conn2 = await client2.any_connection()
+        resp = await conn2.request(m.OFFSET_FETCH, {
+            "group_id": "g-compact",
+            "topics": [{"name": "pt", "partition_indexes": [0]}],
+        })
+        p0 = resp["topics"][0]["partitions"][0]
+        assert p0["committed_offset"] == 200
+        await _stop(server2, broker2, client2)
+
+    run(main())

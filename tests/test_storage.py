@@ -426,3 +426,135 @@ def test_opfuzz_random_interleaving(tmp_path):
         await log.close()
 
     _run(main())
+
+
+# ------------------------------------------------------------------ compaction
+def _kv_batch(pairs, ts=0):
+    """pairs: [(key, value-or-None)]"""
+    recs = [
+        Record(offset_delta=i, timestamp_delta=i, key=k, value=v)
+        for i, (k, v) in enumerate(pairs)
+    ]
+    return RecordBatch.build(recs, first_timestamp=ts, max_timestamp=ts)
+
+
+def _kv_view(batches):
+    """Materialize key->value last-write-wins from read batches."""
+    out = {}
+    for b in batches:
+        for r in b.records():
+            out[r.key] = r.value
+    return out
+
+
+def test_compaction_last_value_wins(ntp, cfg):
+    async def main():
+        cfg.cleanup_policy = "compact"
+        cfg.max_segment_size = 400  # force frequent rolls
+        log = await DiskLog.open(ntp, cfg)
+        for round_ in range(6):
+            await log.append(
+                [_kv_batch([(b"k%d" % i, b"v%d-%d" % (i, round_)) for i in range(4)])]
+            )
+        before_bytes = sum(s.size_bytes for s in log.segments)
+        dirty_before = log.offsets().dirty_offset
+        b_before, b_after = await log.compact()
+        assert b_after < b_before
+        # offsets unchanged, replay sees only the latest values
+        assert log.offsets().dirty_offset == dirty_before
+        view = _kv_view(await log.read(0, 1 << 30))
+        assert view == {b"k%d" % i: b"v%d-5" % i for i in range(4)}
+        # surviving records keep their ORIGINAL absolute offsets
+        for b in await log.read(0, 1 << 30):
+            for r in b.records():
+                assert b.base_offset + r.offset_delta <= dirty_before
+        await log.close()
+
+    _run(main())
+
+
+def test_compaction_preserves_offsets_across_restart(ntp, cfg):
+    async def main():
+        cfg.cleanup_policy = "compact"
+        cfg.max_segment_size = 300
+        log = await DiskLog.open(ntp, cfg)
+        # same single key over and over: closed segments become fully shadowed
+        for i in range(8):
+            await log.append([_kv_batch([(b"k", b"v%d" % i)])])
+        dirty = log.offsets().dirty_offset
+        await log.compact()
+        assert log.offsets().dirty_offset == dirty  # empty final batches kept
+        r = await log.append([_kv_batch([(b"k2", b"x")])])
+        assert r.base_offset == dirty + 1  # no offset reuse after compaction
+        await log.close()
+        # restart: recovery replays the compacted segments cleanly
+        log2 = await DiskLog.open(ntp, cfg)
+        assert log2.offsets().dirty_offset == dirty + 1
+        view = _kv_view(await log2.read(0, 1 << 30))
+        assert view == {b"k": b"v7", b"k2": b"x"}
+        await log2.close()
+
+    _run(main())
+
+
+def test_compaction_tombstones(ntp, cfg):
+    async def main():
+        cfg.cleanup_policy = "compact"
+        cfg.max_segment_size = 1  # roll after every batch: all but tail closed
+        log = await DiskLog.open(ntp, cfg)
+        now_ms = 1_700_000_000_000
+        await log.append([_kv_batch([(b"a", b"1"), (b"b", b"2")], ts=now_ms)])
+        await log.append([_kv_batch([(b"a", None)], ts=now_ms + 1)])  # tombstone
+        await log.append([_kv_batch([(b"c", b"3")], ts=now_ms + 2)])
+        # retention window still open: tombstone survives, shadows a=1
+        cfg.delete_retention_ms = 10**15
+        await log.compact()
+        view = _kv_view(await log.read(0, 1 << 30))
+        assert view == {b"a": None, b"b": b"2", b"c": b"3"}
+        # window closed: tombstone itself is removed
+        cfg.delete_retention_ms = 0
+        log._compacted_through = None
+        await log.compact()
+        view = _kv_view(await log.read(0, 1 << 30))
+        assert view == {b"b": b"2", b"c": b"3"}
+        await log.close()
+
+    _run(main())
+
+
+def test_compaction_key_index_spills(ntp, cfg):
+    async def main():
+        from redpanda_tpu.storage.compaction import build_key_index
+
+        cfg.cleanup_policy = "compact"
+        cfg.max_segment_size = 4096
+        log = await DiskLog.open(ntp, cfg)
+        for chunk in range(10):
+            pairs = [(b"key-%04d" % (chunk * 50 + i), b"v") for i in range(50)]
+            await log.append([_kv_batch(pairs)])
+        idx = build_key_index(log.segments, max_keys_in_memory=64)  # force spill
+        assert len(idx) == 500
+        assert idx[b"key-0000"] == 0 and idx[b"key-0499"] == 499
+        await log.close()
+
+    _run(main())
+
+
+def test_compaction_keeps_non_data_batches(ntp, cfg):
+    async def main():
+        cfg.cleanup_policy = "compact"
+        cfg.max_segment_size = 1  # roll after every batch
+        log = await DiskLog.open(ntp, cfg)
+        await log.append([_kv_batch([(b"k", b"old")])])
+        await log.append([_batch(1, type=RecordBatchType.raft_configuration)])
+        await log.append([_kv_batch([(b"k", b"new")])])
+        await log.append([_kv_batch([(b"z", b"tail")])])
+        await log.compact()
+        batches = await log.read(0, 1 << 30)
+        types = [b.header.type for b in batches]
+        assert RecordBatchType.raft_configuration in types
+        view = _kv_view([b for b in batches if b.header.type == RecordBatchType.raft_data])
+        assert view[b"k"] == b"new"
+        await log.close()
+
+    _run(main())
