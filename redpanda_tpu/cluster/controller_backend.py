@@ -140,7 +140,8 @@ class ControllerBackend:
             c = await self.gm.create_group(
                 pa.group, ntp, voters, log_overrides=overrides
             )
-            self.pm.attach(ntp, Partition(ntp, c, c.log))
+            p = await Partition(ntp, c, c.log, kvs=self.pm.storage.kvs).start()
+            self.pm.attach(ntp, p)
 
     async def _remove_local(self, ntp: NTP) -> None:
         t = self._move_tasks.pop(ntp, None)
@@ -172,7 +173,8 @@ class ControllerBackend:
                 c = await self.gm.create_group(
                     pa.group, ntp, voters, log_overrides=self._log_overrides(ntp)
                 )
-                self.pm.attach(ntp, Partition(ntp, c, c.log))
+                p = await Partition(ntp, c, c.log, kvs=self.pm.storage.kvs).start()
+                self.pm.attach(ntp, p)
         # 2. current leader: run the joint-consensus change + finish
         c = self.gm.consensus_for(pa.group)
         if c is not None and c.is_leader() and ntp not in self._move_tasks:

@@ -74,23 +74,43 @@ class DirectConsensus:
         return ReplicateResult(res.base_offset, res.last_offset)
 
     async def make_reader(
-        self, start: int, max_bytes: int, max_offset: int | None = None
+        self, start: int, max_bytes: int, max_offset: int | None = None, type_filter=None
     ) -> list[RecordBatch]:
         return await self.log.read(
             start,
             max_bytes,
             max_offset=max_offset,
-            type_filter=(RecordBatchType.raft_data,),
+            type_filter=type_filter,
         )
 
 
 class Partition:
-    """Broker-facing partition handle (cluster/partition.h:34)."""
+    """Broker-facing partition handle (cluster/partition.h:34).
 
-    def __init__(self, ntp: NTP, consensus, log: DiskLog):
+    Every offset crossing this boundary is a KAFKA offset: raft config
+    batches occupy raw log offsets that clients must never see
+    (offset_translator.h:11-26), so produce results, reader start/limits,
+    watermarks, and fetched batch base offsets are all translated here.
+    Raft and storage below this line speak raw log offsets.
+    """
+
+    def __init__(self, ntp: NTP, consensus, log: DiskLog, kvs=None):
+        from redpanda_tpu.cluster.offset_translator import OffsetTranslator
+
         self.ntp = ntp
         self.consensus = consensus
         self.log = log
+        self.otl = OffsetTranslator(ntp, kvs)
+        log.append_listeners.append(self.otl.observe)
+        log.truncate_listeners.append(self.otl.truncate)
+        self._otl_ready = False
+
+    async def start(self) -> "Partition":
+        """Bootstrap the offset translator from kvstore + log scan."""
+        if not self._otl_ready:
+            await self.otl.bootstrap(self.log)
+            self._otl_ready = True
+        return self
 
     # -------------------------------------------------------------- state
     def is_leader(self) -> bool:
@@ -106,35 +126,63 @@ class Partition:
 
     @property
     def start_offset(self) -> int:
-        return self.consensus.start_offset
+        return self.otl.to_kafka_excl(self.consensus.start_offset)
 
     @property
     def high_watermark(self) -> int:
         """Exclusive next-offset convention, like kafka HWM."""
-        return self.consensus.committed_offset + 1
+        return self.otl.to_kafka_excl(self.consensus.committed_offset + 1)
 
     @property
     def last_stable_offset(self) -> int:
-        return self.consensus.last_stable_offset
+        return self.otl.to_kafka_excl(self.consensus.last_stable_offset)
 
     # -------------------------------------------------------------- io
     async def replicate(self, batches: list[RecordBatch], level: int) -> ReplicateResult:
-        return await self.consensus.replicate(batches, level)
+        res = await self.consensus.replicate(batches, level)
+        base = getattr(res, "base_offset", None)
+        if base is None:
+            # raft's ReplicateResult carries only last_offset; offsets are
+            # assigned contiguously, so the base falls out of the span
+            span = sum(b.header.last_offset_delta + 1 for b in batches)
+            base = res.last_offset - span + 1
+        return ReplicateResult(
+            self.otl.to_kafka(base), self.otl.to_kafka(res.last_offset)
+        )
 
     async def make_reader(
         self, start: int, max_bytes: int = 1 << 20, max_offset: int | None = None
     ) -> list[RecordBatch]:
+        """Read data batches in [start, max_offset] (kafka domain), re-based
+        into kafka offsets. Safe to rewrite base_offset: the Kafka CRC
+        covers attributes..records only."""
         if max_offset is None:
             max_offset = self.high_watermark - 1
         if start > max_offset:
             return []
-        return await self.consensus.make_reader(start, max_bytes, max_offset)
+        raft_start = self.otl.from_kafka(start)
+        raft_max = self.otl.from_kafka(max_offset)
+        batches = await self.consensus.make_reader(
+            raft_start,
+            max_bytes,
+            max_offset=raft_max,
+            type_filter=(RecordBatchType.raft_data,),
+        )
+        out = []
+        for b in batches:
+            k = self.otl.to_kafka(b.base_offset)
+            out.append(b.with_base_offset(k) if k != b.base_offset else b)
+        return out
 
     async def timequery(self, ts: int) -> int | None:
-        return await self.log.timequery(ts)
+        raft_off = await self.log.timequery(ts)
+        return None if raft_off is None else self.otl.to_kafka(raft_off)
 
     async def prefix_truncate(self, offset: int) -> None:
-        await self.log.prefix_truncate(offset)
+        """offset is a kafka offset (DeleteRecords / archival housekeeping)."""
+        raft_off = self.otl.from_kafka(offset)
+        await self.log.prefix_truncate(raft_off)
+        self.otl.advance_base(raft_off)
 
 
 class PartitionManager:
@@ -151,7 +199,7 @@ class PartitionManager:
             return self._partitions[ntp]
         log = await self.storage.log_mgr.manage(ntp, overrides=log_overrides)
         consensus = DirectConsensus(log, self.node_id, term)
-        p = Partition(ntp, consensus, log)
+        p = await Partition(ntp, consensus, log, kvs=self.storage.kvs).start()
         self._partitions[ntp] = p
         return p
 

@@ -152,6 +152,17 @@ class Consensus:
     def flushed_offset(self) -> int:
         return self.log.offsets().committed_offset
 
+    # Partition-facade accessors (cluster::partition delegates here; the
+    # same names DirectConsensus exposes — raw log offsets, pre-translation)
+    @property
+    def committed_offset(self) -> int:
+        return self._commit_index
+
+    @property
+    def last_stable_offset(self) -> int:
+        """Exclusive; tx-aware LSO clamping happens in rm_stm above."""
+        return self._commit_index + 1
+
     @property
     def start_offset(self) -> int:
         return self.log.offsets().start_offset
@@ -803,13 +814,23 @@ class Consensus:
                 del self._followers[fid]
 
     # ---------------------------------------------------------------- reads
-    async def make_reader(self, start_offset: int, max_bytes: int = 1 << 20, type_filter=None):
+    async def make_reader(
+        self,
+        start_offset: int,
+        max_bytes: int = 1 << 20,
+        max_offset: int | None = None,
+        type_filter=None,
+    ):
         """Committed reads only (partition::make_reader clamps to
-        committed/LSO — partition.h:65)."""
+        committed/LSO — partition.h:65). max_offset is a raw log offset,
+        further clamped to the commit index."""
         if self._commit_index < start_offset:
             return []
+        limit = self._commit_index
+        if max_offset is not None:
+            limit = min(limit, max_offset)
         r = self.log.read(
-            start_offset, max_bytes, max_offset=self._commit_index, type_filter=type_filter
+            start_offset, max_bytes, max_offset=limit, type_filter=type_filter
         )
         if asyncio.iscoroutine(r):
             r = await r

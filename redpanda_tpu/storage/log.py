@@ -65,6 +65,10 @@ class DiskLog:
         self._active_created_at = 0.0
         self._lock = asyncio.Lock()
         self._term = 0
+        # sync callables (type, base_offset, last_offset) fired per appended
+        # batch under the log lock; truncation listeners get (offset)
+        self.append_listeners: list = []
+        self.truncate_listeners: list = []
 
     # ------------------------------------------------------------ lifecycle
     @classmethod
@@ -153,6 +157,8 @@ class DiskLog:
                 seg.append(batch)
                 size += batch.size_bytes
                 next_offset = batch.last_offset + 1
+                for fn in self.append_listeners:
+                    fn(batch.header.type, batch.base_offset, batch.last_offset)
             if self.config.fsync_on_append:
                 seg.fsync()
                 self._committed = seg.dirty_offset
@@ -276,6 +282,8 @@ class DiskLog:
                 keep.append(seg)
             self.segments = keep
             self._committed = min(self._committed, self.offsets().dirty_offset)
+            for fn in self.truncate_listeners:
+                fn(offset)
 
     async def prefix_truncate(self, offset: int):
         """Evict whole segments below `offset` (retention / raft snapshot)."""

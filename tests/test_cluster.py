@@ -470,3 +470,61 @@ def test_shard_table_stable_and_grouped():
     assert len(groups) > 1  # spreads
     st.update(ntps[0], 3)
     assert st.shard_for(ntps[0]) == 3
+
+
+def test_offsets_gap_free_across_leadership_transfers(tmp_path):
+    """VERDICT round-1 acceptance for offset translation: force leadership
+    changes mid-produce (each election/config change appends non-data
+    batches to the raft log) and assert the Kafka-visible offsets stay
+    contiguous from 0 with no client-visible gaps."""
+    async def main():
+        fx = await ClusterFixture(tmp_path, 3).start()
+        try:
+            leader = fx.controller_leader()
+            await leader.controller.create_topic(
+                TopicConfig("gapless", partition_count=1, replication_factor=3)
+            )
+            ntp = NTP.kafka("gapless", 0)
+            await fx.wait_converged(
+                lambda n: n.pm.get(ntp) is not None, msg="partition everywhere"
+            )
+
+            def part_leader():
+                for n in fx.nodes:
+                    p = n.pm.get(ntp)
+                    if p is not None and p.is_leader():
+                        return n
+                return None
+
+            total = 0
+            for round_ in range(3):
+                await wait_until(lambda: part_leader() is not None, msg="leader")
+                ln = part_leader()
+                p = ln.pm.get(ntp)
+                for i in range(4):
+                    res = await p.replicate(
+                        [data_batch(b"r%d-%d" % (round_, i))],
+                        ConsistencyLevel.quorum_ack,
+                    )
+                    # produce responses are kafka offsets: strictly contiguous
+                    assert res.base_offset == total, (res, total)
+                    total += 1
+                if round_ < 2:  # transfer leadership -> config/election churn
+                    ok = await p.consensus.do_transfer_leadership()
+                    assert ok
+                    await asyncio.sleep(0.2)
+
+            await wait_until(lambda: part_leader() is not None, msg="final leader")
+            p = part_leader().pm.get(ntp)
+            # the raft log genuinely contains non-data batches...
+            assert p.otl.total_delta() > 0, "test exercised no config batches"
+            # ...but consumers see contiguous offsets 0..total-1
+            await wait_until(lambda: p.high_watermark >= total, msg="hwm catchup")
+            batches = await p.make_reader(0, 1 << 30)
+            offsets = [b.base_offset + r.offset_delta for b in batches for r in b.records()]
+            assert offsets == list(range(total)), offsets
+            assert p.high_watermark == total
+        finally:
+            await fx.stop()
+
+    run(main())
