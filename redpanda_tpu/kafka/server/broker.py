@@ -30,6 +30,12 @@ class BrokerConfig:
     fetch_poll_interval_s: float = 0.02
     sasl_enabled: bool = False
     superusers: list = field(default_factory=list)
+    # client quotas (quota_manager.h): bytes/s per client-id, None=unlimited
+    target_quota_byte_rate: int | None = None
+    target_fetch_quota_byte_rate: int | None = None
+    # produce-path memory gate (connection_context.cc:32 memory units)
+    kafka_request_max_memory: int = 64 * 1024 * 1024
+    fetch_session_cache_size: int = 1000
 
 
 class Broker:
@@ -47,7 +53,14 @@ class Broker:
 
         self.tx_coordinator = TxCoordinator(self)
         self._rm_stms: dict = {}  # NTP -> RmStm
-        self.quota_manager = None
+        from redpanda_tpu.kafka.server.fetch_session_cache import FetchSessionCache
+        from redpanda_tpu.kafka.server.quota_manager import QuotaManager
+
+        self.quota_manager = QuotaManager(
+            produce_rate=config.target_quota_byte_rate,
+            fetch_rate=config.target_fetch_quota_byte_rate,
+        )
+        self.fetch_sessions = FetchSessionCache(config.fetch_session_cache_size)
         self.controller_dispatcher = None  # multi-node: routes security/topic cmds
         # SCRAM credentials + ACLs; cluster-replicated when a controller is
         # attached, applied locally otherwise (single-node mode)

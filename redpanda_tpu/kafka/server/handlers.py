@@ -195,9 +195,15 @@ async def handle_produce(ctx) -> dict | None:
             )
         )
         responses.append({"name": t["name"], "partitions": list(parts)})
+    n_bytes = sum(
+        len(p.get("records") or b"")
+        for t in ctx.request["topics"]
+        for p in t["partitions"]
+    )
+    throttle = ctx.broker.quota_manager.record_produce(ctx.header.client_id, n_bytes)
     if acks == 0:
         return None
-    return {"responses": responses, "throttle_time_ms": 0}
+    return {"responses": responses, "throttle_time_ms": throttle}
 
 
 def _produce_partition_error(index: int, code: ErrorCode) -> dict:
@@ -264,14 +270,26 @@ async def _produce_one(broker, topic: str, p: dict, level: int) -> dict:
 
 # ---------------------------------------------------------------- fetch
 async def handle_fetch(ctx) -> dict:
+    from redpanda_tpu.kafka.server.fetch_session_cache import resolve_session
+
     req = ctx.request
+    # Incremental fetch sessions (KIP-227): the session supplies the full
+    # partition set when the request only carries changes.
+    session, topics, sess_err = resolve_session(ctx.broker.fetch_sessions, req)
+    if sess_err != E.none:
+        return {
+            "throttle_time_ms": 0,
+            "error_code": int(sess_err),
+            "session_id": 0,
+            "responses": [],
+        }
     max_wait_ms = req.get("max_wait_ms", 0)
     min_bytes = max(req.get("min_bytes", 0), 0)
     max_bytes = req.get("max_bytes", 0x7FFFFFFF)
     deadline = time.monotonic() + max(max_wait_ms, 0) / 1000.0
     poll = ctx.broker.config.fetch_poll_interval_s
     while True:
-        responses, total, any_error = await _fetch_once(ctx, max_bytes)
+        responses, total, any_error = await _fetch_once(ctx, topics, max_bytes)
         # respond immediately on any partition error (kafka semantics) or
         # once min_bytes is satisfied / the wait budget is spent
         if any_error or total >= min_bytes or time.monotonic() >= deadline:
@@ -279,34 +297,37 @@ async def handle_fetch(ctx) -> dict:
         # Long-poll gate: re-reading and re-encoding every poll tick is
         # wasted work — only rerun _fetch_once after some requested
         # partition's high watermark advances.
-        hwms = _fetch_hwm_snapshot(ctx)
+        hwms = _fetch_hwm_snapshot(ctx, topics)
         while time.monotonic() < deadline:
             await asyncio.sleep(min(poll, max(deadline - time.monotonic(), 0)))
-            if _fetch_hwm_snapshot(ctx) != hwms:
+            if _fetch_hwm_snapshot(ctx, topics) != hwms:
                 break
-    out = {"responses": responses}
+    throttle = ctx.broker.quota_manager.record_fetch(ctx.header.client_id, total)
+    if session is not None:
+        responses = session.prune_response(responses)
+    out = {"responses": responses, "throttle_time_ms": throttle}
     if ctx.api_version >= 7:
         out["error_code"] = 0
-        out["session_id"] = req.get("session_id", 0)
+        out["session_id"] = session.session_id if session is not None else 0
     return out
 
 
-def _fetch_hwm_snapshot(ctx) -> tuple:
+def _fetch_hwm_snapshot(ctx, topics) -> tuple:
     out = []
-    for t in ctx.request.get("topics") or []:
+    for t in topics:
         for p in t["partitions"]:
             part = ctx.broker.get_partition(t["name"], p["partition_index"])
             out.append(part.high_watermark if part is not None else -1)
     return tuple(out)
 
 
-async def _fetch_once(ctx, max_bytes: int) -> tuple[list, int, bool]:
+async def _fetch_once(ctx, topics, max_bytes: int) -> tuple[list, int, bool]:
     broker = ctx.broker
     responses = []
     total = 0
     any_error = False
     budget = max_bytes
-    for t in ctx.request.get("topics") or []:
+    for t in topics:
         parts = []
         if not _authorized(ctx, AclOperation.read, t["name"]):
             responses.append({

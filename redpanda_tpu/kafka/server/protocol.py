@@ -66,13 +66,15 @@ class Connection:
         # requests are in flight on this connection.
         self._responses: asyncio.Queue[asyncio.Task | None] = asyncio.Queue(maxsize=MAX_PIPELINE)
         self._handler_tasks: set[asyncio.Task] = set()
+        # memory-gate reservations held by in-flight requests
+        self._reserved: dict[object, int] = {}
 
     async def run(self) -> None:
         writer_task = asyncio.create_task(self._drain_responses())
         cancelled = False
         try:
             while True:
-                frame = await self._read_frame()
+                frame, reserved = await self._read_frame()
                 if frame is None:
                     break
                 # Staged pipelining: decode synchronously here so wire order
@@ -81,15 +83,18 @@ class Connection:
                 # fiber drains responses strictly in request order.
                 decoded = self._decode_frame(frame)
                 if decoded is None:
+                    self._release(reserved)
                     break  # fatal protocol error: close the connection
                 if isinstance(decoded, bytes):
                     done: asyncio.Future = asyncio.get_running_loop().create_future()
                     done.set_result(decoded)
+                    self._reserved[done] = reserved
                     await self._responses.put(done)
                 else:
                     task = asyncio.create_task(self._dispatch(*decoded))
                     self._handler_tasks.add(task)
                     task.add_done_callback(self._handler_tasks.discard)
+                    self._reserved[task] = reserved
                     await self._responses.put(task)
         except asyncio.CancelledError:
             cancelled = True
@@ -107,24 +112,37 @@ class Connection:
                 await writer_task
             if self._handler_tasks:
                 await asyncio.gather(*self._handler_tasks, return_exceptions=True)
+            for reserved in self._reserved.values():
+                self._release(reserved)
+            self._reserved.clear()
             self.writer.close()
             try:
                 await self.writer.wait_closed()
             except (ConnectionError, OSError, asyncio.CancelledError):
                 pass
 
-    async def _read_frame(self) -> bytes | None:
+    async def _read_frame(self) -> tuple[bytes | None, int]:
         try:
             size_buf = await self.reader.readexactly(4)
         except (asyncio.IncompleteReadError, ConnectionError):
-            return None
+            return None, 0
         (size,) = struct.unpack(">i", size_buf)
         if size < 0 or size > MAX_REQUEST_SIZE:
             raise ValueError(f"invalid frame size {size}")
+        # Memory gate (connection_context.cc:32): reserve the frame size
+        # BEFORE reading the body; a flood of large requests backpressures
+        # here instead of ballooning the heap. Released when the response
+        # drains (or the connection dies).
+        reserved = await self.server.memory.acquire(size)
         try:
-            return await self.reader.readexactly(size)
+            return await self.reader.readexactly(size), reserved
         except (asyncio.IncompleteReadError, ConnectionError):
-            return None
+            self._release(reserved)
+            return None, 0
+
+    def _release(self, reserved: int) -> None:
+        if reserved:
+            self.server.memory.release(reserved)
 
     def _decode_frame(self, frame: bytes):
         """Synchronous decode: returns a prebuilt error response (bytes) or
@@ -233,11 +251,14 @@ class Connection:
                 payload = await task
             except asyncio.CancelledError:
                 if isinstance(task, asyncio.Task) and task.cancelled():
+                    self._release(self._reserved.pop(task, 0))
                     continue  # the handler was cancelled, not this fiber
                 raise
             except Exception:
                 logger.exception("response task failed")
+                self._release(self._reserved.pop(task, 0))
                 continue
+            self._release(self._reserved.pop(task, 0))
             if payload is None:
                 continue
             try:
@@ -264,6 +285,9 @@ class KafkaServer:
 
         gh.register_group_handlers(self.handlers)
         th.register_tx_handlers(self.handlers)
+        from redpanda_tpu.resource_mgmt import MemoryBudget
+
+        self.memory = MemoryBudget(broker.config.kafka_request_max_memory)
         self._server: asyncio.AbstractServer | None = None
         self._conn_tasks: set[asyncio.Task] = set()
 

@@ -1,0 +1,230 @@
+"""Client quotas, incremental fetch sessions, and the produce-path memory
+gate (quota_manager.h, fetch_session_cache.h, connection_context.cc:32)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from redpanda_tpu.kafka.client.client import KafkaClient
+from redpanda_tpu.kafka.protocol import messages as m
+from redpanda_tpu.kafka.server.broker import Broker, BrokerConfig
+from redpanda_tpu.kafka.server.protocol import KafkaServer
+from redpanda_tpu.storage.log_manager import StorageApi
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _start_broker(tmp_path, **kw):
+    storage = await StorageApi(str(tmp_path)).start()
+    cfg = BrokerConfig(data_dir=str(tmp_path), **kw)
+    broker = Broker(cfg, storage)
+    server = await KafkaServer(broker, "127.0.0.1", 0).start()
+    cfg.advertised_port = server.port
+    return broker, server
+
+
+async def _stop(server, broker, *clients):
+    for c in clients:
+        await c.close()
+    await server.stop()
+    await broker.storage.stop()
+
+
+# ------------------------------------------------------------------ quotas
+def test_quota_manager_throttles_over_rate():
+    from redpanda_tpu.kafka.server.quota_manager import QuotaManager
+
+    qm = QuotaManager(produce_rate=1000, burst_seconds=1.0)
+    # within burst: no throttle
+    assert qm.record_produce("c1", 500) == 0
+    # blow through the bucket: throttle proportional to the deficit
+    t = qm.record_produce("c1", 2500)
+    assert 1500 <= t <= 2500
+    # other clients are unaffected
+    assert qm.record_produce("c2", 500) == 0
+    # unlimited manager never throttles
+    assert QuotaManager().record_produce("c1", 10**9) == 0
+
+
+def test_produce_response_carries_throttle(tmp_path):
+    async def main():
+        broker, server = await _start_broker(
+            tmp_path, target_quota_byte_rate=1024
+        )
+        client = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        await client.create_topic("q", partitions=1)
+        conn = await client.leader_connection("q", 0)
+        # push well past 1 KiB/s: the response must tell us to back off
+        from redpanda_tpu.models.record import Record, RecordBatch
+        from redpanda_tpu.kafka.protocol.batch import encode_wire_batches
+
+        batch = RecordBatch.build(
+            [Record(offset_delta=i, value=b"x" * 1024) for i in range(16)]
+        )
+        throttles = []
+        for _ in range(3):
+            resp = await conn.request(m.PRODUCE, {
+                "transactional_id": None, "acks": -1, "timeout_ms": 5000,
+                "topics": [{"name": "q", "partitions": [
+                    {"partition_index": 0, "records": encode_wire_batches([batch])}]}],
+            })
+            assert resp["responses"][0]["partitions"][0]["error_code"] == 0
+            throttles.append(resp.get("throttle_time_ms", 0))
+        assert throttles[-1] > 0, throttles
+        await _stop(server, broker, client)
+
+    run(main())
+
+
+# ------------------------------------------------------------------ sessions
+def _fetch_body(topics, session_id=0, epoch=-1, forgotten=None):
+    return {
+        "replica_id": -1, "max_wait_ms": 0, "min_bytes": 0,
+        "max_bytes": 1 << 20, "isolation_level": 0,
+        "session_id": session_id, "session_epoch": epoch,
+        "topics": topics, "forgotten_topics_data": forgotten or [],
+        "rack_id": "",
+    }
+
+
+def _part(idx, offset):
+    return {
+        "partition_index": idx, "current_leader_epoch": -1,
+        "fetch_offset": offset, "log_start_offset": -1,
+        "partition_max_bytes": 1 << 20,
+    }
+
+
+def test_incremental_fetch_session_epoch_reuse(tmp_path):
+    async def main():
+        broker, server = await _start_broker(tmp_path)
+        client = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        await client.create_topic("s", partitions=2)
+        await client.produce("s", 0, [b"a", b"b"])
+        await client.produce("s", 1, [b"c"])
+        conn = await client.leader_connection("s", 0)
+
+        # epoch 0: establish the session, full response
+        resp = await conn.request(m.FETCH, _fetch_body(
+            [{"name": "s", "partitions": [_part(0, 0), _part(1, 0)]}], epoch=0,
+        ), version=10)
+        sid = resp["session_id"]
+        assert sid != 0 and resp["error_code"] == 0
+        got = {p["partition_index"] for t in resp["responses"] for p in t["partitions"]}
+        assert got == {0, 1}
+
+        # epoch 1: client advances its fetch offsets past the consumed data
+        # (KIP-227: changed partitions ride the request); nothing new is
+        # available, so the incremental response omits everything
+        resp = await conn.request(m.FETCH, _fetch_body(
+            [{"name": "s", "partitions": [_part(0, 2), _part(1, 1)]}],
+            session_id=sid, epoch=1,
+        ), version=10)
+        assert resp["error_code"] == 0
+        assert resp["responses"] == [] or all(
+            not t["partitions"] for t in resp["responses"]
+        )
+
+        # produce more on p1; epoch 2 returns ONLY p1
+        await client.produce("s", 1, [b"d"])
+        resp = await conn.request(m.FETCH, _fetch_body([], session_id=sid, epoch=2), version=10)
+        got = {
+            p["partition_index"]
+            for t in resp.get("responses") or [] for p in t["partitions"]
+        }
+        assert got == {1}, resp
+
+        # wrong epoch -> invalid_fetch_session_epoch
+        resp = await conn.request(m.FETCH, _fetch_body([], session_id=sid, epoch=99), version=10)
+        assert resp["error_code"] == 71
+        # unknown session -> fetch_session_id_not_found
+        resp = await conn.request(m.FETCH, _fetch_body([], session_id=123456, epoch=5), version=10)
+        assert resp["error_code"] == 70
+        await _stop(server, broker, client)
+
+    run(main())
+
+
+def test_forgotten_topics_removed_from_session(tmp_path):
+    async def main():
+        broker, server = await _start_broker(tmp_path)
+        client = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        await client.create_topic("f", partitions=2)
+        await client.produce("f", 0, [b"a"])
+        await client.produce("f", 1, [b"b"])
+        conn = await client.leader_connection("f", 0)
+        resp = await conn.request(m.FETCH, _fetch_body(
+            [{"name": "f", "partitions": [_part(0, 0), _part(1, 0)]}], epoch=0,
+        ), version=10)
+        sid = resp["session_id"]
+        # forget p0, produce on both; only p1 comes back
+        await client.produce("f", 0, [b"a2"])
+        await client.produce("f", 1, [b"b2"])
+        resp = await conn.request(m.FETCH, _fetch_body(
+            [], session_id=sid, epoch=1,
+            forgotten=[{"name": "f", "partitions": [0]}],
+        ), version=10)
+        got = {
+            p["partition_index"]
+            for t in resp.get("responses") or [] for p in t["partitions"]
+        }
+        assert got == {1}, resp
+        await _stop(server, broker, client)
+
+    run(main())
+
+
+# ------------------------------------------------------------------ memory gate
+def test_memory_budget_blocks_and_releases():
+    async def main():
+        from redpanda_tpu.resource_mgmt import MemoryBudget
+
+        mb = MemoryBudget(100)
+        got = await mb.acquire(60)
+        assert got == 60 and mb.available == 40
+        # oversized single request clamps instead of deadlocking
+        waiter = asyncio.create_task(mb.acquire(500))
+        await asyncio.sleep(0.01)
+        assert not waiter.done()  # blocked: only 40 free, needs 100 (clamped)
+        mb.release(60)
+        assert await asyncio.wait_for(waiter, 1.0) == 100
+        mb.release(100)
+        assert mb.available == 100
+
+    run(main())
+
+
+def test_kafka_server_gates_request_memory(tmp_path):
+    """With a tiny memory budget, concurrent large produces are serialized
+    by the gate (peak in-use never exceeds the budget) yet all succeed."""
+    async def main():
+        broker, server = await _start_broker(
+            tmp_path, kafka_request_max_memory=64 * 1024
+        )
+        client = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        await client.create_topic("mg", partitions=4)
+
+        peak = 0
+
+        async def watch():
+            nonlocal peak
+            while True:
+                peak = max(peak, server.memory.in_use)
+                await asyncio.sleep(0.001)
+
+        w = asyncio.create_task(watch())
+        vals = [b"z" * 8192 for _ in range(6)]  # ~50 KiB per produce
+        await asyncio.gather(*(client.produce("mg", p % 4, vals) for p in range(8)))
+        w.cancel()
+        assert peak <= 64 * 1024
+        assert server.memory.in_use == 0  # everything released
+        for p in range(4):
+            batches, _ = await client.fetch("mg", p % 4, 0)
+            assert batches
+        await _stop(server, broker, client)
+
+    run(main())
