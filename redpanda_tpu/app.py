@@ -218,9 +218,18 @@ class Application:
             access_key=c.cloud_storage_access_key,
             secret_key=c.cloud_storage_secret_key,
         )
+        import os
+
+        from redpanda_tpu.cloud_storage.cache import CacheService
+
+        cache = CacheService(
+            os.path.join(c.data_directory, "cloud_storage_cache"),
+            max_bytes=c.cloud_storage_cache_size,
+        )
         self.archival = await ArchivalScheduler(
             self.broker, Remote(client),
             interval_s=c.cloud_storage_segment_max_upload_interval_sec,
+            cache=cache,
         ).start()
         self._stop_order.append(self.archival)
         self._s3_client = client

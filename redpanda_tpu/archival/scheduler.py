@@ -21,10 +21,11 @@ logger = logging.getLogger("rptpu.archival")
 
 class ArchivalScheduler:
     def __init__(
-        self, broker, remote: Remote, *, interval_s: float = 30.0
+        self, broker, remote: Remote, *, interval_s: float = 30.0, cache=None
     ) -> None:
         self.broker = broker
         self.remote = remote
+        self.cache = cache  # cloud_storage.CacheService for the read side
         self.interval_s = interval_s
         self.archivers: dict[NTP, NtpArchiver] = {}
         self._uploaded_topic_manifests: set[str] = set()
@@ -81,7 +82,18 @@ class ArchivalScheduler:
             if ntp not in self.archivers:
                 md = self.broker.topic_table.get(ntp.topic)
                 revision = md.config.revision if md else 0
-                self.archivers[ntp] = NtpArchiver(ntp, p.log, self.remote, revision)
+                archiver = NtpArchiver(ntp, p.log, self.remote, revision)
+                self.archivers[ntp] = archiver
+                # read side: fetches below the local start fall through to
+                # the bucket; the leader shares the archiver's manifest
+                from redpanda_tpu.cloud_storage.remote_partition import RemotePartition
+
+                p.attach_remote(
+                    RemotePartition(
+                        ntp, self.remote, self.cache, revision,
+                        manifest_source=lambda a=archiver: a.manifest,
+                    )
+                )
             if ntp.topic not in self._uploaded_topic_manifests:
                 self._uploaded_topic_manifests.add(ntp.topic)
                 t = asyncio.get_running_loop().create_task(
@@ -96,10 +108,12 @@ class ArchivalScheduler:
         md = self.broker.topic_table.get(topic)
         if md is None:
             return
+        cfg_map = {k: v for k, v in md.config.config_map().items() if v is not None}
+        # recovery needs the incarnation id to locate partition manifests
+        cfg_map["x-rp-revision"] = str(md.config.revision)
         tm = TopicManifest(
             md.config.ns, topic, md.config.partition_count,
-            md.config.replication_factor,
-            {k: v for k, v in md.config.config_map().items() if v is not None},
+            md.config.replication_factor, cfg_map,
         )
         try:
             await self.remote.upload_manifest(tm)

@@ -67,6 +67,17 @@ class Remote:
             return None
         return PartitionManifest.from_json(blob)
 
+    async def download_topic_manifest(self, manifest: TopicManifest) -> TopicManifest | None:
+        """Fetch the topic manifest; None when absent (recovery probe)."""
+        try:
+            blob = await self._with_retries(
+                f"download {manifest.manifest_key}",
+                lambda: self.client.get_object(manifest.manifest_key),
+            )
+        except FileNotFoundError:
+            return None
+        return TopicManifest.from_json(blob)
+
     async def list_prefix(self, prefix: str = "") -> list[dict]:
         return await self._with_retries(
             f"list {prefix}", lambda: self.client.list_objects(prefix)

@@ -104,6 +104,9 @@ class Partition:
         log.append_listeners.append(self.otl.observe)
         log.truncate_listeners.append(self.otl.truncate)
         self._otl_ready = False
+        # tiered storage read side (cloud_storage.RemotePartition); serves
+        # offsets below the local log start when attached
+        self.remote = None
 
     async def start(self) -> "Partition":
         """Bootstrap the offset translator from kvstore + log scan."""
@@ -124,9 +127,17 @@ class Partition:
     def term(self) -> int:
         return self.consensus.term
 
+    def attach_remote(self, remote_partition) -> None:
+        self.remote = remote_partition
+
     @property
     def start_offset(self) -> int:
-        return self.otl.to_kafka_excl(self.consensus.start_offset)
+        """Kafka-visible log start: extends back into tiered storage when a
+        remote partition with uploaded data is attached."""
+        local = self.otl.to_kafka_excl(self.consensus.start_offset)
+        if self.remote is not None and self.remote.manifest.segments:
+            return min(local, self.otl.to_kafka_excl(self.remote.start_offset))
+        return local
 
     @property
     def high_watermark(self) -> int:
@@ -162,12 +173,24 @@ class Partition:
             return []
         raft_start = self.otl.from_kafka(start)
         raft_max = self.otl.from_kafka(max_offset)
-        batches = await self.consensus.make_reader(
-            raft_start,
-            max_bytes,
-            max_offset=raft_max,
-            type_filter=(RecordBatchType.raft_data,),
-        )
+        batches: list[RecordBatch] = []
+        if self.remote is not None and raft_start < self.consensus.start_offset:
+            # tiered fall-through: the prefix lives only in the bucket
+            batches = await self.remote.read(
+                raft_start,
+                max_bytes,
+                max_offset=min(raft_max, self.consensus.start_offset - 1),
+                type_filter=(RecordBatchType.raft_data,),
+            )
+            raft_start = self.consensus.start_offset
+            max_bytes -= sum(b.size_bytes for b in batches)
+        if max_bytes > 0 and raft_start <= raft_max:
+            batches += await self.consensus.make_reader(
+                raft_start,
+                max_bytes,
+                max_offset=raft_max,
+                type_filter=(RecordBatchType.raft_data,),
+            )
         out = []
         for b in batches:
             k = self.otl.to_kafka(b.base_offset)
@@ -179,10 +202,13 @@ class Partition:
         return None if raft_off is None else self.otl.to_kafka(raft_off)
 
     async def prefix_truncate(self, offset: int) -> None:
-        """offset is a kafka offset (DeleteRecords / archival housekeeping)."""
+        """offset is a kafka offset (DeleteRecords / archival housekeeping).
+
+        The translator keeps its FULL gap history (no advance_base): evicted
+        prefixes may still be served from tiered storage, and those reads
+        need per-offset translation below the local start."""
         raft_off = self.otl.from_kafka(offset)
         await self.log.prefix_truncate(raft_off)
-        self.otl.advance_base(raft_off)
 
 
 class PartitionManager:

@@ -111,6 +111,7 @@ PROPERTIES: list[Property] = [
     Property("cloud_storage_access_key", "S3 access key", ""),
     Property("cloud_storage_secret_key", "S3 secret key", ""),
     Property("cloud_storage_segment_max_upload_interval_sec", "Upload cadence", 30, int, _positive),
+    Property("cloud_storage_cache_size", "Local read-cache bytes", 1 << 30, int, _positive),
 ]
 
 

@@ -320,3 +320,101 @@ def test_cache_rejects_escaping_keys(tmp_path):
     for hostile in ("../escape", "a/../../escape", "/../etc/passwd"):
         with pytest.raises(ValueError):
             cache.put(hostile, b"evil")
+
+
+def test_tiered_read_after_prefix_truncate(tmp_path):
+    """VERDICT round-1 acceptance: produce -> archive -> local prefix
+    truncate -> consume from offset 0 succeeds via the remote + cache."""
+    async def main():
+        from redpanda_tpu.cloud_storage.cache import CacheService
+        from redpanda_tpu.kafka.client.client import KafkaClient
+
+        storage, broker, server, p = await _broker_with_segments(tmp_path, n_batches=12)
+        imp = await S3Imposter().start()
+        client = S3Client("tiered", endpoint=imp.endpoint, access_key="k", secret_key="s")
+        remote = Remote(client, backoff_s=0.01)
+        cache = CacheService(str(tmp_path / "cs_cache"))
+        sched = ArchivalScheduler(broker, remote, interval_s=600, cache=cache)
+        await sched.run_once()
+        assert p.remote is not None  # read side attached by the scheduler
+        uploaded_through = p.log.segments[-2].dirty_offset
+        hwm = p.high_watermark
+
+        # evict the local prefix (everything that was uploaded)
+        await p.prefix_truncate(uploaded_through + 1)
+        assert p.log.offsets().start_offset > 0
+        # kafka-visible start still reaches back to 0 through the bucket
+        assert p.start_offset == 0
+
+        # a consumer reading from 0 gets the full history: remote prefix +
+        # local tail, contiguous
+        kc = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        got = []
+        offset = 0
+        while offset < hwm:
+            batches, _ = await kc.fetch("arch", 0, offset)
+            if not batches:
+                break
+            for b in batches:
+                got.extend(b.base_offset + r.offset_delta for r in b.records())
+            offset = batches[-1].last_offset + 1
+        assert got == list(range(hwm)), (got[:5], got[-5:], hwm)
+        # segment downloads were cached
+        n_requests_before = len(imp.requests)
+        await p.make_reader(0, 1 << 20)
+        segment_gets = [
+            r for r in imp.requests[n_requests_before:]
+            if r[0] == "GET" and r[1].endswith(".log")
+        ]
+        assert segment_gets == []  # cache hit, no re-download
+        await kc.close()
+        await client.close()
+        await imp.stop()
+        await server.stop()
+        await storage.stop()
+
+    run(main())
+
+
+def test_topic_recovery_from_manifests(tmp_path):
+    """Create-with-recovery: a new broker rebuilds a topic (config + data)
+    purely from the bucket's manifests and segments."""
+    async def main():
+        from redpanda_tpu.cloud_storage.remote_partition import recover_topic_from_cloud
+        from redpanda_tpu.kafka.client.client import KafkaClient
+
+        storage, broker, server, p = await _broker_with_segments(tmp_path / "src")
+        imp = await S3Imposter().start()
+        client = S3Client("tiered", endpoint=imp.endpoint, access_key="k", secret_key="s")
+        remote = Remote(client, backoff_s=0.01)
+        sched = ArchivalScheduler(broker, remote, interval_s=600)
+        await sched.run_once()
+        await asyncio.sleep(0.05)  # topic manifest upload is a bg task
+        uploaded_through = p.log.segments[-2].dirty_offset
+        await server.stop()
+        await storage.stop()
+
+        # brand-new broker, empty data dir: recover the topic from s3
+        storage2 = await StorageApi(str(tmp_path / "dst")).start()
+        cfg2 = BrokerConfig(data_dir=str(tmp_path / "dst"))
+        broker2 = Broker(cfg2, storage2)
+        server2 = await KafkaServer(broker2, "127.0.0.1", 0).start()
+        cfg2.advertised_port = server2.port
+        n = await recover_topic_from_cloud(broker2, remote, "arch")
+        assert n == 1
+        p2 = broker2.get_partition("arch", 0)
+        assert p2.high_watermark == uploaded_through + 1
+
+        kc = await KafkaClient([("127.0.0.1", server2.port)]).connect()
+        batches, hwm = await kc.fetch("arch", 0, 0)
+        assert hwm == uploaded_through + 1
+        assert batches and batches[0].base_offset == 0
+        vals = [r.value for b in batches for r in b.records()]
+        assert vals[0].startswith(b"v0")
+        await kc.close()
+        await client.close()
+        await imp.stop()
+        await server2.stop()
+        await storage2.stop()
+
+    run(main())
