@@ -106,6 +106,9 @@ class AdminServer:
             web.post("/v1/security/users", self._create_user),
             web.delete("/v1/security/users/{user}", self._delete_user),
             web.put("/v1/security/users/{user}", self._update_user),
+            web.get("/v1/data-policies", self._list_policies),
+            web.put("/v1/data-policies/{topic}", self._set_policy),
+            web.delete("/v1/data-policies/{topic}", self._delete_policy),
             web.get("/v1/failure-probes", self._list_probes),
             web.put("/v1/failure-probes/{module}/{probe}/{type}", self._set_probe),
             web.delete("/v1/failure-probes/{module}/{probe}", self._unset_probe),
@@ -265,6 +268,30 @@ class AdminServer:
         return web.json_response({"deleted": req.match_info["user"]})
 
     # ------------------------------------------------------------ failure probes
+    # ------------------------------------------------------------ data policy
+    async def _list_policies(self, req: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                t: {"name": p.name, "spec": p.spec_json}
+                for t, p in self.broker.data_policies.policies().items()
+            }
+        )
+
+    async def _set_policy(self, req: web.Request) -> web.Response:
+        topic = req.match_info["topic"]
+        body = await req.json()
+        try:
+            await self.broker.set_data_policy(
+                topic, body.get("name", "policy"), body["spec"]
+            )
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"status": "ok"})
+
+    async def _delete_policy(self, req: web.Request) -> web.Response:
+        await self.broker.delete_data_policy(req.match_info["topic"])
+        return web.json_response({"status": "ok"})
+
     async def _list_probes(self, req: web.Request) -> web.Response:
         return web.json_response(
             {"enabled": honey_badger.enabled, "modules": honey_badger.modules()}

@@ -186,6 +186,7 @@ class Application:
 
         self.broker.controller_dispatcher = dispatcher
         self.broker.security.attach(self.controller)
+        self.broker.data_policies.attach(self.controller)
         self.broker.metadata_cache = MetadataCache(
             self.controller.topic_table, self.controller.members, leaders
         )

@@ -170,6 +170,20 @@ def register_node_cmd(
     )
 
 
+def create_data_policy_cmd(topic: str, name: str, spec_json: str) -> Command:
+    """Per-topic fetch-path transform policy (commands.h:152-162
+    create_data_policy_cmd; the v8 function name + script become a
+    TransformSpec here)."""
+    return Command(
+        CommandType.create_data_policy,
+        {"topic": topic, "name": name, "spec": spec_json},
+    )
+
+
+def delete_data_policy_cmd(topic: str) -> Command:
+    return Command(CommandType.delete_data_policy, {"topic": topic})
+
+
 def decommission_node_cmd(node_id: NodeId) -> Command:
     return Command(CommandType.decommission_node, {"node_id": node_id})
 

@@ -61,6 +61,11 @@ class Broker:
             fetch_rate=config.target_fetch_quota_byte_rate,
         )
         self.fetch_sessions = FetchSessionCache(config.fetch_session_cache_size)
+        # per-topic fetch-path transform policies (v8_engine equivalent)
+        from redpanda_tpu.policy import DataPolicyTable, PolicyEngine
+
+        self.data_policies = DataPolicyTable()
+        self.policy_engine = PolicyEngine()
         self.controller_dispatcher = None  # multi-node: routes security/topic cmds
         # SCRAM credentials + ACLs; cluster-replicated when a controller is
         # attached, applied locally otherwise (single-node mode)
@@ -77,6 +82,27 @@ class Broker:
             await self.controller_dispatcher.replicate(cmd)
         else:
             await self.security.apply_command(cmd)
+
+    # ------------------------------------------------------------ data policy
+    async def set_data_policy(self, topic: str, name: str, spec_json: str) -> None:
+        """data_policy_frontend: replicate through the controller when
+        clustered, apply locally otherwise."""
+        from redpanda_tpu.cluster.commands import create_data_policy_cmd
+
+        cmd = create_data_policy_cmd(topic, name, spec_json)
+        if self.controller_dispatcher is not None:
+            await self.controller_dispatcher.replicate(cmd)
+        else:
+            await self.data_policies.apply_command(cmd)
+
+    async def delete_data_policy(self, topic: str) -> None:
+        from redpanda_tpu.cluster.commands import delete_data_policy_cmd
+
+        cmd = delete_data_policy_cmd(topic)
+        if self.controller_dispatcher is not None:
+            await self.controller_dispatcher.replicate(cmd)
+        else:
+            await self.data_policies.apply_command(cmd)
 
     # ------------------------------------------------------------ recovery
     def _persist_topic_config(self, cfg: TopicConfig) -> None:

@@ -377,6 +377,13 @@ async def _fetch_once(ctx, topics, max_bytes: int) -> tuple[list, int, bool]:
                     {"producer_id": a.producer_id, "first_offset": a.first_offset}
                     for a in stm.aborted_ranges(fetch_offset, batches[-1].last_offset)
                 ] or None
+            # data policy: per-topic transform view on the fetch path
+            # (v8_engine's seat, application.cc:597,1037)
+            policy = broker.data_policies.get(t["name"])
+            if policy is not None and batches:
+                batches = broker.policy_engine.transform_batches(
+                    policy.spec_json, batches
+                )
             records = encode_wire_batches(batches) if batches else b""
             total += len(records)
             budget -= len(records)

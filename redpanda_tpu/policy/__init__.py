@@ -1,0 +1,28 @@
+"""Data-policy execution engine — the TPU-first answer to v8_engine/.
+
+The reference embeds V8 and runs a per-topic JS function over records on
+the fetch path (v8_engine/script.h:39-165, wired into the kafka protocol
+via application.cc:597,1037; policies replicate through the controller as
+create_data_policy_cmd, cluster/commands.h:152-162). A TPU cannot run
+arbitrary JS; the idiomatic equivalent is the declarative TransformSpec
+DSL already compiled to fused XLA programs for coproc
+(redpanda_tpu/ops/transforms.py) — a data policy IS a TransformSpec bound
+to a topic.
+
+Two execution engines, same semantics:
+- device: pack the fetched records into a staging array and run the
+  compiled packed pipeline (one H2D / one D2H) — chosen when a fetch
+  carries enough records to amortize the launch.
+- host: a pure-Python evaluator of the same DSL (also the parity oracle
+  in tests) — chosen for small fetches and when JAX is unavailable.
+
+Unlike coproc (which materializes NEW topics, renumbering records), a
+policy is a read-side VIEW: surviving records keep their original
+offset_delta/timestamps/keys so client offset arithmetic is unaffected;
+filtered records become offset gaps exactly like compacted batches.
+"""
+
+from redpanda_tpu.policy.engine import PolicyEngine, evaluate_record
+from redpanda_tpu.policy.table import DataPolicy, DataPolicyTable
+
+__all__ = ["DataPolicy", "DataPolicyTable", "PolicyEngine", "evaluate_record"]

@@ -1,0 +1,243 @@
+"""Policy execution: device (packed XLA pipeline) + host (pure Python).
+
+The host evaluator is bit-exact with the compiled device pipeline
+(ops/transforms.py) by construction — it simulates the same byte-level
+semantics (fixed windows, zero padding, 9-digit int bound) rather than
+"parsing JSON properly". tests/test_policy.py asserts parity on random
+inputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+import numpy as np
+
+from redpanda_tpu.models.record import Record, RecordBatch
+from redpanda_tpu.ops.transforms import (
+    Int,
+    Str,
+    TransformSpec,
+    _FilterContains,
+    _INT_WINDOW,
+    _MapProject,
+    _MapUppercase,
+    transform_out_width,
+)
+
+_NUM_CONT = frozenset(b"0123456789.eE+-")
+
+
+# ------------------------------------------------------------------ host path
+def _find_pattern_py(value: bytes, pat: bytes, require_nonnum_suffix: bool) -> int:
+    """First valid start of `pat` in value, else -1 (mirrors _find_pattern)."""
+    start = 0
+    while True:
+        i = value.find(pat, start)
+        if i < 0:
+            return -1
+        if not require_nonnum_suffix:
+            return i
+        end = i + len(pat)
+        if end >= len(value) or value[end] not in _NUM_CONT:
+            return i
+        start = i + 1
+
+
+def _parse_int_py(value: bytes, pos: int) -> tuple[int, bool]:
+    """Mirror _parse_int_at: 12-byte zero-padded window, <=9 digits, a
+    non-digit terminator must appear inside the window."""
+    if pos < 0:
+        return 0, False
+    win = value[pos : pos + _INT_WINDOW].ljust(_INT_WINDOW, b"\x00")
+    i = 0
+    neg = win[0:1] == b"-"
+    if neg:
+        i = 1
+    digits = 0
+    val = 0
+    while i < _INT_WINDOW and 48 <= win[i] <= 57:
+        val = val * 10 + (win[i] - 48)
+        digits += 1
+        i += 1
+    ok = 0 < digits <= 9 and i < _INT_WINDOW  # terminator seen in-window
+    return (-val if neg else val), ok
+
+
+def evaluate_record(spec: TransformSpec, value: bytes) -> bytes | None:
+    """Host-path record transform; None = dropped (keep=False)."""
+    if not value:
+        return None
+    for f in spec.filters:
+        assert isinstance(f, _FilterContains)
+        hit = _find_pattern_py(value, f.pattern, f.require_nonnum_suffix) >= 0
+        if hit if f.negate else not hit:
+            return None
+    mapper = spec.mapper
+    if mapper is None:
+        return value
+    if isinstance(mapper, _MapUppercase):
+        return bytes(b - 32 if 97 <= b <= 122 else b for b in value)
+    assert isinstance(mapper, _MapProject)
+    parts = []
+    for field in mapper.fields:
+        if isinstance(field, Int):
+            pat = f'"{field.key}":'.encode()
+            pos = _find_pattern_py(value, pat, False)
+            v, ok = _parse_int_py(value, pos + len(pat) if pos >= 0 else -1)
+            if not ok:
+                return None
+            parts.append(struct.pack("<i", v))
+        else:
+            assert isinstance(field, Str)
+            pat = f'"{field.key}":"'.encode()
+            pos = _find_pattern_py(value, pat, False)
+            if pos < 0:
+                return None
+            win = value[pos + len(pat) : pos + len(pat) + field.max_len + 1]
+            q = win.find(b'"')
+            if q < 0:  # no closing quote within max_len
+                return None
+            body = win[:q]
+            parts.append(struct.pack("<H", q) + body.ljust(field.max_len, b"\x00"))
+    return b"".join(parts)
+
+
+# ------------------------------------------------------------------ engine
+class PolicyEngine:
+    """Executes a TransformSpec over fetched batches as a read-side view."""
+
+    def __init__(
+        self,
+        *,
+        row_stride: int = 2048,
+        min_records_for_device: int = 256,
+        force_engine: str | None = None,  # "device" | "host" | None=adaptive
+    ):
+        self.row_stride = row_stride
+        self.min_records_for_device = min_records_for_device
+        self.force_engine = force_engine
+        self._specs: dict[str, TransformSpec] = {}
+
+    def _spec(self, spec_json: str) -> TransformSpec:
+        s = self._specs.get(spec_json)
+        if s is None:
+            s = self._specs[spec_json] = TransformSpec.from_json(spec_json)
+        return s
+
+    def transform_batches(
+        self, spec_json: str, batches: list[RecordBatch]
+    ) -> list[RecordBatch]:
+        """Filter/map records in place of the fetched view. Surviving
+        records keep their ORIGINAL offset deltas/timestamps/keys."""
+        if not batches:
+            return batches
+        spec = self._spec(spec_json)
+        n_records = sum(b.header.record_count for b in batches)
+        engine = self.force_engine or (
+            "device" if n_records >= self.min_records_for_device else "host"
+        )
+        if engine == "device":
+            try:
+                return self._run_device(spec, batches)
+            except Exception:  # device trouble must not fail the fetch
+                pass
+        return self._run_host(spec, batches)
+
+    # ------------------------------------------------------------ host
+    def _run_host(self, spec: TransformSpec, batches: list[RecordBatch]) -> list[RecordBatch]:
+        out = []
+        for batch in batches:
+            kept: list[Record] = []
+            changed = False
+            for rec in batch.records():
+                new_val = evaluate_record(spec, rec.value or b"")
+                if new_val is None:
+                    changed = True
+                    continue
+                if new_val != rec.value:
+                    changed = True
+                    rec = dataclasses.replace(rec, value=new_val)
+                kept.append(rec)
+            nb = self._rebuild(batch, kept, changed)
+            if nb is not None:
+                out.append(nb)
+        return out
+
+    # ------------------------------------------------------------ device
+    def _run_device(self, spec: TransformSpec, batches: list[RecordBatch]) -> list[RecordBatch]:
+        from redpanda_tpu.coproc import batch_codec
+        from redpanda_tpu.ops.pipeline import IN_META, make_packed_pipeline, unpack_result
+
+        import jax
+
+        fn, r_out = make_packed_pipeline(spec, self.row_stride)
+        exploded = batch_codec.explode_batches(batches)
+        n = len(exploded.sizes)
+        if n == 0:
+            return batches
+        fits = exploded.sizes <= self.row_stride
+        stride = self.row_stride + IN_META
+        try:
+            from redpanda_tpu.native import lib
+        except Exception:
+            lib = None
+        if lib is not None:
+            staged, _ = lib.pack_rows(
+                exploded.joined, exploded.offsets, exploded.sizes, stride
+            )
+        else:
+            from redpanda_tpu.ops.packing import pack_rows
+
+            vals = [
+                exploded.joined[o : o + min(s, self.row_stride)]
+                for o, s in zip(exploded.offsets, exploded.sizes)
+            ]
+            staged, _ = pack_rows(vals, stride)
+        lens = np.where(fits, exploded.sizes, 0).astype("<i4")
+        staged[:, self.row_stride : self.row_stride + 4] = lens.view(np.uint8).reshape(n, 4)
+        staged[:, self.row_stride + 4 :] = 0
+        packed = np.asarray(fn(jax.device_put(staged)))
+        out_rows, out_len, keep = unpack_result(packed, r_out)
+        keep = keep & fits
+        result = []
+        for batch, (start, end) in zip(batches, exploded.ranges):
+            kept: list[Record] = []
+            changed = False
+            for i, rec in enumerate(batch.records()):
+                j = start + i
+                if not keep[j]:
+                    changed = True
+                    continue
+                new_val = out_rows[j, : out_len[j]].tobytes()
+                if new_val != rec.value:
+                    changed = True
+                    rec = dataclasses.replace(rec, value=new_val)
+                kept.append(rec)
+            nb = self._rebuild(batch, kept, changed)
+            if nb is not None:
+                result.append(nb)
+        return result
+
+    # ------------------------------------------------------------ shared
+    @staticmethod
+    def _rebuild(batch: RecordBatch, kept: list[Record], changed: bool) -> RecordBatch | None:
+        """Reassemble the view batch; None when nothing survives. Original
+        offset deltas ride along, so a partially-filtered batch keeps its
+        base_offset/last_offset_delta and clients' offset math still works
+        (gaps, like compaction)."""
+        if not changed:
+            return batch
+        if not kept:
+            return None
+        from redpanda_tpu.compression import compress
+
+        payload = b"".join(r.encode() for r in kept)
+        codec = batch.header.compression
+        if codec != type(codec).none:
+            payload = compress(payload, codec)
+        hdr = dataclasses.replace(batch.header, record_count=len(kept))
+        nb = RecordBatch(hdr, payload)
+        nb.reseal()
+        return nb
