@@ -39,7 +39,12 @@ class AdminServer:
         port: int = 9644,
         require_auth: bool = False,
         auth_token: str | None = None,
+        tls=None,
     ) -> None:
+        self.tls = tls  # security.tls.ReloadableTlsContext | None
+        # listener-name -> ReloadableTlsContext for /v1/tls/reload (the app
+        # fills this after wiring every listener)
+        self.tls_contexts: dict[str, object] = {}
         self.broker = broker
         self.config = config
         self.gm = group_manager
@@ -106,6 +111,7 @@ class AdminServer:
             web.post("/v1/security/users", self._create_user),
             web.delete("/v1/security/users/{user}", self._delete_user),
             web.put("/v1/security/users/{user}", self._update_user),
+            web.post("/v1/tls/reload", self._reload_tls),
             web.get("/v1/data-policies", self._list_policies),
             web.put("/v1/data-policies/{topic}", self._set_policy),
             web.delete("/v1/data-policies/{topic}", self._delete_policy),
@@ -118,7 +124,8 @@ class AdminServer:
         from redpanda_tpu.utils.http_server import start_site
 
         self._runner, self.port = await start_site(
-            app, self.host, self.port, logger, "admin api"
+            app, self.host, self.port, logger, "admin api",
+            ssl_context=self.tls.server_context if self.tls is not None else None,
         )
         return self
 
@@ -268,6 +275,20 @@ class AdminServer:
         return web.json_response({"deleted": req.match_info["user"]})
 
     # ------------------------------------------------------------ failure probes
+    async def _reload_tls(self, req: web.Request) -> web.Response:
+        """Hot certificate reload on every TLS listener
+        (application.cc:704-719 credential reload)."""
+        reloaded = []
+        for name, ctx in self.tls_contexts.items():
+            try:
+                if ctx is not None and ctx.reload():
+                    reloaded.append(name)
+            except Exception as e:
+                return web.json_response(
+                    {"error": f"{name}: {e}", "reloaded": reloaded}, status=500
+                )
+        return web.json_response({"reloaded": reloaded})
+
     # ------------------------------------------------------------ data policy
     async def _list_policies(self, req: web.Request) -> web.Response:
         return web.json_response(

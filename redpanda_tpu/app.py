@@ -62,8 +62,29 @@ class Application:
             superusers=[u for u in c.superusers.split(",") if u],
         )
 
+    def _tls_for(self, prefix: str):
+        """Build the hot-reloadable TLS context for a listener group, or
+        None when that listener is plaintext."""
+        from redpanda_tpu.security.tls import ReloadableTlsContext, TlsConfig
+
+        c = self.config
+        if not getattr(c, f"{prefix}_tls_enabled"):
+            return None
+        return ReloadableTlsContext(
+            TlsConfig(
+                enabled=True,
+                cert_file=getattr(c, f"{prefix}_tls_cert_file"),
+                key_file=getattr(c, f"{prefix}_tls_key_file"),
+                truststore_file=getattr(c, f"{prefix}_tls_truststore_file", ""),
+                require_client_auth=getattr(
+                    c, f"{prefix}_tls_require_client_auth", False
+                ),
+            )
+        )
+
     async def start(self) -> "Application":
         c = self.config
+        self.rpc_tls = self._tls_for("rpc_server")
         self.storage = await StorageApi(c.data_directory).start()
         self._stop_order.append(self.storage)
         self.broker = Broker(self._broker_config(), self.storage)
@@ -72,8 +93,9 @@ class Application:
         if is_clustered:
             await self._start_cluster_services()
 
+        self.kafka_tls = self._tls_for("kafka_api")
         self.kafka_server = await KafkaServer(
-            self.broker, c.kafka_api_host, c.kafka_api_port
+            self.broker, c.kafka_api_host, c.kafka_api_port, tls=self.kafka_tls
         ).start()
         # ephemeral bind (port 0, tests) must advertise the real port or
         # metadata sends clients to a dead address
@@ -83,6 +105,7 @@ class Application:
         self.broker.config.advertised_port = adv
         self._stop_order.append(self.kafka_server)
 
+        self.admin_tls = self._tls_for("admin_api")
         self.admin = await AdminServer(
             self.broker,
             config=c,
@@ -92,7 +115,13 @@ class Application:
             port=c.admin_api_port,
             require_auth=c.admin_api_require_auth,
             auth_token=c.admin_api_auth_token or None,
+            tls=self.admin_tls,
         ).start()
+        self.admin.tls_contexts = {
+            "kafka": self.kafka_tls,
+            "rpc": self.rpc_tls,
+            "admin": self.admin_tls,
+        }
         self._stop_order.append(self.admin)
 
         if c.coproc_enable:
@@ -127,7 +156,10 @@ class Application:
         from redpanda_tpu.raft.types import VNode
 
         c = self.config
-        self.connections = rpc.ConnectionCache()
+        rpc_client_ssl = (
+            self.rpc_tls.client_context() if self.rpc_tls is not None else None
+        )
+        self.connections = rpc.ConnectionCache(ssl_context=rpc_client_ssl)
         self_vnode = VNode(c.node_id, 0)
         self.group_manager = GroupManager(
             self_vnode, self.storage, self.connections,
@@ -162,7 +194,9 @@ class Application:
         proto.register_service(
             rpc.ServiceHandler(md_dissemination_service, self.md_dissemination)
         )
-        self.rpc_server = rpc.Server(c.rpc_server_host, c.rpc_server_port)
+        self.rpc_server = rpc.Server(
+            c.rpc_server_host, c.rpc_server_port, tls=self.rpc_tls
+        )
         self.rpc_server.set_protocol(proto)
         await self.rpc_server.start()
         await self.group_manager.start()

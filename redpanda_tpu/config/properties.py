@@ -73,6 +73,22 @@ PROPERTIES: list[Property] = [
     Property("admin_api_port", "Admin API port", 9644, int, _port),
     Property("admin_api_require_auth", "Require auth on the admin API", False, bool),
     Property("admin_api_auth_token", "Static bearer token for the admin API", ""),
+    # --- TLS (per listener, hot-reloadable: application.cc:704-719)
+    Property("kafka_api_tls_enabled", "TLS on the kafka listener", False, bool),
+    Property("kafka_api_tls_cert_file", "Kafka listener cert (PEM)", ""),
+    Property("kafka_api_tls_key_file", "Kafka listener key (PEM)", ""),
+    Property("kafka_api_tls_truststore_file", "Kafka listener CA bundle", ""),
+    Property("kafka_api_tls_require_client_auth", "Kafka mTLS", False, bool),
+    Property("rpc_server_tls_enabled", "TLS on the internal RPC mesh", False, bool),
+    Property("rpc_server_tls_cert_file", "RPC cert (PEM)", ""),
+    Property("rpc_server_tls_key_file", "RPC key (PEM)", ""),
+    Property("rpc_server_tls_truststore_file", "RPC CA bundle", ""),
+    Property("rpc_server_tls_require_client_auth", "RPC mTLS", False, bool),
+    Property("admin_api_tls_enabled", "TLS on the admin API", False, bool),
+    Property("admin_api_tls_cert_file", "Admin cert (PEM)", ""),
+    Property("admin_api_tls_key_file", "Admin key (PEM)", ""),
+    Property("admin_api_tls_truststore_file", "Admin CA bundle", ""),
+    Property("admin_api_tls_require_client_auth", "Admin mTLS", False, bool),
     Property("seed_servers", "Seed broker list host:port,...", ""),
     # --- raft timings (configuration.cc raft group)
     Property("raft_election_timeout_ms", "Election timeout", 1500, int, _positive, needs_restart=False),

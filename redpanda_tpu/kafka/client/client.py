@@ -33,12 +33,14 @@ class BrokerConnection:
         client_id: str = "rptpu-client",
         sasl: tuple[str, str] | None = None,
         sasl_mechanism: str = "SCRAM-SHA-256",
+        ssl_context=None,
     ):
         self.host = host
         self.port = port
         self.client_id = client_id
         self.sasl = sasl  # (username, password) enables the SCRAM dance
         self.sasl_mechanism = sasl_mechanism
+        self.ssl_context = ssl_context
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._correlation = itertools.count(1)
@@ -48,7 +50,9 @@ class BrokerConnection:
         self._lock = asyncio.Lock()
 
     async def connect(self) -> "BrokerConnection":
-        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port, ssl=self.ssl_context
+        )
         self._recv_task = asyncio.create_task(self._recv_loop())
         vs = await self.request(m.API_VERSIONS, {}, version=0)
         if vs["error_code"] == 0:
@@ -187,11 +191,13 @@ class KafkaClient:
         client_id: str = "rptpu-client",
         sasl: tuple[str, str] | None = None,
         sasl_mechanism: str = "SCRAM-SHA-256",
+        ssl_context=None,
     ):
         self.bootstrap = bootstrap
         self.client_id = client_id
         self.sasl = sasl
         self.sasl_mechanism = sasl_mechanism
+        self.ssl_context = ssl_context
         self._conns: dict[int, BrokerConnection] = {}
         self._brokers: dict[int, tuple[str, int]] = {}
         self._leaders: dict[tuple[str, int], int] = {}
@@ -200,7 +206,8 @@ class KafkaClient:
 
     def _new_conn(self, host: str, port: int) -> BrokerConnection:
         return BrokerConnection(
-            host, port, self.client_id, sasl=self.sasl, sasl_mechanism=self.sasl_mechanism
+            host, port, self.client_id, sasl=self.sasl,
+            sasl_mechanism=self.sasl_mechanism, ssl_context=self.ssl_context,
         )
 
     async def connect(self) -> "KafkaClient":

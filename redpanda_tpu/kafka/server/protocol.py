@@ -271,13 +271,14 @@ class Connection:
 class KafkaServer:
     """Accept loop + handler registry (rpc::server with kafka::protocol)."""
 
-    def __init__(self, broker, host: str = "127.0.0.1", port: int = 9092):
+    def __init__(self, broker, host: str = "127.0.0.1", port: int = 9092, tls=None):
         from redpanda_tpu.kafka.server import handlers as h
         from redpanda_tpu.kafka.server import security_handlers as sh
 
         self.broker = broker
         self.host = host
         self.port = port
+        self.tls = tls  # security.tls.ReloadableTlsContext | None
         self.handlers = h.build_dispatch_table()
         sh.register_security_handlers(self.handlers)
         from redpanda_tpu.kafka.server import group_handlers as gh
@@ -299,7 +300,10 @@ class KafkaServer:
         tx = getattr(self.broker, "tx_coordinator", None)
         if tx is not None:
             tx.start_expiry()
-        self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
+        ssl_ctx = self.tls.server_context if self.tls is not None else None
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port, ssl=ssl_ctx
+        )
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
         logger.info("kafka api listening on %s:%d", self.host, self.port)

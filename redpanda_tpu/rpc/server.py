@@ -87,9 +87,10 @@ class SimpleProtocol:
 class Server:
     """TCP accept loop with a pluggable protocol."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, tls=None) -> None:
         self.host = host
         self.port = port
+        self.tls = tls  # security.tls.ReloadableTlsContext | None
         self._protocol = None
         self._server: asyncio.AbstractServer | None = None
         self._conn_tasks: set[asyncio.Task] = set()
@@ -99,7 +100,10 @@ class Server:
 
     async def start(self) -> None:
         assert self._protocol is not None, "set_protocol first"
-        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        ssl_ctx = self.tls.server_context if self.tls is not None else None
+        self._server = await asyncio.start_server(
+            self._on_conn, self.host, self.port, ssl=ssl_ctx
+        )
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def _on_conn(self, reader, writer) -> None:

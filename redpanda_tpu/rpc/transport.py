@@ -33,10 +33,13 @@ class TransportClosed(Exception):
 
 
 class Transport:
-    def __init__(self, host: str, port: int, compress: bool = False) -> None:
+    def __init__(
+        self, host: str, port: int, compress: bool = False, ssl_context=None
+    ) -> None:
         self.host = host
         self.port = port
         self.compress = compress
+        self.ssl_context = ssl_context
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._corr = itertools.count(1)
@@ -48,7 +51,9 @@ class Transport:
         return self._writer is not None
 
     async def connect(self) -> None:
-        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port, ssl=self.ssl_context
+        )
         self._read_task = asyncio.ensure_future(self._read_loop())
 
     async def _read_loop(self) -> None:
@@ -130,11 +135,19 @@ class BackoffPolicy:
 
 
 class ReconnectTransport:
-    def __init__(self, host: str, port: int, backoff: BackoffPolicy | None = None, compress: bool = False) -> None:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        backoff: BackoffPolicy | None = None,
+        compress: bool = False,
+        ssl_context=None,
+    ) -> None:
         self.host = host
         self.port = port
         self._backoff = backoff or BackoffPolicy()
         self._compress = compress
+        self.ssl_context = ssl_context
         self._transport: Transport | None = None
         self._lock = asyncio.Lock()
         self._next_attempt = 0.0  # monotonic deadline gating reconnects
@@ -155,7 +168,10 @@ class ReconnectTransport:
                 raise TransportClosed(
                     f"{self.host}:{self.port} in backoff for {self._next_attempt - now:.2f}s"
                 )
-            t = Transport(self.host, self.port, compress=self._compress)
+            t = Transport(
+                self.host, self.port, compress=self._compress,
+                ssl_context=self.ssl_context,
+            )
             try:
                 if timeout is not None:
                     await asyncio.wait_for(t.connect(), timeout)
@@ -184,8 +200,9 @@ class ReconnectTransport:
 class ConnectionCache:
     """node_id → ReconnectTransport (rpc/connection_cache.h)."""
 
-    def __init__(self, n_shards: int = 1) -> None:
+    def __init__(self, n_shards: int = 1, ssl_context=None) -> None:
         self._n_shards = max(1, n_shards)
+        self.ssl_context = ssl_context  # dial peers over TLS when set
         self._by_node: dict[int, ReconnectTransport] = {}
         self._addrs: dict[int, tuple[str, int]] = {}
         self._stale: list[ReconnectTransport] = []
@@ -209,7 +226,7 @@ class ConnectionCache:
         t = self._by_node.get(node_id)
         if t is None:
             host, port = self._addrs[node_id]
-            t = ReconnectTransport(host, port)
+            t = ReconnectTransport(host, port, ssl_context=self.ssl_context)
             self._by_node[node_id] = t
         return t
 

@@ -1,0 +1,83 @@
+"""TLS configuration with hot certificate reload.
+
+Parity with the reference's per-listener TLS (application.cc:704-719 builds
+reloadable credentials for the internal RPC server; each kafka listener and
+the admin server get the same treatment). Python's ssl.SSLContext allows
+``load_cert_chain`` to be called again on a LIVE context: connections
+already established keep their session, new handshakes pick up the fresh
+chain — which is exactly hot reload. ``ReloadableTlsContext.reload()``
+re-reads the files; the admin API exposes POST /v1/tls/reload.
+
+mTLS: set require_client_auth and provide a truststore; the client context
+verifies the server against the same truststore (private CA deployments).
+"""
+
+from __future__ import annotations
+
+import logging
+import ssl
+from dataclasses import dataclass
+
+logger = logging.getLogger("rptpu.tls")
+
+
+@dataclass
+class TlsConfig:
+    enabled: bool = False
+    cert_file: str = ""
+    key_file: str = ""
+    truststore_file: str = ""  # CA bundle for peer verification
+    require_client_auth: bool = False  # mTLS
+
+
+class ReloadableTlsContext:
+    """One live server context + client-context factory per listener."""
+
+    def __init__(self, config: TlsConfig):
+        self.config = config
+        self._server_ctx: ssl.SSLContext | None = None
+        if config.enabled:
+            self._server_ctx = self._build_server()
+
+    # ------------------------------------------------------------ contexts
+    def _build_server(self) -> ssl.SSLContext:
+        c = self.config
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+        ctx.load_cert_chain(c.cert_file, c.key_file)
+        if c.require_client_auth:
+            ctx.verify_mode = ssl.CERT_REQUIRED
+            ctx.load_verify_locations(c.truststore_file)
+        return ctx
+
+    @property
+    def server_context(self) -> ssl.SSLContext | None:
+        """None when TLS is disabled (plaintext listener)."""
+        return self._server_ctx
+
+    def client_context(self, *, verify: bool = True) -> ssl.SSLContext:
+        """Context for dialing a TLS listener of this cluster."""
+        c = self.config
+        if verify and c.truststore_file:
+            ctx = ssl.create_default_context(cafile=c.truststore_file)
+            ctx.check_hostname = False  # brokers dial by IP inside the mesh
+        else:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        if c.require_client_auth and c.cert_file:
+            ctx.load_cert_chain(c.cert_file, c.key_file)
+        return ctx
+
+    # ------------------------------------------------------------ reload
+    def reload(self) -> bool:
+        """Re-read cert/key (+truststore) into the LIVE context: existing
+        connections are untouched, new handshakes use the fresh chain."""
+        if self._server_ctx is None:
+            return False
+        c = self.config
+        self._server_ctx.load_cert_chain(c.cert_file, c.key_file)
+        if c.require_client_auth:
+            self._server_ctx.load_verify_locations(c.truststore_file)
+        logger.info("reloaded TLS credentials from %s", c.cert_file)
+        return True

@@ -12,11 +12,16 @@ from aiohttp import web
 
 
 async def start_site(
-    app: web.Application, host: str, port: int, logger: logging.Logger, name: str
+    app: web.Application,
+    host: str,
+    port: int,
+    logger: logging.Logger,
+    name: str,
+    ssl_context=None,
 ) -> tuple[web.AppRunner, int]:
     runner = web.AppRunner(app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    site = web.TCPSite(runner, host, port, ssl_context=ssl_context)
     await site.start()
     if port == 0:
         port = runner.addresses[0][1]

@@ -1,0 +1,293 @@
+"""TLS tests: kafka listener, internal RPC mesh, admin API, mTLS, and hot
+certificate reload (application.cc:704-719 parity)."""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import ssl
+
+import pytest
+
+from redpanda_tpu.security.tls import ReloadableTlsContext, TlsConfig
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# ------------------------------------------------------------------ certs
+def _make_ca(tmp_path, name="rptpu-test-ca"):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    subject = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    ca_path = tmp_path / "ca.pem"
+    ca_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    return key, cert, str(ca_path)
+
+
+def _issue(tmp_path, ca_key, ca_cert, cn, stem):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+    import ipaddress
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)]))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.DNSName("localhost"),
+                 x509.IPAddress(ipaddress.ip_address("127.0.0.1"))]
+            ),
+            critical=False,
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+    cert_path = tmp_path / f"{stem}.pem"
+    key_path = tmp_path / f"{stem}.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(cert_path), str(key_path), cert
+
+
+@pytest.fixture()
+def pki(tmp_path):
+    ca_key, ca_cert, ca_path = _make_ca(tmp_path)
+    cert, key, cert_obj = _issue(tmp_path, ca_key, ca_cert, "broker", "broker")
+    return {
+        "ca": ca_path, "cert": cert, "key": key, "cert_obj": cert_obj,
+        "ca_key": ca_key, "ca_cert": ca_cert, "tmp": tmp_path,
+    }
+
+
+# ------------------------------------------------------------------ kafka
+def test_kafka_listener_tls_and_plaintext_rejection(pki, tmp_path):
+    async def main():
+        from redpanda_tpu.kafka.client.client import KafkaClient
+        from redpanda_tpu.kafka.server.broker import Broker, BrokerConfig
+        from redpanda_tpu.kafka.server.protocol import KafkaServer
+        from redpanda_tpu.storage.log_manager import StorageApi
+
+        tls = ReloadableTlsContext(
+            TlsConfig(True, pki["cert"], pki["key"], pki["ca"])
+        )
+        storage = await StorageApi(str(tmp_path / "d")).start()
+        cfg = BrokerConfig(data_dir=str(tmp_path / "d"))
+        broker = Broker(cfg, storage)
+        server = await KafkaServer(broker, "127.0.0.1", 0, tls=tls).start()
+        cfg.advertised_port = server.port
+
+        client = await KafkaClient(
+            [("127.0.0.1", server.port)], ssl_context=tls.client_context()
+        ).connect()
+        await client.produce("sec", 0, [b"encrypted"])
+        batches, _ = await client.fetch("sec", 0, 0)
+        assert batches[0].records()[0].value == b"encrypted"
+        await client.close()
+
+        # a plaintext client cannot talk to the TLS listener
+        plain = KafkaClient([("127.0.0.1", server.port)])
+        with pytest.raises(Exception):
+            await asyncio.wait_for(plain.connect(), 3.0)
+        await plain.close()
+        await server.stop()
+        await storage.stop()
+
+    run(main())
+
+
+def test_kafka_mtls_requires_client_cert(pki, tmp_path):
+    async def main():
+        from redpanda_tpu.kafka.client.client import KafkaClient
+        from redpanda_tpu.kafka.server.broker import Broker, BrokerConfig
+        from redpanda_tpu.kafka.server.protocol import KafkaServer
+        from redpanda_tpu.storage.log_manager import StorageApi
+
+        tls = ReloadableTlsContext(
+            TlsConfig(True, pki["cert"], pki["key"], pki["ca"], require_client_auth=True)
+        )
+        storage = await StorageApi(str(tmp_path / "d2")).start()
+        cfg = BrokerConfig(data_dir=str(tmp_path / "d2"))
+        broker = Broker(cfg, storage)
+        server = await KafkaServer(broker, "127.0.0.1", 0, tls=tls).start()
+        cfg.advertised_port = server.port
+
+        # with a client cert: works
+        ok = await KafkaClient(
+            [("127.0.0.1", server.port)], ssl_context=tls.client_context()
+        ).connect()
+        await ok.produce("m", 0, [b"x"])
+        await ok.close()
+
+        # without a client cert: handshake rejected
+        anon = ssl.create_default_context(cafile=pki["ca"])
+        anon.check_hostname = False
+        bad = KafkaClient([("127.0.0.1", server.port)], ssl_context=anon)
+        with pytest.raises(Exception):
+            await asyncio.wait_for(bad.connect(), 3.0)
+        await bad.close()
+        await server.stop()
+        await storage.stop()
+
+    run(main())
+
+
+# ------------------------------------------------------------------ rpc
+def test_internal_rpc_over_tls(pki):
+    async def main():
+        from redpanda_tpu import rpc
+        from redpanda_tpu.rpc.transport import Transport
+
+        from redpanda_tpu.rpc import serde
+
+        tls = ReloadableTlsContext(TlsConfig(True, pki["cert"], pki["key"], pki["ca"]))
+        proto = rpc.SimpleProtocol()
+        req_t = serde.S(("text", serde.STRING))
+        svc = rpc.ServiceDef("tls", "echo", [rpc.MethodDef("echo", req_t, req_t)])
+
+        class Impl:
+            async def echo(self, req):
+                return {"text": req["text"]}
+
+        proto.register_service(rpc.ServiceHandler(svc, Impl()))
+        server = rpc.Server("127.0.0.1", 0, tls=tls)
+        server.set_protocol(proto)
+        await server.start()
+        t = Transport("127.0.0.1", server.port, ssl_context=tls.client_context())
+        await t.connect()
+        client = rpc.Client(svc, t)
+        assert (await client.echo({"text": "secure"}))["text"] == "secure"
+        await t.close()
+        await server.stop()
+
+    run(main())
+
+
+# ------------------------------------------------------------------ reload
+def test_hot_cert_reload_new_handshakes_use_new_chain(pki, tmp_path):
+    async def main():
+        from redpanda_tpu.kafka.client.client import KafkaClient
+        from redpanda_tpu.kafka.server.broker import Broker, BrokerConfig
+        from redpanda_tpu.kafka.server.protocol import KafkaServer
+        from redpanda_tpu.storage.log_manager import StorageApi
+
+        tls = ReloadableTlsContext(TlsConfig(True, pki["cert"], pki["key"], pki["ca"]))
+        storage = await StorageApi(str(tmp_path / "d3")).start()
+        cfg = BrokerConfig(data_dir=str(tmp_path / "d3"))
+        broker = Broker(cfg, storage)
+        server = await KafkaServer(broker, "127.0.0.1", 0, tls=tls).start()
+        cfg.advertised_port = server.port
+
+        async def leaf_serial():
+            r, w = await asyncio.open_connection(
+                "127.0.0.1", server.port, ssl=tls.client_context(),
+                server_hostname="localhost",
+            )
+            der = w.get_extra_info("ssl_object").getpeercert(binary_form=True)
+            w.close()
+            try:
+                await w.wait_closed()
+            except Exception:
+                pass
+            from cryptography import x509
+
+            return x509.load_der_x509_certificate(der).serial_number
+
+        serial_before = await leaf_serial()
+        # rotate the leaf in place (same paths) and reload
+        new_cert, new_key, new_obj = _issue(
+            pki["tmp"], pki["ca_key"], pki["ca_cert"], "broker-rotated", "broker"
+        )
+        assert tls.reload()
+        serial_after = await leaf_serial()
+        assert serial_before != serial_after
+        assert serial_after == new_obj.serial_number
+        # and the listener still serves kafka traffic
+        client = await KafkaClient(
+            [("127.0.0.1", server.port)], ssl_context=tls.client_context()
+        ).connect()
+        await client.produce("rot", 0, [b"y"])
+        await client.close()
+        await server.stop()
+        await storage.stop()
+
+    run(main())
+
+
+# ------------------------------------------------------------------ app-level
+def test_app_serves_tls_kafka_and_admin(pki, tmp_path):
+    async def main():
+        import aiohttp
+
+        from redpanda_tpu.app import Application
+        from redpanda_tpu.config import Configuration
+        from redpanda_tpu.kafka.client.client import KafkaClient
+
+        cfg = Configuration()
+        cfg.set("data_directory", str(tmp_path / "app"))
+        cfg.set("kafka_api_port", 0)
+        cfg.set("admin_api_port", 0)
+        cfg.set("kafka_api_tls_enabled", True)
+        cfg.set("kafka_api_tls_cert_file", pki["cert"])
+        cfg.set("kafka_api_tls_key_file", pki["key"])
+        cfg.set("kafka_api_tls_truststore_file", pki["ca"])
+        cfg.set("admin_api_tls_enabled", True)
+        cfg.set("admin_api_tls_cert_file", pki["cert"])
+        cfg.set("admin_api_tls_key_file", pki["key"])
+        app = await Application(cfg).start()
+        try:
+            cfg.set("advertised_kafka_api_port", app.kafka_server.port)
+            client = await KafkaClient(
+                [("127.0.0.1", app.kafka_server.port)],
+                ssl_context=app.kafka_tls.client_context(),
+            ).connect()
+            await client.produce("apptls", 0, [b"z"])
+            await client.close()
+            sslctx = ssl.create_default_context(cafile=pki["ca"])
+            sslctx.check_hostname = False
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(
+                    f"https://127.0.0.1:{app.admin.port}/v1/status/ready", ssl=sslctx
+                )
+                assert r.status == 200
+                r = await s.post(
+                    f"https://127.0.0.1:{app.admin.port}/v1/tls/reload", ssl=sslctx
+                )
+                assert r.status == 200
+                body = await r.json()
+                assert "kafka" in body["reloaded"] and "admin" in body["reloaded"]
+        finally:
+            await app.stop()
+
+    run(main())
