@@ -1,0 +1,145 @@
+"""Multi-PROCESS cluster harness for chaos testing.
+
+The in-process ClusterFixture (tests/test_cluster.py) shares one event
+loop, so a "node failure" there is polite. This harness spawns N real
+broker processes (``python -m redpanda_tpu start``) and kills them with
+SIGKILL mid-workload — the reference's ducktape + chaostest posture
+(tests/rptest services/redpanda.py, src/consistency-testing/chaostest).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import aiohttp
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class BrokerProc:
+    def __init__(self, node_id: int, base_dir: str, ports: dict, seed_str: str):
+        self.node_id = node_id
+        self.base_dir = base_dir
+        self.ports = ports  # {"kafka", "rpc", "admin"}
+        self.seed_str = seed_str
+        self.proc: subprocess.Popen | None = None
+        self.log_path = os.path.join(base_dir, "broker.log")
+
+    def start(self) -> None:
+        os.makedirs(self.base_dir, exist_ok=True)
+        sets = {
+            "node_id": self.node_id,
+            "data_directory": self.base_dir,
+            "kafka_api_port": self.ports["kafka"],
+            "advertised_kafka_api_port": self.ports["kafka"],
+            "rpc_server_port": self.ports["rpc"],
+            "admin_api_port": self.ports["admin"],
+            "seed_servers": self.seed_str,
+            "raft_election_timeout_ms": 500,
+            "raft_heartbeat_interval_ms": 100,
+        }
+        cmd = [sys.executable, "-m", "redpanda_tpu", "start"]
+        for k, v in sets.items():
+            cmd += ["--set", f"{k}={v}"]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        self.proc = subprocess.Popen(
+            cmd,
+            stdout=open(self.log_path, "ab"),
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=REPO,
+        )
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self) -> None:
+        """SIGKILL: no graceful shutdown, no flush."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait()
+        self.proc = None
+
+    def terminate(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        self.proc = None
+
+    async def wait_ready(self, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        url = f"http://127.0.0.1:{self.ports['admin']}/v1/status/ready"
+        async with aiohttp.ClientSession() as s:
+            while time.monotonic() < deadline:
+                if not self.alive:
+                    raise RuntimeError(
+                        f"broker {self.node_id} died during startup; "
+                        f"log tail:\n{self.log_tail()}"
+                    )
+                try:
+                    async with s.get(url, timeout=aiohttp.ClientTimeout(total=1)) as r:
+                        if r.status == 200:
+                            return
+                except Exception:
+                    pass
+                await asyncio.sleep(0.2)
+        raise TimeoutError(f"broker {self.node_id} not ready; log:\n{self.log_tail()}")
+
+    def log_tail(self, n: int = 4000) -> str:
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, 2)
+                f.seek(max(0, f.tell() - n))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return "<no log>"
+
+
+class ProcCluster:
+    def __init__(self, base_dir: str, n: int = 3):
+        self.base_dir = str(base_dir)
+        ports = [
+            {"kafka": _free_port(), "rpc": _free_port(), "admin": _free_port()}
+            for _ in range(n)
+        ]
+        seed_str = ",".join(f"{i}@127.0.0.1:{p['rpc']}" for i, p in enumerate(ports))
+        self.nodes = [
+            BrokerProc(i, os.path.join(self.base_dir, f"n{i}"), ports[i], seed_str)
+            for i in range(n)
+        ]
+
+    async def start(self) -> "ProcCluster":
+        for n in self.nodes:
+            n.start()
+        await asyncio.gather(*(n.wait_ready() for n in self.nodes))
+        return self
+
+    async def stop(self) -> None:
+        for n in self.nodes:
+            n.terminate()
+
+    def bootstrap(self) -> list[tuple[str, int]]:
+        return [("127.0.0.1", n.ports["kafka"]) for n in self.nodes if n.alive]
+
+    async def restart(self, node: BrokerProc) -> None:
+        node.start()
+        await node.wait_ready()
