@@ -11,6 +11,8 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <cmath>
+#include <cstdlib>
 
 #if defined(__x86_64__)
 #include <nmmintrin.h>
@@ -229,6 +231,249 @@ int64_t rp_frame_records(const uint8_t* rows, size_t row_stride,
   }
   *kept_out = seq;
   return out - dst;
+}
+
+// ---------------------------------------------------------------- columnar
+// JSON field extraction for the columnar pushdown path (coproc engine v2).
+// The device link charges per byte (tools/link_probe.py: H2D ~15-70 MB/s,
+// D2H ~3-14 MB/s over the tunnel), so the engine ships *columns* of the
+// fields a compiled TransformSpec references instead of record payloads.
+// This walker mirrors redpanda_tpu/ops/exprs.py json_find byte-for-byte:
+// parity is tested in tests/test_exprs.py (TestNativeWalkerParity).
+
+static inline int64_t skip_ws(const uint8_t* s, int64_t i, int64_t end) {
+  while (i < end && (s[i] == ' ' || s[i] == '\t' || s[i] == '\n' || s[i] == '\r'))
+    i++;
+  return i;
+}
+
+static int64_t skip_string(const uint8_t* s, int64_t i, int64_t end) {
+  i++;  // opening quote
+  while (i < end) {
+    uint8_t c = s[i];
+    if (c == '\\') {
+      i += 2;
+      continue;
+    }
+    if (c == '"') return i + 1;
+    i++;
+  }
+  return end;
+}
+
+static int64_t skip_value(const uint8_t* s, int64_t i, int64_t end) {
+  i = skip_ws(s, i, end);
+  if (i >= end) return end;
+  uint8_t c = s[i];
+  if (c == '"') return skip_string(s, i, end);
+  if (c == '{' || c == '[') {
+    int depth = 0;
+    while (i < end) {
+      c = s[i];
+      if (c == '"') {
+        i = skip_string(s, i, end);
+        continue;
+      }
+      if (c == '{' || c == '[') depth++;
+      else if (c == '}' || c == ']') {
+        depth--;
+        if (depth == 0) return i + 1;
+      }
+      i++;
+    }
+    return end;
+  }
+  while (i < end && c != ',' && c != '}' && c != ']' && c != ' ' && c != '\t' &&
+         c != '\n' && c != '\r') {
+    i++;
+    if (i < end) c = s[i];
+  }
+  return i;
+}
+
+// Locate dot-separated `path` in JSON object s[0:len]. Returns type
+// (0 missing, 1 string, 2 number, 3 true, 4 false, 5 null, 6 object,
+// 7 array) and value extent via vs/ve (string extent excludes quotes).
+int32_t rp_json_find(const uint8_t* s, int64_t len, const char* path,
+                     int32_t path_len, int64_t* vs, int64_t* ve) {
+  int64_t i = 0, end = len;
+  int32_t seg_start = 0;
+  for (;;) {
+    int32_t seg_end = seg_start;
+    while (seg_end < path_len && path[seg_end] != '.') seg_end++;
+    int32_t seg_len = seg_end - seg_start;
+    const char* seg = path + seg_start;
+    bool last = seg_end >= path_len;
+
+    i = skip_ws(s, i, end);
+    if (i >= end || s[i] != '{') return 0;
+    i++;
+    for (;;) {
+      i = skip_ws(s, i, end);
+      if (i >= end || s[i] == '}') return 0;
+      if (s[i] != '"') return 0;  // malformed
+      int64_t kstart = i + 1;
+      i = skip_string(s, i, end);
+      int64_t kend = i - 1;
+      i = skip_ws(s, i, end);
+      if (i >= end || s[i] != ':') return 0;
+      i++;
+      i = skip_ws(s, i, end);
+      if (kend - kstart == seg_len &&
+          std::memcmp(s + kstart, seg, (size_t)seg_len) == 0) {
+        break;  // found this segment; i is at the value start
+      }
+      i = skip_value(s, i, end);
+      i = skip_ws(s, i, end);
+      if (i < end && s[i] == ',') i++;
+    }
+    if (!last) {
+      seg_start = seg_end + 1;
+      continue;  // descend: value must parse as an object
+    }
+    if (i >= end) return 0;
+    uint8_t c = s[i];
+    if (c == '"') {
+      int64_t j = skip_string(s, i, end);
+      *vs = i + 1;
+      *ve = j - 1;
+      return 1;
+    }
+    if (c == '{') {
+      *vs = i;
+      *ve = skip_value(s, i, end);
+      return 6;
+    }
+    if (c == '[') {
+      *vs = i;
+      *ve = skip_value(s, i, end);
+      return 7;
+    }
+    int64_t j = skip_value(s, i, end);
+    *vs = i;
+    *ve = j;
+    int64_t tl = j - i;
+    if (tl == 4 && std::memcmp(s + i, "true", 4) == 0) return 3;
+    if (tl == 5 && std::memcmp(s + i, "false", 5) == 0) return 4;
+    if (tl == 4 && std::memcmp(s + i, "null", 4) == 0) return 5;
+    return 2;
+  }
+}
+
+// Extract a string-typed field into a [n, w] byte column (zero padded) plus
+// per-record raw value length (clipped to 1<<30): -1 = field missing or not
+// a string. Bytes are the value's raw JSON bytes (no unescaping), truncated
+// to w. Returns number of records with the field present as a string.
+int64_t rp_extract_str(const uint8_t* joined, const int64_t* offsets,
+                       const int32_t* sizes, int64_t n, const char* path,
+                       int32_t path_len, int32_t w, uint8_t* out_bytes,
+                       int32_t* out_vlen) {
+  int64_t hits = 0;
+  for (int64_t i = 0; i < n; i++) {
+    uint8_t* dst = out_bytes + i * (int64_t)w;
+    std::memset(dst, 0, (size_t)w);
+    int32_t sz = sizes[i];
+    if (sz <= 0) {
+      out_vlen[i] = -1;
+      continue;
+    }
+    int64_t vs, ve;
+    int32_t t = rp_json_find(joined + offsets[i], sz, path, path_len, &vs, &ve);
+    if (t != 1) {
+      out_vlen[i] = -1;
+      continue;
+    }
+    int64_t vlen = ve - vs;
+    if (vlen > (1 << 30)) vlen = 1 << 30;
+    out_vlen[i] = (int32_t)vlen;
+    int64_t cp = vlen < w ? vlen : w;
+    std::memcpy(dst, joined + offsets[i] + vs, (size_t)cp);
+    hits++;
+  }
+  return hits;
+}
+
+// Numeric lattice flags; keep in sync with redpanda_tpu/ops/exprs.py.
+enum {
+  RP_F_PRESENT = 1,
+  RP_F_NUMBER = 2,
+  RP_F_INT_EXACT = 4,
+  RP_F_BOOL = 8,
+  RP_F_NULL = 16,
+};
+
+// Extract a numeric/bool/null field as (f32, i32, flags) per record.
+// Numbers parse as double then narrow: INT_EXACT when integral and within
+// int32. Strings/objects/arrays set PRESENT only. Missing -> flags 0.
+int64_t rp_extract_num(const uint8_t* joined, const int64_t* offsets,
+                       const int32_t* sizes, int64_t n, const char* path,
+                       int32_t path_len, float* out_f32, int32_t* out_i32,
+                       uint8_t* out_flags) {
+  int64_t hits = 0;
+  for (int64_t i = 0; i < n; i++) {
+    out_f32[i] = 0.0f;
+    out_i32[i] = 0;
+    out_flags[i] = 0;
+    int32_t sz = sizes[i];
+    if (sz <= 0) continue;
+    int64_t vs, ve;
+    int32_t t = rp_json_find(joined + offsets[i], sz, path, path_len, &vs, &ve);
+    if (t == 0) continue;
+    hits++;
+    if (t == 3) {  // true
+      out_f32[i] = 1.0f;
+      out_i32[i] = 1;
+      out_flags[i] = RP_F_PRESENT | RP_F_BOOL;
+    } else if (t == 4) {  // false
+      out_flags[i] = RP_F_PRESENT | RP_F_BOOL;
+    } else if (t == 5) {  // null
+      out_flags[i] = RP_F_PRESENT | RP_F_NULL;
+    } else if (t == 2) {  // number
+      char buf[48];
+      int64_t tl = ve - vs;
+      if (tl > 0 && tl < (int64_t)sizeof(buf)) {
+        std::memcpy(buf, joined + offsets[i] + vs, (size_t)tl);
+        buf[tl] = 0;
+        char* endp = nullptr;
+        double d = strtod(buf, &endp);
+        if (endp == buf + tl) {
+          out_f32[i] = (float)d;
+          uint8_t fl = RP_F_PRESENT | RP_F_NUMBER;
+          if (std::isfinite(d) && d == (double)(int64_t)d &&
+              d >= -2147483648.0 && d <= 2147483647.0) {
+            fl |= RP_F_INT_EXACT;
+            out_i32[i] = (int32_t)d;
+          }
+          out_flags[i] = fl;
+        } else {
+          out_flags[i] = RP_F_PRESENT;  // malformed number token
+        }
+      } else {
+        out_flags[i] = RP_F_PRESENT;  // token too long for exact parse
+      }
+    } else {  // string/object/array
+      out_flags[i] = RP_F_PRESENT;
+    }
+  }
+  return hits;
+}
+
+// Presence-only column (exists()): 1 when the path resolves to any value.
+int64_t rp_extract_exists(const uint8_t* joined, const int64_t* offsets,
+                          const int32_t* sizes, int64_t n, const char* path,
+                          int32_t path_len, uint8_t* out) {
+  int64_t hits = 0;
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = 0;
+    int32_t sz = sizes[i];
+    if (sz <= 0) continue;
+    int64_t vs, ve;
+    if (rp_json_find(joined + offsets[i], sz, path, path_len, &vs, &ve) != 0) {
+      out[i] = 1;
+      hits++;
+    }
+  }
+  return hits;
 }
 
 }  // extern "C"

@@ -4,26 +4,33 @@ The reference ships record batches over RPC to a Node.js process that runs
 user JS per record (ProcessBatchServer, src/js/modules/rpc/server.ts:79,
 applyCoprocessor :244-266). Here the "supervisor" is a JAX engine: deploys
 carry a declarative TransformSpec (redpanda_tpu.ops.transforms) compiled once
-per (script, row-stride) into a fused XLA program.
+per script into an execution plan (coproc/column_plan.py).
 
 Data-path architecture (why it looks the way it does): the link between the
-broker runtime and the device charges per *round trip*, not per byte — a
-synchronous launch over the axon tunnel costs ~66 ms while the actual
-compute for a 64-partition tick is ~3 ms. The engine therefore never blocks
-per call:
+broker runtime and the device charges per round trip AND per byte, and both
+are expensive over a tunnel (tools/link_probe.py measured ~70 ms per
+synchronous op, H2D ~15-70 MB/s, D2H ~3-14 MB/s, while a 64-partition tick
+needs only ~3 ms of device compute). The engine therefore ships as little
+as possible and never blocks per call:
 
-  * ``submit()`` packs every record of a request into ONE staging array
-    (lengths ride in trailing metadata columns — exactly one H2D), issues
-    the launch, and immediately queues an async device→host copy of the ONE
-    packed result array. It returns a :class:`Ticket` without synchronizing.
-  * ``submit_group()`` goes further and fuses MANY requests into one launch
-    per script, amortizing the H2D round trip across all of them.
-  * ``Ticket.result()`` materializes the reply; by the time a pipelined
-    caller harvests, the async copy has landed and the call is host-speed.
+  * **columnar plans** (v2 ``where`` specs) ship per-field columns — a few
+    bytes per record — and fetch ONE BIT per record back (packed); the
+    device evaluates the whole predicate tree. Projections are assembled
+    host-side from columns the native columnarizer already extracted.
+  * **payload plans** (v1 raw-byte specs) stage full records; correct
+    everywhere, fast only on wide links (co-located PCIe/ICI).
+  * **host plans** (identity / uppercase / py_transform escape hatch) have
+    no device stage; they run in the engine's host stage with the same
+    interface.
+  * ``submit_group()`` fuses MANY requests into one launch per script;
+    ``Ticket.result()`` materializes replies after the async D2H lands.
   * ``process_batch()`` is the synchronous compatibility wrapper
     (submit + result), matching the supervisor RPC schema (coproc/gen.json):
     enable_coprocessors / disable_coprocessors / disable_all /
     process_batch / heartbeat.
+
+Per-stage wall time and link bytes accumulate in ``stats()`` so the bench
+(and the engine's own mode decisions) argue from data.
 
 Error policies mirror the public SDK (Coprocessor.ts:21-24):
 SkipOnFailure drops the failing batch but keeps the script; Deregister
@@ -34,6 +41,8 @@ from __future__ import annotations
 
 import enum
 import threading
+import time
+from collections import defaultdict
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -44,6 +53,7 @@ from redpanda_tpu.models.record import Compression, RecordBatch
 from redpanda_tpu.ops.pipeline import IN_META, make_packed_pipeline, unpack_result
 from redpanda_tpu.ops.transforms import TransformSpec
 from redpanda_tpu.coproc import batch_codec
+from redpanda_tpu.coproc.column_plan import ColumnarPlan, HostPlan, PayloadPlan, plan_spec
 
 
 class EnableResponseCode(enum.IntEnum):
@@ -108,18 +118,37 @@ def _bucket_rows(n: int) -> int:
 
 
 class _Launch:
-    """One device launch for one script, possibly spanning many requests."""
+    """One device launch for one script, possibly spanning many requests.
 
-    __slots__ = ("script_id", "policy", "r_out", "ranges", "fits", "_packed_dev",
-                 "_mat", "_lock")
+    ``materialize()`` yields (out_rows, out_len, keep) host arrays with one
+    row per input record; mode decides where they come from:
+
+    - payload: the fetched packed device result (full transformed rows).
+    - columnar: keep = device mask bits & host projection-ok; rows are
+      host-assembled projection columns (or packed input values for
+      passthrough specs).
+    - host: computed synchronously from the exploded inputs at harvest.
+    """
+
+    __slots__ = ("script_id", "policy", "mode", "r_out", "ranges", "fits",
+                 "engine", "n", "_packed_dev", "_mask_dev", "_proj_data",
+                 "_proj_ok", "_plan", "_exploded", "_mat", "_lock")
 
     def __init__(self, script_id: int, policy: ErrorPolicy):
         self.script_id = script_id
         self.policy = policy
+        self.mode = "payload"
         self.r_out = 0
         self.ranges: list[tuple[int, int]] = []
         self.fits: np.ndarray | None = None
+        self.engine = None
+        self.n = 0
         self._packed_dev = None
+        self._mask_dev = None
+        self._proj_data = None
+        self._proj_ok = None
+        self._plan = None
+        self._exploded = None
         self._mat = None
         self._lock = threading.Lock()
 
@@ -131,19 +160,124 @@ class _Launch:
         run_in_executor)."""
         with self._lock:
             if self._mat is None:
-                if self._packed_dev is None:  # zero-record launch
-                    self._mat = (
-                        np.zeros((0, self.r_out), np.uint8),
-                        np.zeros(0, np.int32),
-                        np.zeros(0, bool),
-                    )
+                if self.mode == "payload":
+                    self._mat = self._mat_payload()
+                elif self.mode == "columnar":
+                    self._mat = self._mat_columnar()
                 else:
-                    packed = np.asarray(self._packed_dev)
-                    self._packed_dev = None
-                    out, out_len, keep = unpack_result(packed, self.r_out)
-                    n = len(self.fits)
-                    self._mat = (out[:n], out_len[:n], keep[:n] & self.fits)
+                    self._mat = self._mat_host()
             return self._mat
+
+    def _mat_payload(self):
+        if self._packed_dev is None:  # zero-record launch
+            return (
+                np.zeros((0, self.r_out), np.uint8),
+                np.zeros(0, np.int32),
+                np.zeros(0, bool),
+            )
+        t0 = time.perf_counter()
+        packed = np.asarray(self._packed_dev)
+        self._stat("t_fetch", t0)
+        self._packed_dev = None
+        out, out_len, keep = unpack_result(packed, self.r_out)
+        n = len(self.fits)
+        return out[:n], out_len[:n], keep[:n] & self.fits
+
+    def _mat_columnar(self):
+        n = self.n
+        if n == 0:
+            return (
+                np.zeros((0, max(self.r_out, 1)), np.uint8),
+                np.zeros(0, np.int32),
+                np.zeros(0, bool),
+            )
+        if self._mask_dev is None:  # no predicate: keep everything present
+            keep = np.ones(n, dtype=bool)
+        else:
+            t0 = time.perf_counter()
+            bits = np.asarray(self._mask_dev)
+            self._stat("t_fetch", t0)
+            self._mask_dev = None
+            keep = np.unpackbits(bits)[:n].astype(bool)
+        keep &= self._proj_ok
+        t0 = time.perf_counter()
+        plan: ColumnarPlan = self._plan
+        if plan.passthrough:
+            # Output = input value bytes of kept records (empty values are
+            # legal and kept when the predicate says so — host_eval is the
+            # normative semantics, unlike v1's drop-empty payload rule).
+            ex = self._exploded
+            stride = max(int(ex.sizes.max()) if n else 1, 1)
+            rows, lens = _pack_values(ex, stride)
+        else:
+            rows, lens = plan.assemble_rows(self._proj_data, n)
+        self._stat("t_assemble", t0)
+        self._proj_data = None
+        self._exploded = None
+        return rows, lens, keep
+
+    def _mat_host(self):
+        plan: HostPlan = self._plan
+        ex = self._exploded
+        n = self.n
+        if n == 0:
+            return (
+                np.zeros((0, 1), np.uint8),
+                np.zeros(0, np.int32),
+                np.zeros(0, bool),
+            )
+        t0 = time.perf_counter()
+        if plan.kind == "python":
+            outs = []
+            for i in range(n):
+                o = int(ex.offsets[i])
+                val = ex.joined[o : o + int(ex.sizes[i])]
+                try:
+                    outs.append(plan.fn(val))
+                except Exception:
+                    outs.append(None)
+            keep = np.array([o is not None for o in outs], dtype=bool)
+            stride = max((len(o) for o in outs if o is not None), default=1)
+            stride = max(stride, 1)
+            rows = np.zeros((n, stride), dtype=np.uint8)
+            lens = np.zeros(n, dtype=np.int32)
+            for i, o in enumerate(outs):
+                if o is not None:
+                    rows[i, : len(o)] = np.frombuffer(o, np.uint8)
+                    lens[i] = len(o)
+        else:
+            stride = max(int(ex.sizes.max()), 1)
+            rows, lens = _pack_values(ex, stride)
+            keep = ex.sizes > 0
+            if plan.kind == "uppercase":
+                is_lower = (rows >= ord("a")) & (rows <= ord("z"))
+                rows = np.where(is_lower, rows - 32, rows)
+        self._stat("t_assemble", t0)
+        self._exploded = None
+        return rows, lens, keep
+
+    def _stat(self, key: str, t0: float):
+        if self.engine is not None:
+            self.engine._stat_add(key, time.perf_counter() - t0)
+
+
+def _pack_values(ex, stride: int):
+    """Pack exploded record values into [n, stride] rows + lens."""
+    try:
+        from redpanda_tpu.native import lib
+    except Exception:
+        lib = None
+    sizes = np.minimum(ex.sizes, stride).astype(np.int32)
+    if lib is not None:
+        rows, _ = lib.pack_rows(ex.joined, ex.offsets, sizes, stride)
+    else:
+        from redpanda_tpu.ops.packing import pack_rows
+
+        vals = [
+            ex.joined[o : o + s] for o, s in zip(ex.offsets, sizes)
+        ]
+        rows, _ = pack_rows(vals, stride)
+    return rows, sizes
 
 
 # Per-slot dispositions inside a Ticket.
@@ -197,6 +331,7 @@ class Ticket:
     def _rebuild(self, item: ProcessBatchItem, launch: _Launch, rng) -> list[RecordBatch]:
         out, out_len, keep = launch.materialize()
         e = self._engine
+        t0 = time.perf_counter()
         item_out: list[RecordBatch] = []
         for batch, ridx in zip(item.batches, rng):
             start, end = launch.ranges[ridx]
@@ -210,11 +345,20 @@ class Ticket:
             )
             if rebuilt is not None:
                 item_out.append(rebuilt)
+        e._stat_add("t_rebuild", time.perf_counter() - t0)
         return item_out
 
 
 class TpuEngine:
-    """HandleTable + batched async device execution."""
+    """HandleTable + batched async device execution.
+
+    ``mesh``: optional jax.sharding.Mesh with a 'p' axis; columnar launches
+    then run SPMD with record rows sharded over the mesh (the per-shard
+    pacemaker-fiber analogue of coproc/pacemaker.h:41-145 — one engine, all
+    chips). ``force_mode`` pins every script to one execution mode
+    ("payload" forces the full-row staging path; used by the bench to
+    measure raw bridge overhead).
+    """
 
     def __init__(
         self,
@@ -222,12 +366,19 @@ class TpuEngine:
         row_stride: int = 1024,
         compress_threshold: int = 512,
         output_codec: Compression = Compression.zstd,
+        mesh=None,
+        force_mode: str | None = None,
     ):
         self._handles: dict[int, ScriptHandle] = {}
         self._row_stride = row_stride
         self._compress_threshold = compress_threshold
         self._output_codec = output_codec
-        self._pipelines: dict[int, tuple] = {}  # script_id -> (fn, r_out)
+        self._mesh = mesh
+        self._force_mode = force_mode
+        self._pipelines: dict[int, tuple] = {}  # payload: script_id -> (fn, r_out)
+        self._plans: dict[int, object] = {}  # script_id -> execution plan
+        self._stats: dict[str, float] = defaultdict(float)
+        self._stats_lock = threading.Lock()
 
     # ------------------------------------------------------------ control
     def enable_coprocessors(
@@ -247,7 +398,20 @@ class TpuEngine:
                 continue
             try:
                 spec = TransformSpec.from_json(spec_json)
-                self._pipelines[script_id] = make_packed_pipeline(spec, self._row_stride)
+                plan = plan_spec(spec)  # validates the expr tree + constants
+                if (
+                    self._force_mode == "payload"
+                    and plan.mode != "payload"
+                    and spec.where is None
+                ):
+                    # v1-expressible specs only: where-specs have no payload
+                    # compilation and keep their columnar plan.
+                    plan = PayloadPlan(spec)
+                if plan.mode == "payload":
+                    self._pipelines[script_id] = make_packed_pipeline(
+                        spec, self._row_stride
+                    )
+                self._plans[script_id] = plan
             except Exception:
                 out.append(EnableResponseCode.internal_error)
                 continue
@@ -257,12 +421,30 @@ class TpuEngine:
             out.append(EnableResponseCode.success)
         return out
 
+    def enable_py_transform(
+        self, script_id: int, fn, topics: tuple[str, ...]
+    ) -> EnableResponseCode:
+        """Escape hatch: an arbitrary python callable(value) -> value | None
+        run in the engine's host stage with the standard engine interface —
+        for transforms the declarative DSL cannot express (the analogue of
+        the reference's arbitrary Coprocessor.apply(), SimpleTransform.ts:18).
+        """
+        if script_id in self._handles:
+            return EnableResponseCode.script_id_already_exists
+        if not topics:
+            return EnableResponseCode.script_contains_no_topics
+        spec = TransformSpec(name=f"py:{getattr(fn, '__name__', 'fn')}")
+        self._plans[script_id] = plan_spec(spec, py_fn=fn)
+        self._handles[script_id] = ScriptHandle(script_id, spec, tuple(topics))
+        return EnableResponseCode.success
+
     def disable_coprocessors(self, script_ids: list[int]) -> list[DisableResponseCode]:
         out = []
         for sid in script_ids:
             if sid in self._handles:
                 del self._handles[sid]
                 self._pipelines.pop(sid, None)
+                self._plans.pop(sid, None)
                 out.append(DisableResponseCode.success)
             else:
                 out.append(DisableResponseCode.script_id_does_not_exist)
@@ -272,7 +454,23 @@ class TpuEngine:
         n = len(self._handles)
         self._handles.clear()
         self._pipelines.clear()
+        self._plans.clear()
         return n
+
+    # ------------------------------------------------------------ metrics
+    def stats(self) -> dict:
+        """Accumulated per-stage wall seconds and link bytes."""
+        with self._stats_lock:
+            return dict(self._stats)
+
+    def reset_stats(self) -> None:
+        with self._stats_lock:
+            self._stats.clear()
+
+    def _stat_add(self, key: str, v: float) -> None:
+        # Harvests may run on executor threads concurrently with dispatch.
+        with self._stats_lock:
+            self._stats[key] += v
 
     def heartbeat(self) -> int:
         """Returns the number of registered scripts (liveness probe)."""
@@ -332,24 +530,82 @@ class TpuEngine:
         return tickets
 
     def _dispatch(self, script_id: int, launch: _Launch, entries: list[tuple]) -> None:
-        """Pack all entries' records and issue the (async) device launch."""
-        import jax
-
-        fn, r_out = self._pipelines[script_id]
-        launch.r_out = r_out
+        """Explode all entries' records and issue the (async) device launch."""
+        plan = self._plans[script_id]
+        launch.engine = self
+        launch.mode = plan.mode
+        launch._plan = plan
+        t0 = time.perf_counter()
         all_batches = [b for _, _, item in entries for b in item.batches]
         exploded = batch_codec.explode_batches(all_batches)
+        self._stat_add("t_explode", time.perf_counter() - t0)
         launch.ranges = exploded.ranges
         n = len(exploded.sizes)
+        launch.n = n
+        self._stat_add("n_records", n)
+        self._stat_add("n_launches", 1)
+        if plan.mode == "payload":
+            self._dispatch_payload(launch, exploded, n)
+        elif plan.mode == "columnar":
+            self._dispatch_columnar(launch, plan, exploded, n)
+        else:  # host: materialized lazily at harvest
+            launch._exploded = exploded
+
+    def _dispatch_payload(self, launch: _Launch, exploded, n: int) -> None:
+        import jax
+
+        fn, r_out = self._pipelines[launch.script_id]
+        launch.r_out = r_out
         launch.fits = exploded.sizes <= self._row_stride
         if n == 0:
             return
+        t0 = time.perf_counter()
         n_pad = _bucket_rows(n)
         staged = self._pack_staged(exploded, n_pad)
+        self._stat_add("t_pack", time.perf_counter() - t0)
+        t0 = time.perf_counter()
         dev = jax.device_put(staged)
         packed = fn(dev)
         packed.copy_to_host_async()
+        self._stat_add("t_dispatch", time.perf_counter() - t0)
+        self._stat_add("bytes_h2d", staged.nbytes)
+        self._stat_add("bytes_d2h", n_pad * (r_out + 8))
         launch._packed_dev = packed
+
+    def _dispatch_columnar(
+        self, launch: _Launch, plan: ColumnarPlan, exploded, n: int
+    ) -> None:
+        launch.r_out = plan.r_out
+        if n == 0:
+            launch._proj_ok = np.zeros(0, bool)
+            return
+        if plan.dev_cols:
+            t0 = time.perf_counter()
+            n_pad = _bucket_rows(n)
+            cols = plan.extract_device_inputs(
+                exploded.joined, exploded.offsets, exploded.sizes, n_pad
+            )
+            self._stat_add("t_extract_pred", time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            fn = plan.compile_device(self._mesh)
+            mask = fn(*cols)
+            mask.copy_to_host_async()
+            self._stat_add("t_dispatch", time.perf_counter() - t0)
+            self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
+            self._stat_add("bytes_d2h", n_pad // 8)
+            launch._mask_dev = mask
+        # Projection extraction overlaps the device launch.
+        t0 = time.perf_counter()
+        if plan.passthrough:
+            launch._proj_ok = np.ones(n, bool)
+            launch._exploded = exploded
+        else:
+            data, ok = plan.extract_projection(
+                exploded.joined, exploded.offsets, exploded.sizes
+            )
+            launch._proj_data = data
+            launch._proj_ok = ok
+        self._stat_add("t_extract_proj", time.perf_counter() - t0)
 
     def _pack_staged(self, exploded, n_pad: int) -> np.ndarray:
         """[n_pad, row_stride + IN_META] uint8: record bytes then LE32 length.

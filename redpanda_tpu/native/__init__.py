@@ -48,6 +48,28 @@ class _NativeLib:
             ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
         ]
+        dll.rp_json_find.restype = ctypes.c_int32
+        dll.rp_json_find.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        dll.rp_extract_str.restype = ctypes.c_int64
+        dll.rp_extract_str.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        dll.rp_extract_num.restype = ctypes.c_int64
+        dll.rp_extract_num.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        dll.rp_extract_exists.restype = ctypes.c_int64
+        dll.rp_extract_exists.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int32, ctypes.c_void_p,
+        ]
 
     def crc32c_update(self, state: int, data: bytes) -> int:
         return self._dll.rp_crc32c_update(state & 0xFFFFFFFF, data, len(data))
@@ -101,6 +123,72 @@ class _NativeLib:
             n, dst.ctypes.data, ctypes.byref(kept),
         )
         return dst[:length].tobytes(), kept.value
+
+    def json_find(self, value: bytes, path: str) -> tuple[int, int, int]:
+        """(type, value_start, value_end) of `path` in one JSON value.
+
+        Mirrors ops.exprs.json_find; types: 0 missing, 1 str, 2 num,
+        3 true, 4 false, 5 null, 6 object, 7 array."""
+        vs = ctypes.c_int64()
+        ve = ctypes.c_int64()
+        p = path.encode()
+        t = self._dll.rp_json_find(
+            value, len(value), p, len(p), ctypes.byref(vs), ctypes.byref(ve)
+        )
+        return t, vs.value, ve.value
+
+    def extract_str(
+        self, joined, offsets: np.ndarray, sizes: np.ndarray, path: str, w: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """String field column: ([n, w] raw bytes, [n] true value length).
+
+        vlen -1 = missing or not a string; bytes are zero-padded/truncated."""
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+        n = len(sizes)
+        joined_arr = np.frombuffer(joined, dtype=np.uint8)
+        out = np.empty((n, w), dtype=np.uint8)
+        vlen = np.empty(n, dtype=np.int32)
+        p = path.encode()
+        self._dll.rp_extract_str(
+            joined_arr.ctypes.data, offsets.ctypes.data, sizes.ctypes.data, n,
+            p, len(p), w, out.ctypes.data, vlen.ctypes.data,
+        )
+        return out, vlen
+
+    def extract_num(
+        self, joined, offsets: np.ndarray, sizes: np.ndarray, path: str
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Numeric field column: ([n] f32, [n] i32, [n] lattice flags u8)."""
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+        n = len(sizes)
+        joined_arr = np.frombuffer(joined, dtype=np.uint8)
+        f32 = np.empty(n, dtype=np.float32)
+        i32 = np.empty(n, dtype=np.int32)
+        flags = np.empty(n, dtype=np.uint8)
+        p = path.encode()
+        self._dll.rp_extract_num(
+            joined_arr.ctypes.data, offsets.ctypes.data, sizes.ctypes.data, n,
+            p, len(p), f32.ctypes.data, i32.ctypes.data, flags.ctypes.data,
+        )
+        return f32, i32, flags
+
+    def extract_exists(
+        self, joined, offsets: np.ndarray, sizes: np.ndarray, path: str
+    ) -> np.ndarray:
+        """Presence column: [n] u8, 1 when the path resolves."""
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+        n = len(sizes)
+        joined_arr = np.frombuffer(joined, dtype=np.uint8)
+        out = np.empty(n, dtype=np.uint8)
+        p = path.encode()
+        self._dll.rp_extract_exists(
+            joined_arr.ctypes.data, offsets.ctypes.data, sizes.ctypes.data, n,
+            p, len(p), out.ctypes.data,
+        )
+        return out
 
     def unpack_rows(self, rows: np.ndarray, sizes: np.ndarray) -> bytes:
         rows = np.ascontiguousarray(rows, dtype=np.uint8)

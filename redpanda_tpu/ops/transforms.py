@@ -47,6 +47,31 @@ class Str:
 
 
 @dataclass(frozen=True)
+class Float:
+    """Project a JSON number as little-endian float32 (4 bytes)."""
+
+    key: str
+
+
+@dataclass(frozen=True)
+class Substr:
+    """Project value[start : start+length] of a string field, padded."""
+
+    key: str
+    start: int
+    length: int
+
+
+@dataclass(frozen=True)
+class Concat:
+    """Project two string fields joined (a + b), truncated to max_len."""
+
+    a: str
+    b: str
+    max_len: int = 64
+
+
+@dataclass(frozen=True)
 class _FilterContains:
     pattern: bytes
     negate: bool = False
@@ -68,19 +93,32 @@ class _MapUppercase:
 
 @dataclass(frozen=True)
 class TransformSpec:
-    """A chain of filters plus at most one terminal map."""
+    """Filters (legacy raw-byte) and/or a predicate tree, plus one map.
+
+    ``filters`` are v1 raw-payload substring ops (compiled to the payload
+    device pipeline); ``where`` is a v2 field-anchored expression tree
+    (redpanda_tpu.ops.exprs) compiled to the columnar pushdown path. The
+    engine picks the execution mode per spec (coproc/column_plan.py).
+    """
 
     filters: tuple = ()
     mapper: object = None
     name: str = "identity"
+    where: object = None  # exprs.Expr | None
 
     def __or__(self, other: "TransformSpec") -> "TransformSpec":
         if self.mapper is not None and other.mapper is not None:
             raise ValueError("only one map stage per transform")
+        w = self.where
+        if other.where is not None:
+            from redpanda_tpu.ops.exprs import And
+
+            w = And(w, other.where) if w is not None else other.where
         return TransformSpec(
             filters=self.filters + other.filters,
             mapper=self.mapper or other.mapper,
             name=f"{self.name}|{other.name}",
+            where=w,
         )
 
     # ------------------------------------------------------------- serde
@@ -97,16 +135,29 @@ class TransformSpec:
                 }
             )
         if isinstance(self.mapper, _MapProject):
-            fields = [
-                {"kind": "int", "key": f.key}
-                if isinstance(f, Int)
-                else {"kind": "str", "key": f.key, "max_len": f.max_len}
-                for f in self.mapper.fields
-            ]
+            fields = []
+            for f in self.mapper.fields:
+                if isinstance(f, Int):
+                    fields.append({"kind": "int", "key": f.key})
+                elif isinstance(f, Float):
+                    fields.append({"kind": "float", "key": f.key})
+                elif isinstance(f, Substr):
+                    fields.append(
+                        {"kind": "substr", "key": f.key, "start": f.start, "length": f.length}
+                    )
+                elif isinstance(f, Concat):
+                    fields.append(
+                        {"kind": "concat", "a": f.a, "b": f.b, "max_len": f.max_len}
+                    )
+                else:
+                    fields.append({"kind": "str", "key": f.key, "max_len": f.max_len})
             ops.append({"op": "map_project", "fields": fields})
         elif isinstance(self.mapper, _MapUppercase):
             ops.append({"op": "map_uppercase"})
-        return json.dumps({"name": self.name, "ops": ops})
+        doc = {"name": self.name, "ops": ops}
+        if self.where is not None:
+            doc["where"] = self.where.to_dict()
+        return json.dumps(doc)
 
     @staticmethod
     def from_json(blob: str | bytes) -> "TransformSpec":
@@ -126,16 +177,30 @@ class TransformSpec:
                     name="",
                 )
             elif kind == "map_project":
-                fields = tuple(
-                    Int(f["key"]) if f["kind"] == "int" else Str(f["key"], f["max_len"])
-                    for f in op["fields"]
-                )
-                spec = spec | TransformSpec(mapper=_MapProject(fields), name="")
+                fields = []
+                for f in op["fields"]:
+                    fk = f["kind"]
+                    if fk == "int":
+                        fields.append(Int(f["key"]))
+                    elif fk == "float":
+                        fields.append(Float(f["key"]))
+                    elif fk == "substr":
+                        fields.append(Substr(f["key"], f["start"], f["length"]))
+                    elif fk == "concat":
+                        fields.append(Concat(f["a"], f["b"], f["max_len"]))
+                    else:
+                        fields.append(Str(f["key"], f["max_len"]))
+                spec = spec | TransformSpec(mapper=_MapProject(tuple(fields)), name="")
             elif kind == "map_uppercase":
                 spec = spec | TransformSpec(mapper=_MapUppercase(), name="")
             else:
                 raise ValueError(f"unknown transform op {kind!r}")
-        return TransformSpec(spec.filters, spec.mapper, doc.get("name", "anon"))
+        w = None
+        if "where" in doc:
+            from redpanda_tpu.ops.exprs import Expr
+
+            w = Expr.from_dict(doc["where"])
+        return TransformSpec(spec.filters, spec.mapper, doc.get("name", "anon"), w)
 
 
 # ----------------------------------------------------------------- public DSL
@@ -163,7 +228,7 @@ def filter_field_eq(key: str, value) -> TransformSpec:
     )
 
 
-def map_project(*fields: Int | Str) -> TransformSpec:
+def map_project(*fields) -> TransformSpec:
     return TransformSpec(mapper=_MapProject(tuple(fields)), name="project")
 
 
@@ -171,10 +236,32 @@ def map_uppercase() -> TransformSpec:
     return TransformSpec(mapper=_MapUppercase(), name="upper")
 
 
+def where(expr) -> TransformSpec:
+    """v2 predicate: a field-anchored expression tree (ops.exprs).
+
+    Compiled to the columnar pushdown path: only referenced fields cross
+    the device link, the device evaluates the tree, one bit returns per
+    record. Combine with ``|`` like any other stage::
+
+        where((field("level") == "error") & (field("code") >= 500))
+            | map_project(Int("code"), Str("msg", 64))
+    """
+    from redpanda_tpu.ops.exprs import _as_expr
+
+    return TransformSpec(where=_as_expr(expr), name="where")
+
+
 def project_out_width(fields: Sequence) -> int:
     w = 0
     for f in fields:
-        w += 4 if isinstance(f, Int) else 2 + f.max_len
+        if isinstance(f, (Int, Float)):
+            w += 4
+        elif isinstance(f, Substr):
+            w += 2 + f.length
+        elif isinstance(f, Concat):
+            w += 2 + f.max_len
+        else:
+            w += 2 + f.max_len
     return w
 
 
@@ -270,8 +357,17 @@ def _compile_cached(spec_json: str, r_in: int):
     import jax.numpy as jnp
 
     spec = TransformSpec.from_json(spec_json)
+    if spec.where is not None:
+        raise ValueError(
+            "where-expression specs compile to the columnar path "
+            "(coproc/column_plan.py), not the raw-payload pipeline"
+        )
     mapper = spec.mapper
     if isinstance(mapper, _MapProject):
+        if any(not isinstance(f, (Int, Str)) for f in mapper.fields):
+            raise ValueError(
+                "Float/Substr/Concat projections require the columnar path"
+            )
         r_out = project_out_width(mapper.fields)
         if r_out > r_in:
             raise ValueError("projected width exceeds input width")

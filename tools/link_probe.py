@@ -1,0 +1,132 @@
+"""Measure the host<->device link characteristics (RTT, bandwidth, overlap).
+
+The coproc engine's performance ceiling is set by how the device link
+charges for work: per round trip, per byte, or both — and whether JAX's
+async dispatch actually overlaps transfers with compute on this backend.
+This probe measures each axis directly and prints one JSON document; the
+engine and bench use the same measurements (redpanda_tpu/ops/linkprof.py)
+to pick a bridge strategy at runtime.
+
+Run: python tools/link_probe.py            (whatever jax.devices() gives)
+     JAX_PLATFORMS=cpu python tools/link_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    out = {"device": str(dev)}
+
+    # --- RTT: tiny array round trip, H2D then D2H, fully synchronous.
+    tiny = np.zeros(8, np.uint8)
+    for _ in range(3):
+        np.asarray(jax.device_put(tiny))  # warm
+    t0 = time.perf_counter()
+    reps = 10
+    for _ in range(reps):
+        np.asarray(jax.device_put(tiny))
+    out["rtt_ms_put_get"] = round((time.perf_counter() - t0) / reps * 1e3, 2)
+
+    # --- H2D bandwidth: device_put of increasing sizes (sync via block).
+    h2d = {}
+    for mb in (1, 4, 16, 64):
+        arr = np.random.default_rng(0).integers(0, 255, mb << 20, np.uint8)
+        jax.block_until_ready(jax.device_put(arr))  # warm path
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(arr))
+        h2d[mb] = round(mb / (time.perf_counter() - t0), 1)
+    out["h2d_mb_s"] = h2d
+
+    # --- D2H bandwidth.
+    d2h = {}
+    for mb in (1, 4, 16, 64):
+        darr = jax.block_until_ready(
+            jax.device_put(np.zeros(mb << 20, np.uint8))
+        )
+        np.asarray(darr)  # warm
+        t0 = time.perf_counter()
+        np.asarray(darr)
+        d2h[mb] = round(mb / (time.perf_counter() - t0), 1)
+    out["d2h_mb_s"] = d2h
+
+    # --- dispatch cost: jitted no-op-ish program on resident data.
+    f = jax.jit(lambda x: x * 2 + 1)
+    darr = jax.block_until_ready(jax.device_put(np.zeros(1 << 20, np.uint8)))
+    jax.block_until_ready(f(darr))
+    t0 = time.perf_counter()
+    reps = 20
+    r = darr
+    for _ in range(reps):
+        r = f(r)
+    jax.block_until_ready(r)
+    out["dispatch_chain_ms"] = round((time.perf_counter() - t0) / reps * 1e3, 2)
+
+    # one dispatch with sync each time (cost of an isolated launch)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(f(darr))
+    out["dispatch_sync_ms"] = round((time.perf_counter() - t0) / reps * 1e3, 2)
+
+    # --- end-to-end single launch: numpy arg -> jit -> fetch, 16MB.
+    arr = np.random.default_rng(1).integers(0, 255, 16 << 20, np.uint8)
+    g = jax.jit(lambda x: (x.astype(jnp.int32).sum(), x[:1024]))
+    jax.block_until_ready(g(arr))
+    t0 = time.perf_counter()
+    reps = 4
+    for _ in range(reps):
+        s, head = g(arr)
+        np.asarray(head)
+    out["e2e_16mb_ms"] = round((time.perf_counter() - t0) / reps * 1e3, 1)
+
+    # --- overlap: do N independent launches pipeline? compare serial sync
+    # vs issue-all-then-drain for 8 x 8MB jobs.
+    arrs = [
+        np.random.default_rng(i).integers(0, 255, 8 << 20, np.uint8)
+        for i in range(8)
+    ]
+    h = jax.jit(lambda x: x.astype(jnp.int32).sum())
+    jax.block_until_ready(h(arrs[0]))
+    t0 = time.perf_counter()
+    for a in arrs:
+        jax.block_until_ready(h(a))
+    serial = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    outs = [h(a) for a in arrs]
+    jax.block_until_ready(outs)
+    piped = time.perf_counter() - t0
+    out["overlap_serial_ms"] = round(serial * 1e3, 1)
+    out["overlap_piped_ms"] = round(piped * 1e3, 1)
+    out["overlap_speedup"] = round(serial / piped, 2)
+
+    # --- donation: update a device-resident buffer in place (scatter rows).
+    big = jax.block_until_ready(
+        jax.device_put(np.zeros((16384, 1160), np.uint8))
+    )
+
+    @jax.jit
+    def scatter(buf, rows, idx):
+        return buf.at[idx].set(rows)
+
+    rows = np.ones((512, 1160), np.uint8)
+    idx = np.arange(512, dtype=np.int32)
+    big = jax.block_until_ready(scatter(big, rows, idx))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        big = scatter(big, rows, idx)
+    jax.block_until_ready(big)
+    out["scatter_512rows_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 2)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
