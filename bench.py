@@ -4,19 +4,30 @@ Measures record_batches/sec through the TPU engine (BASELINE.md config 4
 shape: JSON filter + project to a fixed struct, 64 partitions, zstd output)
 against a single-core host baseline that mirrors what the reference's
 Node.js sidecar does per record (decode framing, JSON parse, predicate,
-re-encode, re-CRC).
+re-encode, re-CRC — src/js/modules/rpc/server.ts:244-266).
 
 The engine is measured the way a broker drives it: a steady stream of ticks
 with GROUP ticks fused per launch and DEPTH launches in flight
-(submit_group / Ticket.result — coproc/engine.py). Every tick's records are
-exploded, packed, shipped to the device, transformed, fetched, reframed,
-recompressed, and resealed; the clock runs from first submit to the last
-fully-rebuilt reply.
+(submit_group / Ticket.result — coproc/engine.py). The spec is a v2
+where-expression, so the engine runs its columnar pushdown path: the native
+columnarizer ships per-field columns up, the device evaluates the predicate
+tree, one bit per record comes back, and outputs are assembled, framed,
+recompressed, and resealed host-side — the clock runs from first submit to
+the last fully-rebuilt reply.
 
-Secondary metrics (BASELINE.md configs 1-3) ride in the same JSON line:
-config 1 = produce-path batch CRC validation (device validator vs host
-crc32c loop), config 2 = 16-partition LZ4 produce codec path, config 3 =
-identity transform through the engine at 16 partitions.
+Secondary metrics ride in the same JSON line:
+- config 1 = produce-path batch CRC validation through the measured adapter
+  boundary (ops/crc_backend.py): BOTH host and device rates plus the
+  backend pick() chose.
+- config 2 = 16-partition LZ4 produce codec path.
+- config 3 = identity transform through the engine at 16 partitions (the
+  engine routes identity to its host stage — no device work exists for it),
+  plus config3_payload_bridge_16p = the same identity FORCED through the
+  full-row device staging path, the honest bridge-overhead number
+  (comparable to BENCH_r03's config3 collapse).
+- "stages" = the engine's per-stage wall/bytes breakdown for the headline
+  run; "link" = a quick device-link profile (RTT + H2D MB/s), so every
+  BENCH artifact carries the physics that justified the architecture.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -36,7 +47,7 @@ P = 64  # partitions
 RECORDS_PER_BATCH = 32
 RECORD_JSON_PAD = 900  # ~1KB records
 ROW_STRIDE = 1152
-GROUP = int(os.environ.get("BENCH_GROUP", "8"))  # ticks fused per launch
+GROUP = int(os.environ.get("BENCH_GROUP", "16"))  # ticks fused per launch
 DEPTH = int(os.environ.get("BENCH_DEPTH", "3"))  # launch groups in flight
 MEASURE_TICKS = int(os.environ.get("BENCH_TICKS", "48"))
 BASELINE_TICKS = 2
@@ -97,9 +108,10 @@ def _build_workload(n_partitions=P, topic="bench"):
 
 
 def _spec():
-    from redpanda_tpu.ops.transforms import Int, Str, filter_field_eq, map_project
+    from redpanda_tpu.ops.exprs import field
+    from redpanda_tpu.ops.transforms import Int, Str, map_project, where
 
-    return filter_field_eq("level", "error") | map_project(Int("code"), Str("msg", 64))
+    return where(field("level") == "error") | map_project(Int("code"), Str("msg", 64))
 
 
 def _run_engine_stream(engine, req, n_ticks, group, depth) -> float:
@@ -123,7 +135,7 @@ def _run_engine_stream(engine, req, n_ticks, group, depth) -> float:
     return n_ticks * n_batches / elapsed
 
 
-def run_tpu_engine(req) -> float:
+def run_tpu_engine(req) -> tuple[float, dict]:
     from redpanda_tpu.coproc import TpuEngine
 
     engine = TpuEngine(row_stride=ROW_STRIDE)
@@ -134,7 +146,13 @@ def run_tpu_engine(req) -> float:
     # by one tail-sized group), so no XLA compile lands in the timed run.
     tail = MEASURE_TICKS % GROUP
     _run_engine_stream(engine, req, GROUP + (tail or min(GROUP, MEASURE_TICKS)), GROUP, DEPTH)
-    return _run_engine_stream(engine, req, MEASURE_TICKS, GROUP, DEPTH)
+    engine.reset_stats()
+    rate = _run_engine_stream(engine, req, MEASURE_TICKS, GROUP, DEPTH)
+    stages = {
+        k: (round(v, 4) if k.startswith("t_") else int(v))
+        for k, v in sorted(engine.stats().items())
+    }
+    return rate, stages
 
 
 def run_cpu_baseline(req) -> float:
@@ -182,18 +200,16 @@ def run_cpu_baseline(req) -> float:
 
 
 def run_config1_crc_validate() -> dict:
-    """Config 1: produce-path batch CRC validation, 1KB records.
+    """Config 1: produce-path batch CRC validation, 1KB records, through
+    the measured adapter boundary (ops/crc_backend.py — the call site the
+    reference hard-codes at kafka_batch_adapter.cc:93-121).
 
-    Device batch validator (ops/pipeline.make_batch_validator — the produce
-    adapter boundary, kafka_batch_adapter.cc:93) vs a single-core host
-    crc32c loop over the same wire regions."""
-    import jax
-
-    from redpanda_tpu.hashing.crc32c import crc32c
+    Reports both measured rates and the backend the probe chose; the chosen
+    path is what the produce handler runs, so vs_host_single_core reflects
+    the DECISION, not a forced device run."""
     from redpanda_tpu.models import Record, RecordBatch
-    from redpanda_tpu.ops.pipeline import make_batch_validator
+    from redpanda_tpu.ops.crc_backend import CrcBackend
 
-    n, r = 1024, 1536
     batches = [
         RecordBatch.build(
             [Record(offset_delta=i, value=bytes([i % 251]) * 1024) for i in range(1)],
@@ -201,29 +217,18 @@ def run_config1_crc_validate() -> dict:
         )
         for b in range(64)
     ]
-    regions = [b.crc_region() for b in batches] * (n // 64)
-    claimed = np.array(
-        [b.header.crc for b in batches] * (n // 64), dtype=np.uint32
+    regions = [b.crc_region() for b in batches] * 16  # 1024 batches
+    backend = CrcBackend.pick(regions, reps=8)
+    d = backend.decision
+    chosen_rate = (
+        d.device_batches_per_sec if backend.backend == "device" else d.host_batches_per_sec
     )
-    from redpanda_tpu.ops.packing import pack_rows
-
-    rows, lens = pack_rows(regions, r)
-    validate = make_batch_validator(r)
-    ok = np.asarray(validate(rows, lens, claimed))
-    assert ok.all()
-    # steady-state pipelined device throughput
-    reps = 12
-    t0 = time.perf_counter()
-    outs = [validate(rows, lens, claimed) for _ in range(reps)]
-    jax.block_until_ready(outs)
-    dev_rate = reps * n / (time.perf_counter() - t0)
-    t0 = time.perf_counter()
-    for reg, c in zip(regions, claimed):
-        assert crc32c(reg) == c
-    host_rate = n / (time.perf_counter() - t0)
     return {
-        "batches_per_sec": round(dev_rate, 1),
-        "vs_host_single_core": round(dev_rate / host_rate, 2),
+        "batches_per_sec": round(chosen_rate, 1),
+        "vs_host_single_core": round(chosen_rate / d.host_batches_per_sec, 2),
+        "host_batches_per_sec": round(d.host_batches_per_sec, 1),
+        "device_batches_per_sec": round(d.device_batches_per_sec, 1),
+        "chosen_backend": backend.backend,
     }
 
 
@@ -254,13 +259,17 @@ def run_config2_lz4_produce() -> dict:
     return {"mb_per_sec": round(reps * total_bytes / 1e6 / elapsed, 1)}
 
 
-def run_config3_identity(engine_cls) -> dict:
-    """Config 3: identity transform at 16 partitions (engine bridge
-    overhead, the reference's WASM-engine baseline shape)."""
+def run_config3_identity(engine_cls, force_mode=None) -> dict:
+    """Config 3: identity transform at 16 partitions.
+
+    Default: the engine's real identity path (routed to the host stage —
+    identity has no device work; coproc/column_plan.py plan_spec).
+    force_mode="payload": the full-row device staging path, isolating raw
+    bridge overhead (the number that collapsed to 490 rb/s in BENCH_r03)."""
     from redpanda_tpu.ops.transforms import identity
 
     req16 = _build_workload(16, topic="bench3")
-    engine = engine_cls(row_stride=ROW_STRIDE)
+    engine = engine_cls(row_stride=ROW_STRIDE, force_mode=force_mode)
     codes = engine.enable_coprocessors([(1, identity().to_json(), ("bench3",))])
     assert codes[0] == 0
     _run_engine_stream(engine, req16, GROUP, GROUP, DEPTH)
@@ -268,12 +277,32 @@ def run_config3_identity(engine_cls) -> dict:
     return {"record_batches_per_sec": round(rate, 1)}
 
 
+def run_link_profile() -> dict:
+    """Quick device-link physics: sync RTT and H2D bandwidth (the numbers
+    that justify columnar pushdown; full probe in tools/link_probe.py)."""
+    import jax
+
+    tiny = np.zeros(8, np.uint8)
+    np.asarray(jax.device_put(tiny))  # warm
+    t0 = time.perf_counter()
+    for _ in range(3):
+        np.asarray(jax.device_put(tiny))
+    rtt_ms = (time.perf_counter() - t0) / 3 * 1e3
+    arr = np.random.default_rng(0).integers(0, 255, 8 << 20, np.uint8)
+    f = jax.jit(lambda x: x.astype(np.int32).sum())
+    jax.block_until_ready(f(arr))  # warm + compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(f(arr))
+    h2d = 8 / (time.perf_counter() - t0)
+    return {"rtt_ms": round(rtt_ms, 1), "h2d_mb_s_consumed": round(h2d, 1)}
+
+
 def main():
     tpu_ok = _probe_tpu()
     if not tpu_ok:
         _pin_cpu()
     req = _build_workload()
-    value = run_tpu_engine(req)
+    value, stages = run_tpu_engine(req)
     baseline = run_cpu_baseline(req)
     import jax
 
@@ -284,6 +313,10 @@ def main():
         extras["config1_crc_validate"] = run_config1_crc_validate()
         extras["config2_lz4_produce"] = run_config2_lz4_produce()
         extras["config3_identity_16p"] = run_config3_identity(TpuEngine)
+        extras["config3_payload_bridge_16p"] = run_config3_identity(
+            TpuEngine, force_mode="payload"
+        )
+        extras["link"] = run_link_profile()
     except Exception as exc:  # secondary metrics must never sink the bench
         extras["configs_error"] = repr(exc)
 
@@ -300,6 +333,8 @@ def main():
                 "records_per_batch": RECORDS_PER_BATCH,
                 "group_ticks_per_launch": GROUP,
                 "launch_depth": DEPTH,
+                "engine_mode": "columnar",
+                "stages": stages,
                 **extras,
             }
         )

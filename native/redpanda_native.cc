@@ -248,15 +248,19 @@ static inline int64_t skip_ws(const uint8_t* s, int64_t i, int64_t end) {
 }
 
 static int64_t skip_string(const uint8_t* s, int64_t i, int64_t end) {
+  // memchr-accelerated: jump to each '"' and check whether it is escaped
+  // (odd run of preceding backslashes). Equivalent to the byte-stepping
+  // Python reference (ops/exprs.py _skip_string) on every input.
   i++;  // opening quote
   while (i < end) {
-    uint8_t c = s[i];
-    if (c == '\\') {
-      i += 2;
-      continue;
-    }
-    if (c == '"') return i + 1;
-    i++;
+    const uint8_t* q =
+        (const uint8_t*)std::memchr(s + i, '"', (size_t)(end - i));
+    if (!q) return end;
+    int64_t qi = q - s;
+    int64_t bs = qi - 1;
+    while (bs >= i && s[bs] == '\\') bs--;
+    if (((qi - 1 - bs) & 1) == 0) return qi + 1;  // even backslashes: closes
+    i = qi + 1;
   }
   return end;
 }
@@ -431,7 +435,16 @@ int64_t rp_extract_num(const uint8_t* joined, const int64_t* offsets,
     } else if (t == 2) {  // number
       char buf[48];
       int64_t tl = ve - vs;
-      if (tl > 0 && tl < (int64_t)sizeof(buf)) {
+      // Restrict to decimal-number characters BEFORE strtod: strtod also
+      // accepts hex (0x10) / inf / nan, which the Python oracle rejects —
+      // the token must stay PRESENT-only on both paths (parity contract).
+      bool decimal_chars = tl > 0;
+      for (int64_t k = 0; k < tl && decimal_chars; k++) {
+        uint8_t c = joined[offsets[i] + vs + k];
+        decimal_chars = (c >= '0' && c <= '9') || c == '-' || c == '+' ||
+                        c == '.' || c == 'e' || c == 'E';
+      }
+      if (decimal_chars && tl < (int64_t)sizeof(buf)) {
         std::memcpy(buf, joined + offsets[i] + vs, (size_t)tl);
         buf[tl] = 0;
         char* endp = nullptr;

@@ -29,7 +29,6 @@ axis), and `assemble_rows` materializes projection outputs.
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
@@ -259,11 +258,12 @@ def plan_spec(spec: TransformSpec, py_fn=None):
     if isinstance(spec.mapper, _MapUppercase):
         return HostPlan(spec, "uppercase")
     if isinstance(spec.mapper, _MapProject):
-        # projection with no predicate: columnar with empty expr (keep all)
-        proj = spec.mapper.fields
-        return ColumnarPlan(
-            spec, [], tuple(proj), project_out_width(proj), passthrough=False
-        )
+        # A v1 projection-only spec keeps the v1 payload pipeline: its Int
+        # semantics differ from columnar (v1 _parse_int_at truncates "3.5"
+        # to 3; columnar requires an exact integer) and deployed spec JSON
+        # must not change outputs across an upgrade. v2 columnar projection
+        # is opted into by writing a where() stage.
+        return PayloadPlan(spec)
     return HostPlan(spec, "identity")
 
 
@@ -360,26 +360,14 @@ def _build_expr(jnp, expr, slots):
         and float(v) == int(v)
         and -(2**31) <= int(v) <= 2**31 - 1
     )
-    fcmp = _cmp(jnp, expr.op, f32, jnp.float32(np.float32(float(v))))
+    # E._cmp_num is dtype-generic; sharing it keeps host-oracle and device
+    # comparison semantics in one place.
+    fcmp = E._cmp_num(expr.op, f32, jnp.float32(np.float32(float(v))))
     if const_int:
         int_exact = (flags & E.F_INT_EXACT) != 0
-        icmp = _cmp(jnp, expr.op, i32, jnp.int32(int(v)))
+        icmp = E._cmp_num(expr.op, i32, jnp.int32(int(v)))
         return isnum & jnp.where(int_exact, icmp, fcmp)
     return isnum & fcmp
-
-
-def _cmp(jnp, op, a, b):
-    if op == "eq":
-        return a == b
-    if op == "ne":
-        return a != b
-    if op == "lt":
-        return a < b
-    if op == "le":
-        return a <= b
-    if op == "gt":
-        return a > b
-    return a >= b
 
 
 def _contains(jnp, bytes_col, vlen, needle: bytes, window: int):

@@ -227,17 +227,29 @@ async def _produce_one(broker, topic: str, p: dict, level: int) -> dict:
     if not records:
         return _produce_partition_error(index, E.invalid_record)
     try:
-        adapted = decode_wire_batches(records, verify_crc=True)
+        # CRC validation goes through the measured adapter boundary
+        # (ops/crc_backend.py): batched host SSE4.2 or device kernel,
+        # whichever the process-wide probe picked.
+        adapted = decode_wire_batches(records, verify_crc=False)
     except EOFError:
         return _produce_partition_error(index, E.corrupt_message)
-    batches = []
+    from redpanda_tpu.ops.crc_backend import default_backend
+
+    v2 = [a for a in adapted if a.v2_format]
+    ok = default_backend().validate(
+        [a.batch.crc_region() for a in v2],
+        [a.batch.header.crc for a in v2],
+    )
+    ok_iter = iter(ok)
     for a in adapted:
-        # kafka_batch_adapter.cc:93-121: reject legacy magic and bad CRC
+        # kafka_batch_adapter.cc:93-121: per batch IN ORDER, reject legacy
+        # magic first, then a bad CRC — the first offending batch decides
+        # the error (validation itself is batched through the backend).
         if not a.v2_format:
             return _produce_partition_error(index, E.unsupported_for_message_format)
-        if not a.valid_crc:
+        if not next(ok_iter):
             return _produce_partition_error(index, E.corrupt_message)
-        batches.append(a.batch)
+    batches = [a.batch for a in adapted]
     if not batches:
         return _produce_partition_error(index, E.invalid_record)
     # idempotence / transaction gate (rm_stm on the produce path,

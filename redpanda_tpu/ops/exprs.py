@@ -346,9 +346,13 @@ def _num_lattice(tok: bytes) -> tuple[float, int, int]:
     import numpy as np
 
     try:
-        # strtod parity: no '_' separators; tokens too long for the native
-        # 48-byte parse buffer are PRESENT but not NUMBER on both paths.
-        if b"_" in tok or len(tok) >= 48:
+        # Native-parity grammar: decimal-number characters only (float()
+        # would also take 'inf'/'nan'/'_', strtod would take hex — both are
+        # PRESENT-only on both paths), and tokens too long for the native
+        # 48-byte parse buffer stay PRESENT-only too.
+        if len(tok) >= 48 or not tok or any(
+            c not in b"0123456789-+.eE" for c in tok
+        ):
             raise ValueError(tok)
         d = float(tok)
     except ValueError:

@@ -1,0 +1,63 @@
+"""Adapter-boundary CRC backend (ops/crc_backend.py): host/device parity
+and the measured pick() decision. Reference call site it replaces:
+kafka_batch_adapter.cc:93-121."""
+
+import numpy as np
+
+from redpanda_tpu.hashing.crc32c import crc32c
+from redpanda_tpu.models import Record, RecordBatch
+from redpanda_tpu.ops.crc_backend import CrcBackend
+
+
+def _regions():
+    batches = [
+        RecordBatch.build(
+            [Record(offset_delta=0, value=bytes([b % 251]) * 700)],
+            base_offset=b,
+        )
+        for b in range(16)
+    ]
+    regions = [b.crc_region() for b in batches]
+    claimed = np.array([b.header.crc for b in batches], np.uint32)
+    return regions, claimed
+
+
+def test_host_device_agree():
+    regions, claimed = _regions()
+    host = CrcBackend("host").validate(regions, claimed)
+    dev = CrcBackend("device").validate(regions, claimed)
+    assert host.all() and dev.all()
+    bad = claimed.copy()
+    bad[3] ^= 0xDEAD
+    bad[11] ^= 1
+    h = CrcBackend("host").validate(regions, bad)
+    d = CrcBackend("device").validate(regions, bad)
+    assert (h == d).all()
+    assert not h[3] and not h[11] and h.sum() == 14
+
+
+def test_pick_records_measurement():
+    regions, _ = _regions()
+    b = CrcBackend.pick(regions, reps=2)
+    assert b.backend in ("host", "device")
+    assert b.decision is not None
+    assert b.decision.host_batches_per_sec > 0
+    # On the CPU test backend the device path still measures; the decision
+    # must be the argmax of the two measured rates.
+    want = (
+        "device"
+        if b.decision.device_batches_per_sec > b.decision.host_batches_per_sec
+        else "host"
+    )
+    assert b.backend == want
+
+
+def test_pick_without_device_probe():
+    regions, _ = _regions()
+    b = CrcBackend.pick(regions, reps=1, probe_device=False)
+    assert b.backend == "host"
+    assert b.decision.device_batches_per_sec == 0.0
+
+
+def test_validate_empty():
+    assert CrcBackend("host").validate([], []).shape == (0,)

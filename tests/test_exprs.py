@@ -260,8 +260,9 @@ class TestEngineColumnar:
         ]
         assert out == want
 
-    def test_projection_only(self):
-        spec = map_project(Int("code"))
+    def test_projection_with_trivial_where(self):
+        # Columnar projection semantics (exact ints only) opt in via where().
+        spec = where(field("code").exists()) | map_project(Int("code"))
         out = self._run(spec, DOCS)
         want = [
             int(d["code"]).to_bytes(4, "little", signed=True)
@@ -271,6 +272,19 @@ class TestEngineColumnar:
             and abs(d["code"]) <= 999_999_999
         ]
         assert out == want
+
+    def test_projection_only_keeps_v1_payload_semantics(self):
+        # A v1 map_project-only spec must keep v1 outputs across the
+        # upgrade: _parse_int_at truncates "3.5" -> 3 instead of dropping.
+        from redpanda_tpu.coproc.column_plan import plan_spec
+
+        spec = map_project(Int("code"))
+        assert plan_spec(spec).mode == "payload"
+        out = self._run(spec, [{"code": 3.5}, {"code": 7}])
+        assert out == [
+            (3).to_bytes(4, "little", signed=True),
+            (7).to_bytes(4, "little", signed=True),
+        ]
 
     def test_substr_concat_float(self):
         docs = [
@@ -351,9 +365,25 @@ class TestEngineColumnar:
 
     def test_int_min_projection_dropped(self):
         docs = [{"code": -(2**31)}, {"code": -999_999_999}]
-        spec = map_project(Int("code"))
+        spec = where(field("code").exists()) | map_project(Int("code"))
         out = self._run(spec, docs)
         assert out == [(-999_999_999).to_bytes(4, "little", signed=True)]
+
+    def test_hex_and_inf_tokens_present_only(self):
+        from redpanda_tpu.native import lib
+
+        if lib is None:
+            pytest.skip("native lib unavailable")
+        docs = [b'{"a":0x10}', b'{"a":inf}', b'{"a":nan}', b'{"a":1e5}']
+        joined = b"".join(docs)
+        offsets = np.cumsum([0] + [len(d) for d in docs[:-1]]).astype(np.int64)
+        sizes = np.array([len(d) for d in docs], np.int32)
+        _, _, fl = lib.extract_num(joined, offsets, sizes, "a")
+        for d, f in zip(docs, fl):
+            h = E.host_field(d, "a")
+            assert f == h["flags"], (d, f, h["flags"])
+        assert list(fl) == [E.F_PRESENT, E.F_PRESENT, E.F_PRESENT,
+                            E.F_PRESENT | E.F_NUMBER | E.F_INT_EXACT]
 
     def test_stats_populated(self):
         spec = where(field("level") == "error") | map_project(Int("code"))

@@ -3,9 +3,11 @@
 The coproc engine's performance ceiling is set by how the device link
 charges for work: per round trip, per byte, or both — and whether JAX's
 async dispatch actually overlaps transfers with compute on this backend.
-This probe measures each axis directly and prints one JSON document; the
-engine and bench use the same measurements (redpanda_tpu/ops/linkprof.py)
-to pick a bridge strategy at runtime.
+This probe measures each axis directly and prints one JSON document. The
+measurements drove the engine's execution-mode design
+(redpanda_tpu/coproc/column_plan.py module docs) and are re-recorded in
+every BENCH artifact (bench.run_link_profile); the produce-path CRC
+backend makes its own runtime timing probe (redpanda_tpu/ops/crc_backend.py).
 
 Run: python tools/link_probe.py            (whatever jax.devices() gives)
      JAX_PLATFORMS=cpu python tools/link_probe.py
