@@ -84,6 +84,11 @@ class Application:
 
     async def start(self) -> "Application":
         c = self.config
+        # refuse unsuitable environments up front with actionable messages
+        # (application.cc:364-373 check_environment -> syschecks)
+        from redpanda_tpu.syschecks import check_environment
+
+        check_environment(c)
         self.rpc_tls = self._tls_for("rpc_server")
         self.storage = await StorageApi(c.data_directory).start()
         self._stop_order.append(self.storage)
@@ -170,6 +175,11 @@ class Application:
             recovery_concurrency=c.raft_recovery_concurrency,
         )
         self.controller = Controller(self_vnode, self.group_manager, self.connections)
+        # One topic table per node: the controller STM's replicated view IS
+        # the broker's view (topic_table.h — metadata_cache aggregates the
+        # same table). The broker's standalone-mode private table is only
+        # for controller-less single-node runs.
+        self.broker.topic_table = self.controller.topic_table
         dispatcher = ControllerDispatcher(self.controller, self.connections)
         leaders = PartitionLeadersTable()
         self.md_dissemination = MetadataDisseminationService(
@@ -183,11 +193,23 @@ class Application:
                 ccmds.finish_moving_cmd(ntp, reps)
             ),
         )
-        self.group_manager.register_leadership_notification(
-            lambda cons: self.md_dissemination.notify_leadership(
+        def _on_leadership(cons):
+            self.md_dissemination.notify_leadership(
                 cons.ntp, cons.leader_id, cons.term
             )
-        )
+            # Coordinator failover: gaining a group-topic partition means
+            # replaying its log into group state (group_manager.cc
+            # handle_leader_change), or committed offsets vanish for every
+            # group hashed onto the partition.
+            if (
+                cons.ntp.topic == "__consumer_offsets"
+                and cons.leader_id == c.node_id
+            ):
+                self.broker.group_coordinator.on_leadership_gained(
+                    cons.ntp.partition
+                )
+
+        self.group_manager.register_leadership_notification(_on_leadership)
         proto = rpc.SimpleProtocol()
         self.group_manager.register_service(proto)
         ClusterService(self.controller, dispatcher).register(proto)
@@ -216,6 +238,17 @@ class Application:
         await self.controller.start(seed_vnodes)
         await self.backend.start()
         await self.md_dissemination.start()
+        # A (re)starting broker only hears about FUTURE elections from the
+        # gossip loop; leaders elected while it was down must be pulled from
+        # a peer (metadata_dissemination get_leadership_request semantics).
+        for node_id, _h, _p in seeds:
+            if node_id == c.node_id:
+                continue
+            try:
+                await self.md_dissemination.pull_initial(node_id)
+                break
+            except Exception:
+                continue  # peer down/fresh cluster: gossip will catch us up
         self._stop_order += [self.md_dissemination, self.backend, self.controller]
 
         self.broker.controller_dispatcher = dispatcher
@@ -224,12 +257,18 @@ class Application:
         self.broker.metadata_cache = MetadataCache(
             self.controller.topic_table, self.controller.members, leaders
         )
-        # announce ourselves through the controller once a leader exists
+        # announce ourselves through the controller once a leader exists.
+        # In a real multi-process cluster the first election only completes
+        # after a MAJORITY of seed brokers finish interpreter startup (~10s
+        # each), so registration must outwait peers, not give up in the
+        # default few retries (tests/chaos drives this path with SIGKILLed
+        # real processes; raft_availability_test.py posture).
         await dispatcher.replicate(
             ccmds.register_node_cmd(
                 c.node_id, c.rpc_server_host, self.rpc_server.port,
                 c.advertised_kafka_api_host, c.advertised_kafka_api_port,
-            )
+            ),
+            retries=300,
         )
 
     async def _start_coproc(self) -> None:
@@ -277,6 +316,14 @@ class Application:
         )
         registry.gauge(
             "topics_total", lambda: len(b.topic_table.topics()), "Known topics"
+        )
+        bc = self.storage.log_mgr.batch_cache
+        registry.gauge("batch_cache_hits", lambda: bc.hits, "Batch cache hits")
+        registry.gauge(
+            "batch_cache_misses", lambda: bc.misses, "Batch cache misses"
+        )
+        registry.gauge(
+            "batch_cache_bytes", lambda: bc.bytes_used, "Batch cache bytes"
         )
 
     # ------------------------------------------------------------ shutdown

@@ -49,6 +49,16 @@ class NotControllerError(ClusterError):
         self.leader = leader
 
 
+class TopicExistsError(ClusterError):
+    """Typed so the RPC/dispatcher layers map it to the single-node
+    contract (ValueError from topic_table.add_topic) instead of pattern-
+    matching error strings."""
+
+    def __init__(self, name: str) -> None:
+        super().__init__(f"topic exists: {name}")
+        self.topic = name
+
+
 class ControllerStm(MuxStateMachine):
     """Applies replicated commands to the node-local tables.
 
@@ -297,7 +307,7 @@ class Controller:
         if not self.is_leader():
             raise NotControllerError(self.leader_id)
         if self.topic_table.contains(cfg.name):
-            raise ClusterError(f"topic exists: {cfg.name}")
+            raise TopicExistsError(cfg.name)
         replica_sets = self.allocator.allocate(
             cfg.partition_count, cfg.replication_factor
         )

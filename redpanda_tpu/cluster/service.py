@@ -15,7 +15,12 @@ import logging
 
 from redpanda_tpu import rpc
 from redpanda_tpu.cluster.commands import Command, CommandType
-from redpanda_tpu.cluster.controller import ClusterError, Controller, NotControllerError
+from redpanda_tpu.cluster.controller import (
+    ClusterError,
+    Controller,
+    NotControllerError,
+    TopicExistsError,
+)
 from redpanda_tpu.cluster.members import Broker
 from redpanda_tpu.rpc import serde
 
@@ -35,6 +40,11 @@ JOIN_NODE_REQUEST = serde.S(
     ("kafka_port", serde.I32),
 )
 JOIN_NODE_REPLY = REPLICATE_CMD_REPLY
+# Topic ops need LEADER-side logic (partition allocation, group ids), so
+# they cannot ride replicate_command's pre-built payloads; op: 0 create,
+# 1 delete, 2 add_partitions (controller.json create/delete_topic analogue).
+TOPIC_OP_REQUEST = serde.S(("op", serde.I32), ("data_json", serde.BYTES))
+TOPIC_OP_REPLY = REPLICATE_CMD_REPLY
 
 cluster_service = rpc.ServiceDef(
     "cluster",
@@ -42,10 +52,32 @@ cluster_service = rpc.ServiceDef(
     [
         rpc.MethodDef("replicate_command", REPLICATE_CMD_REQUEST, REPLICATE_CMD_REPLY),
         rpc.MethodDef("join_node", JOIN_NODE_REQUEST, JOIN_NODE_REPLY),
+        rpc.MethodDef("topic_op", TOPIC_OP_REQUEST, TOPIC_OP_REPLY),
     ],
 )
 
-_OK, _NOT_LEADER, _ERROR = 0, 1, 2
+_OK, _NOT_LEADER, _ERROR, _EXISTS = 0, 1, 2, 3
+
+
+async def apply_topic_op(controller: Controller, op: int, data: dict) -> None:
+    """Leader-side topic mutation; the ONE implementation used by both the
+    RPC handler and the dispatcher's local-leader path."""
+    if op == 0:
+        from redpanda_tpu.cluster.topic_table import TopicConfig
+
+        cfg = TopicConfig(
+            data["name"],
+            data["partitions"],
+            data["replication"],
+            ns=data.get("ns", "kafka"),
+        )
+        for k, v in (data.get("overrides") or {}).items():
+            cfg.apply_override(k, v)
+        await controller.create_topic(cfg)
+    elif op == 1:
+        await controller.delete_topic(data["name"], data.get("ns", "kafka"))
+    else:
+        await controller.create_partitions(data["name"], data["total"])
 
 
 class ClusterService:
@@ -76,6 +108,22 @@ class ClusterService:
             return self._reply(_NOT_LEADER)
         except Exception as e:
             logger.exception("replicate_command failed")
+            return self._reply(_ERROR, str(e))
+
+    async def topic_op(self, req: dict) -> dict:
+        """Leader-side topic mutation (create/delete/add_partitions)."""
+        data = json.loads(req["data_json"].decode())
+        try:
+            await apply_topic_op(self.controller, req["op"], data)
+            return self._reply(_OK)
+        except NotControllerError:
+            return self._reply(_NOT_LEADER)
+        except TopicExistsError as e:
+            return self._reply(_EXISTS, str(e))
+        except ClusterError as e:
+            return self._reply(_ERROR, str(e))
+        except Exception as e:
+            logger.exception("topic_op failed")
             return self._reply(_ERROR, str(e))
 
     async def join_node(self, req: dict) -> dict:
@@ -133,6 +181,55 @@ class ControllerDispatcher:
                 return
             last = reply["message"] or f"errc={reply['errc']}"
         raise ClusterError(f"controller mutation failed: {last}", retriable=True)
+
+    async def topic_op(
+        self, op: int, data: dict, *, retries: int = 25, timeout: float = 10.0
+    ) -> None:
+        """Create/delete/add_partitions on the controller leader, from any
+        broker. Leader-side because allocation + group-id assignment live
+        there. Only LEADERLESS states retry (elections in flight — a real
+        cluster spends seconds leaderless after a kill); permanent errors
+        (exists, allocation impossible) surface immediately and identically
+        from both the local-leader and the forwarded path.
+
+        Raises ValueError for already-exists (the single-node
+        topic_table.add_topic contract every idempotent caller handles).
+        """
+        import asyncio
+
+        last = "no controller leader"
+        for _ in range(retries):
+            if self.controller.is_leader():
+                try:
+                    await apply_topic_op(self.controller, op, data)
+                    return
+                except NotControllerError:
+                    pass  # lost leadership mid-call; fall through to forward
+                except TopicExistsError as e:
+                    raise ValueError(str(e)) from e
+            leader = self.controller.leader_id
+            if leader is None or leader == self.controller.self_node.id:
+                await asyncio.sleep(0.2)
+                continue
+            try:
+                client = rpc.Client(cluster_service, self.connections.get(leader))
+                reply = await client.topic_op(
+                    {"op": op, "data_json": json.dumps(data).encode()},
+                    timeout=timeout,
+                )
+            except Exception as e:  # leader just died: retry after re-election
+                last = str(e)
+                await asyncio.sleep(0.2)
+                continue
+            if reply["errc"] == _OK:
+                return
+            last = reply["message"] or f"errc={reply['errc']}"
+            if reply["errc"] == _EXISTS:
+                raise ValueError(last)
+            if reply["errc"] == _ERROR:
+                raise ClusterError(last)  # permanent: no retry
+            await asyncio.sleep(0.2)  # _NOT_LEADER: election in flight
+        raise ClusterError(f"topic op failed: {last}", retriable=True)
 
 
 async def join_cluster(

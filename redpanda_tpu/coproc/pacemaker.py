@@ -241,8 +241,12 @@ class Pacemaker:
                 src_md = self.broker.topic_table.get(source.topic)
                 n_parts = src_md.config.partition_count if src_md else 1
                 try:
+                    # Materialized logs live NEXT TO their source partition
+                    # (script_context_backend.cc:70-78 direct storage
+                    # append, no raft) — never controller-allocated.
                     await self.broker.create_topic(
-                        TopicConfig(mntp.topic, n_parts, 1, ns=mntp.ns)
+                        TopicConfig(mntp.topic, n_parts, 1, ns=mntp.ns),
+                        local_only=True,
                     )
                 except ValueError:
                     pass

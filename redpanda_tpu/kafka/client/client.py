@@ -250,9 +250,19 @@ class KafkaClient:
     async def leader_connection(self, topic: str, partition: int) -> BrokerConnection:
         key = (topic, partition)
         if key not in self._leaders:
-            await self.refresh_metadata([topic])
-        if key not in self._leaders:
-            raise KafkaError(ErrorCode.unknown_topic_or_partition, f"{topic}/{partition}")
+            # A just-created partition is mid-election (leader_id -1 in
+            # metadata); standard client behavior polls metadata rather
+            # than failing the first produce after create_topic.
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while True:
+                await self.refresh_metadata([topic])
+                if key in self._leaders:
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    raise KafkaError(
+                        ErrorCode.unknown_topic_or_partition, f"{topic}/{partition}"
+                    )
+                await asyncio.sleep(0.25)
         return await self.connection_for(self._leaders[key])
 
     async def any_connection(self) -> BrokerConnection:

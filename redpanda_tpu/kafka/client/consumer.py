@@ -121,10 +121,25 @@ class GroupConsumer:
     # ------------------------------------------------------------ membership
     async def _coordinator(self):
         if self._coord is None:
-            conn = await self.client.any_connection()
-            resp = await conn.request(m.FIND_COORDINATOR, {"key": self.group_id, "key_type": 0})
-            if resp["error_code"] != 0:
-                raise KafkaError(ErrorCode(resp["error_code"]), "find_coordinator")
+            # coordinator_not_available is a POLL signal, not a failure:
+            # right after the group topic's creation (or a coordinator
+            # node's death) the partition is mid-election. Standard client
+            # behavior is retry-with-backoff until the deadline.
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while True:
+                conn = await self.client.any_connection()
+                resp = await conn.request(
+                    m.FIND_COORDINATOR, {"key": self.group_id, "key_type": 0}
+                )
+                code = resp["error_code"]
+                if code == 0:
+                    break
+                if (
+                    code != int(ErrorCode.coordinator_not_available)
+                    or asyncio.get_event_loop().time() > deadline
+                ):
+                    raise KafkaError(ErrorCode(code), "find_coordinator")
+                await asyncio.sleep(0.25)
             await self.client.refresh_metadata()
             if resp["node_id"] in self.client._brokers:
                 self._coord = await self.client.connection_for(resp["node_id"])

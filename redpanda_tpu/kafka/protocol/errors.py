@@ -98,5 +98,5 @@ class KafkaError(Exception):
     """Raised by handlers to short-circuit into an error response."""
 
     def __init__(self, code: ErrorCode, message: str = ""):
-        super().__init__(message or code.name)
+        super().__init__(f"{code.name}: {message}" if message else code.name)
         self.code = code

@@ -191,7 +191,40 @@ class Broker:
         return rev
 
     # ------------------------------------------------------------ topics
-    async def create_topic(self, config: TopicConfig) -> None:
+    async def _await_topic_table(self, pred, what: str, timeout: float = 15.0) -> None:
+        """The requesting node applies committed controller commands
+        asynchronously (its own STM replay); callers of the kafka API see
+        the mutation once the LOCAL table reflects it."""
+        import asyncio
+        import time as _t
+
+        deadline = _t.monotonic() + timeout
+        while not pred():
+            if _t.monotonic() > deadline:
+                raise TimeoutError(f"{what} not applied locally in {timeout}s")
+            await asyncio.sleep(0.05)
+
+    async def create_topic(self, config: TopicConfig, *, local_only: bool = False) -> None:
+        """Create a topic. Clustered: route through the controller leader
+        (allocation + replicated create_topic_cmd — topics_frontend path,
+        SURVEY §3.5); every replica node reconciles its own raft member.
+        Standalone (or local_only, used for per-node materialized logs):
+        single-replica local creation."""
+        if self.controller_dispatcher is not None and not local_only:
+            await self.controller_dispatcher.topic_op(0, {
+                "name": config.name,
+                "ns": config.ns,
+                "partitions": config.partition_count,
+                "replication": config.replication_factor,
+                "overrides": {
+                    k: v for k, v in config.config_map().items() if v is not None
+                },
+            })
+            await self._await_topic_table(
+                lambda: self.topic_table.contains(config.name),
+                f"create {config.name}",
+            )
+            return
         if config.revision == 0:
             config.revision = self._next_revision()
         md = self.topic_table.add_topic(
@@ -206,6 +239,14 @@ class Broker:
     async def delete_topic(self, name: str) -> None:
         from redpanda_tpu.storage.kvstore import KeySpace
 
+        if self.controller_dispatcher is not None:
+            md = self.topic_table.get(name)
+            ns = md.config.ns if md is not None else "kafka"
+            await self.controller_dispatcher.topic_op(1, {"name": name, "ns": ns})
+            await self._await_topic_table(
+                lambda: not self.topic_table.contains(name), f"delete {name}"
+            )
+            return
         md = self.topic_table.remove_topic(name)
         for pa in md.assignments.values():
             await self.partition_manager.remove(pa.ntp)
@@ -217,6 +258,18 @@ class Broker:
         )
 
     async def create_partitions(self, name: str, new_count: int) -> None:
+        if self.controller_dispatcher is not None:
+            await self.controller_dispatcher.topic_op(
+                2, {"name": name, "total": new_count}
+            )
+            await self._await_topic_table(
+                lambda: (
+                    (md := self.topic_table.get(name)) is not None
+                    and md.config.partition_count >= new_count
+                ),
+                f"add_partitions {name}",
+            )
+            return
         self.topic_table.add_partitions(
             name, new_count, replicas_for=lambda p: [self.config.node_id]
         )

@@ -43,7 +43,14 @@ async def handle_find_coordinator(ctx) -> dict:
         if p is not None and p.is_leader():
             leader_node = cfg.node_id
         else:
-            leader_node = md.assignments[ntp.partition].leader
+            # clustered: live leadership is in the metadata cache (leaders
+            # table via raft notifications + gossip); pa.leader only covers
+            # the standalone path
+            mdc = getattr(ctx.broker, "metadata_cache", None)
+            if mdc is not None:
+                leader_node = mdc.get_leader(ntp)
+            else:
+                leader_node = md.assignments[ntp.partition].leader
     if leader_node is None:
         return {
             "error_code": int(E.coordinator_not_available),

@@ -190,21 +190,38 @@ class GroupManager:
         if md is None:
             return
         for idx in md.assignments:
-            p = self.broker.get_partition(GROUP_TOPIC, idx)
-            if p is None:
-                continue
-            offset = p.start_offset
-            hwm = p.high_watermark
-            while offset < hwm:
-                batches = await p.make_reader(offset, 1 << 20)
-                if not batches:
-                    break
-                for b in batches:
-                    for rec in b.records():
-                        self._apply_recovered(rec)
-                    offset = b.last_offset + 1
+            await self.recover_partition(idx)
         if self.groups:
             logger.info("recovered %d groups", len(self.groups))
+
+    async def recover_partition(self, idx: int) -> None:
+        """Replay one group-topic partition into coordinator state.
+
+        Called at start for every local partition AND whenever this node
+        GAINS leadership of a group partition (the reference's
+        group_manager handle_leader_change -> recovery, group_manager.cc):
+        after a coordinator dies, the new leader must rebuild that
+        partition's groups/offsets from the replicated log or committed
+        offsets silently vanish for every group hashed onto it."""
+        p = self.broker.get_partition(GROUP_TOPIC, idx)
+        if p is None:
+            return
+        offset = p.start_offset
+        hwm = p.high_watermark
+        while offset < hwm:
+            batches = await p.make_reader(offset, 1 << 20)
+            if not batches:
+                break
+            for b in batches:
+                for rec in b.records():
+                    self._apply_recovered(rec)
+                offset = b.last_offset + 1
+
+    def on_leadership_gained(self, idx: int) -> None:
+        """Sync notification hook (raft leadership callback): schedule the
+        replay; no-op before start (start() replays everything anyway)."""
+        if self._started:
+            asyncio.create_task(self.recover_partition(idx))
 
     def _apply_recovered(self, rec: Record) -> None:
         try:

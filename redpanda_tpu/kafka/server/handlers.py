@@ -113,14 +113,19 @@ async def handle_metadata(ctx) -> dict:
             )
             topics.append({"error_code": int(code), "name": name, "partitions": []})
             continue
+        mdc = getattr(broker, "metadata_cache", None)
         partitions = []
         for idx in sorted(md.assignments):
             pa = md.assignments[idx]
+            # Clustered: leadership lives in the leaders table fed by raft
+            # notifications + dissemination gossip (metadata_cache.h
+            # aggregation); pa.leader only covers the standalone path.
+            leader = mdc.get_leader(pa.ntp) if mdc is not None else pa.leader
             partitions.append(
                 {
                     "error_code": 0,
                     "partition_index": idx,
-                    "leader_id": pa.leader if pa.leader is not None else -1,
+                    "leader_id": leader if leader is not None else -1,
                     "replica_nodes": list(pa.replicas),
                     "isr_nodes": list(pa.replicas),
                     "offline_replicas": [],
@@ -134,15 +139,27 @@ async def handle_metadata(ctx) -> dict:
                 "partitions": partitions,
             }
         )
-    return {
-        "brokers": [
+    if getattr(broker, "metadata_cache", None) is not None and broker.metadata_cache.all_brokers():
+        brokers = [
+            {
+                "node_id": b.node_id,
+                "host": b.kafka_host,
+                "port": b.kafka_port,
+                "rack": None,
+            }
+            for b in broker.metadata_cache.all_brokers()
+        ]
+    else:
+        brokers = [
             {
                 "node_id": cfg.node_id,
                 "host": cfg.advertised_host,
                 "port": cfg.advertised_port,
                 "rack": None,
             }
-        ],
+        ]
+    return {
+        "brokers": brokers,
         "cluster_id": cfg.cluster_id,
         "controller_id": cfg.node_id,
         "topics": topics,
@@ -233,10 +250,10 @@ async def _produce_one(broker, topic: str, p: dict, level: int) -> dict:
         adapted = decode_wire_batches(records, verify_crc=False)
     except EOFError:
         return _produce_partition_error(index, E.corrupt_message)
-    from redpanda_tpu.ops.crc_backend import default_backend
+    from redpanda_tpu.ops.crc_backend import default_backend_async
 
     v2 = [a for a in adapted if a.v2_format]
-    ok = default_backend().validate(
+    ok = (await default_backend_async()).validate(
         [a.batch.crc_region() for a in v2],
         [a.batch.header.crc for a in v2],
     )
@@ -517,7 +534,20 @@ async def handle_create_topics(ctx) -> dict:
         for c in t.get("configs") or []:
             _apply_topic_config(cfg, c["name"], c["value"])
         if not validate_only:
-            await broker.create_topic(cfg)
+            try:
+                await broker.create_topic(cfg)
+            except ValueError:
+                # lost a cross-broker create race after the contains() check
+                results.append(_topic_result(name, E.topic_already_exists))
+                continue
+            except Exception as e:
+                code = (
+                    E.invalid_replication_factor
+                    if "replication factor" in str(e)
+                    else E.unknown_server_error
+                )
+                results.append(_topic_result(name, code, str(e)))
+                continue
         results.append(_topic_result(name, E.none))
     return {"topics": results}
 

@@ -129,6 +129,7 @@ class CrcBackend:
 
 
 _default: CrcBackend | None = None
+_default_lock = __import__("threading").Lock()
 
 
 def default_backend() -> CrcBackend:
@@ -139,9 +140,23 @@ def default_backend() -> CrcBackend:
     """
     global _default
     if _default is None:
-        import os
+        with _default_lock:
+            if _default is None:
+                import os
 
-        _default = CrcBackend.pick(
-            probe_device=os.environ.get("RP_CRC_PROBE_DEVICE") == "1"
-        )
+                _default = CrcBackend.pick(
+                    probe_device=os.environ.get("RP_CRC_PROBE_DEVICE") == "1"
+                )
     return _default
+
+
+async def default_backend_async() -> CrcBackend:
+    """Async-safe accessor: the first call's probe (and, with
+    RP_CRC_PROBE_DEVICE=1, a 20-40s device jit compile) runs in a worker
+    thread so the event loop keeps serving raft heartbeats; later calls
+    return the cached instance without a thread hop."""
+    if _default is not None:
+        return _default
+    import asyncio
+
+    return await asyncio.to_thread(default_backend)

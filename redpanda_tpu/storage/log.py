@@ -69,6 +69,17 @@ class DiskLog:
         # batch under the log lock; truncation listeners get (offset)
         self.append_listeners: list = []
         self.truncate_listeners: list = []
+        # global LRU fronting segment reads (batch_cache.h:99); assigned by
+        # the LogManager, None in bare/standalone usage
+        self.batch_cache = None
+
+    def _cache_put(self, batch: RecordBatch) -> None:
+        if self.batch_cache is not None:
+            self.batch_cache.put(id(self), batch)
+
+    def _cache_invalidate(self, **kw) -> None:
+        if self.batch_cache is not None:
+            self.batch_cache.invalidate(id(self), **kw)
 
     # ------------------------------------------------------------ lifecycle
     @classmethod
@@ -99,11 +110,13 @@ class DiskLog:
 
     async def close(self):
         async with self._lock:
+            self._cache_invalidate()
             for seg in self.segments:
                 seg.close()
 
     async def remove(self):
         async with self._lock:
+            self._cache_invalidate()
             for seg in self.segments:
                 seg.remove()
             self.segments.clear()
@@ -155,6 +168,9 @@ class DiskLog:
                 seg = self._segment_for_term(seg, batch.header.term)
                 seg = self._maybe_roll(seg)
                 seg.append(batch)
+                # hot tail into the cache: fetch-after-produce never touches
+                # the segment file (batch_cache put-on-append)
+                self._cache_put(batch)
                 size += batch.size_bytes
                 next_offset = batch.last_offset + 1
                 for fn in self.append_listeners:
@@ -222,9 +238,12 @@ class DiskLog:
         type_filter=None,
     ) -> list[RecordBatch]:
         async with self._lock:
+            start = max(start_offset, self._start_offset)
+            cached = self._read_cached(start, max_bytes, max_offset, type_filter)
+            if cached is not None:
+                return cached
             out: list[RecordBatch] = []
             taken = 0
-            start = max(start_offset, self._start_offset)
             for seg in self.segments:
                 if seg.dirty_offset < start:
                     continue
@@ -235,12 +254,39 @@ class DiskLog:
                 )
                 for b in batches:
                     out.append(b)
+                    self._cache_put(b)
                     taken += b.size_bytes
                 if taken >= max_bytes:
                     break
                 if out:
                     start = out[-1].last_offset + 1
             return out
+
+    def _read_cached(self, start, max_bytes, max_offset, type_filter):
+        """Serve the read purely from the batch cache, or None.
+
+        Only a COMPLETE answer counts: the cached chain must run unbroken
+        from `start` to the dirty offset / max_offset / byte budget —
+        a mid-range miss falls back to the segment scan (which re-populates
+        the cache), so callers never see a silently shortened read."""
+        if self.batch_cache is None or not self.segments:
+            return None
+        end = self.segments[-1].dirty_offset
+        if max_offset is not None:
+            end = min(end, max_offset)
+        out: list[RecordBatch] = []
+        taken = 0
+        cur = start
+        key = id(self)
+        while cur <= end and taken < max_bytes:
+            b = self.batch_cache.get(key, cur)
+            if b is None:
+                return None  # chain broken: not a complete answer
+            if type_filter is None or b.header.type in type_filter:
+                out.append(b)
+                taken += b.size_bytes
+            cur = b.last_offset + 1
+        return out
 
     async def timequery(self, ts: int) -> int | None:
         """First offset with max_timestamp >= ts (storage timequery)."""
@@ -256,6 +302,7 @@ class DiskLog:
     async def truncate(self, offset: int):
         """Drop everything at and after `offset` (suffix truncation)."""
         async with self._lock:
+            self._cache_invalidate(from_offset=offset)
             keep: list[Segment] = []
             for seg in self.segments:
                 if seg.dirty_offset < offset:
@@ -288,6 +335,7 @@ class DiskLog:
     async def prefix_truncate(self, offset: int):
         """Evict whole segments below `offset` (retention / raft snapshot)."""
         async with self._lock:
+            self._cache_invalidate(below_offset=offset)
             while self.segments and self.segments[0].dirty_offset < offset and (
                 len(self.segments) > 1 or not self.segments[0].writable
             ):
@@ -312,6 +360,9 @@ class DiskLog:
             delete_retention_ms=self.config.delete_retention_ms,
             max_keys_in_memory=self.config.compaction_max_keys_in_memory,
         )
+        # compaction rewrote segment contents in place: cached batches for
+        # dropped keys would resurrect them on a cache-served fetch
+        self._cache_invalidate()
         self._compacted_through = offs.dirty_offset
         return result
 

