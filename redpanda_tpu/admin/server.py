@@ -322,6 +322,14 @@ class AdminServer:
         module = req.match_info["module"]
         probe = req.match_info["probe"]
         typ = req.match_info["type"]
+        # arming a name nothing ever injects must fail loudly, not 200:
+        # a typo'd module would silently neuter a whole fault campaign
+        known = honey_badger.modules()
+        if module not in known or probe not in known[module]:
+            return web.json_response(
+                {"error": f"unknown probe {module}.{probe}", "modules": known},
+                status=404,
+            )
         honey_badger.enable()
         if typ == "exception":
             honey_badger.set_exception(module, probe)

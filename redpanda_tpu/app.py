@@ -60,6 +60,7 @@ class Application:
             fetch_poll_interval_s=c.fetch_poll_interval_ms / 1000.0,
             sasl_enabled=c.enable_sasl,
             superusers=[u for u in c.superusers.split(",") if u],
+            unsafe_relaxed_acks=c.unsafe_relaxed_acks,
         )
 
     def _tls_for(self, prefix: str):
@@ -252,6 +253,7 @@ class Application:
         self._stop_order += [self.md_dissemination, self.backend, self.controller]
 
         self.broker.controller_dispatcher = dispatcher
+        self.broker.controller_leader_fn = lambda: self.controller.leader_id
         self.broker.security.attach(self.controller)
         self.broker.data_policies.attach(self.controller)
         self.broker.metadata_cache = MetadataCache(

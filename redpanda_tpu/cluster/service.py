@@ -169,14 +169,24 @@ class ControllerDispatcher:
 
                 await asyncio.sleep(0.2)
                 continue
-            client = rpc.Client(cluster_service, self.connections.get(leader))
-            reply = await client.replicate_command(
-                {
-                    "type": int(cmd.type),
-                    "data_json": json.dumps(cmd.data).encode(),
-                },
-                timeout=timeout,
-            )
+            try:
+                client = rpc.Client(cluster_service, self.connections.get(leader))
+                reply = await client.replicate_command(
+                    {
+                        "type": int(cmd.type),
+                        "data_json": json.dumps(cmd.data).encode(),
+                    },
+                    timeout=timeout,
+                )
+            except Exception as e:
+                # leader died mid-RPC: re-resolve after the election — this
+                # is the path startup registration rides through a
+                # SIGKILL/restart (retries=300 must actually outwait it)
+                last = str(e)
+                import asyncio
+
+                await asyncio.sleep(0.2)
+                continue
             if reply["errc"] == _OK:
                 return
             last = reply["message"] or f"errc={reply['errc']}"

@@ -1,0 +1,18 @@
+"""Consistency testing: linearizability checking for the partitioned log.
+
+The gobekli analogue (reference: src/consistency-testing/gobekli —
+LinearizabilityRegisterChecker, gobekli/consensus.py:65, plus the chaostest
+fault campaigns). The reference checks a kv register built ON raft
+(kvelldb); here the object under test is what this system actually
+guarantees: the partition IS a linearizable append-only register sequence,
+so the checker validates client-observed histories of produce/fetch against
+the log model. See checker.py for the model and workload.py for the
+fault-driving workload used by tests/chaos/test_linearizability.py.
+"""
+
+from redpanda_tpu.consistency.checker import (  # noqa: F401
+    CheckResult,
+    Op,
+    check_history,
+)
+from redpanda_tpu.consistency.workload import LogWorkload  # noqa: F401

@@ -1,0 +1,184 @@
+"""Linearizability checker for the append-only log register.
+
+Model: one topic partition is a sequence register. A produce(v, acks=-1)
+that RETURNS offset o asserts "v is durably at position o, committed". A
+fetch observing high watermark h asserts "positions [0, h) are immutable
+and v at o < h is readable". Linearizability over this model means the
+log's committed prefix behaves like a single atomic object in real time:
+
+  W1. Every acked write's value sits at its acked offset in the final log,
+      exactly once (no lost acked writes, no duplication of an acked op,
+      no offset reuse). Failed/timed-out writes are indeterminate: they
+      may appear at most once anywhere.
+  W2. Real-time write order: if write A completed before write B was
+      invoked, then offset(A) < offset(B).
+  R1. A read's observed records match the final log at those offsets
+      byte-for-byte (committed data is immutable).
+  R2. Recency: a read invoked after write W completed must observe
+      high watermark > offset(W) — the committed write cannot disappear
+      or be hidden from later readers.
+  R3. Real-time hw monotonicity: if read R1 completed before R2 was
+      invoked, hw(R1) <= hw(R2) (the register never rolls back).
+
+This is the same guarantee gobekli's LinearizabilityRegisterChecker
+(reference src/consistency-testing/gobekli/gobekli/consensus.py:65)
+enforces for its kv register, specialized to the log's offset order — the
+total order is given by offsets, so checking is O(n log n) rather than a
+search over permutations.
+
+Clock note: invocation/response timestamps come from ONE test process
+(time.monotonic), so real-time comparisons are exact, not approximations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Op:
+    kind: str  # "write" | "read"
+    invoke_t: float
+    response_t: float | None = None  # None = never returned (indeterminate)
+    ok: bool = False  # acked / completed successfully
+    # write fields
+    value: bytes | None = None
+    offset: int | None = None  # acked offset
+    # read fields
+    hw: int | None = None
+    observed: list[tuple[int, bytes]] = field(default_factory=list)
+
+    @property
+    def determinate(self) -> bool:
+        return self.ok and self.response_t is not None
+
+
+@dataclass
+class CheckResult:
+    ok: bool
+    violations: list[str]
+    n_ops: int
+    n_acked_writes: int
+
+    def __bool__(self) -> bool:
+        return self.ok
+
+
+def check_history(history: list[Op], final_log: list[tuple[int, bytes]]) -> CheckResult:
+    """Validate a client-observed history against the final committed log.
+
+    ``final_log``: [(offset, value)] read from offset 0 to the high
+    watermark after the workload (and after recovery from any faults).
+    """
+    violations: list[str] = []
+    log = dict(final_log)
+    offsets_sorted = sorted(log)
+    writes = [op for op in history if op.kind == "write"]
+    reads = [op for op in history if op.kind == "read"]
+    acked = [w for w in writes if w.determinate]
+
+    # --- W1: acked writes present at their offsets, exactly once
+    value_locations: dict[bytes, list[int]] = {}
+    for off, v in final_log:
+        value_locations.setdefault(v, []).append(off)
+    for w in acked:
+        locs = value_locations.get(w.value, [])
+        if w.offset is None:
+            violations.append(f"acked write {w.value!r} returned no offset")
+            continue
+        if w.offset not in locs:
+            got = log.get(w.offset)
+            violations.append(
+                f"LOST ACKED WRITE: {w.value!r} acked at offset {w.offset} "
+                f"but log has {got!r} there (value found at {locs})"
+            )
+        elif len(locs) > 1:
+            violations.append(
+                f"acked write {w.value!r} duplicated at offsets {locs}"
+            )
+    # indeterminate writes: at most once
+    for w in writes:
+        if not w.determinate and w.value is not None:
+            locs = value_locations.get(w.value, [])
+            if len(locs) > 1:
+                violations.append(
+                    f"indeterminate write {w.value!r} duplicated at {locs}"
+                )
+
+    # --- W2: real-time order between acked writes. Offsets are the total
+    # order, so the check is a sweep: walking writes by invocation time,
+    # any write whose offset is <= the max offset of writes ALREADY
+    # completed before it began violates real time (an op that completed
+    # strictly earlier cannot be ordered after one invoked later).
+    placed = [w for w in acked if w.offset is not None]
+    by_completion = sorted(placed, key=lambda w: w.response_t)
+    max_done_off = -1
+    max_done_val = None
+    ci = 0
+    for w in sorted(placed, key=lambda w: w.invoke_t):
+        while ci < len(by_completion) and by_completion[ci].response_t < w.invoke_t:
+            if by_completion[ci].offset > max_done_off:
+                max_done_off = by_completion[ci].offset
+                max_done_val = by_completion[ci].value
+            ci += 1
+        if w.offset <= max_done_off:
+            violations.append(
+                f"REAL-TIME ORDER: write {w.value!r} got offset {w.offset} "
+                f"but {max_done_val!r} already completed at offset "
+                f"{max_done_off} before it was invoked"
+            )
+
+    # --- R1: observed records match the final log
+    for r in reads:
+        if not r.determinate:
+            continue
+        for off, v in r.observed:
+            if off in log:
+                if log[off] != v:
+                    violations.append(
+                        f"IMMUTABILITY: read observed {v!r} at offset {off}, "
+                        f"final log has {log[off]!r}"
+                    )
+            elif offsets_sorted and off <= offsets_sorted[-1]:
+                violations.append(
+                    f"read observed offset {off} ({v!r}) absent from the "
+                    "final log"
+                )
+
+    # --- R2: recency — reads see every write completed before they began
+    for r in reads:
+        if not r.determinate or r.hw is None:
+            continue
+        for w in acked:
+            if w.offset is not None and w.response_t < r.invoke_t:
+                if r.hw <= w.offset:
+                    violations.append(
+                        f"STALE READ: hw {r.hw} but write {w.value!r} at "
+                        f"offset {w.offset} completed before the read began"
+                    )
+                    break  # one witness per read keeps the report readable
+
+    # --- R3: hw never moves backwards in real time
+    done_reads = sorted(
+        (r for r in reads if r.determinate and r.hw is not None),
+        key=lambda r: r.response_t,
+    )
+    max_hw = -1
+    for r in sorted(done_reads, key=lambda r: r.invoke_t):
+        prior_hw = max(
+            (x.hw for x in done_reads if x.response_t < r.invoke_t),
+            default=-1,
+        )
+        if r.hw < prior_hw:
+            violations.append(
+                f"HW ROLLBACK: read observed hw {r.hw} after an earlier "
+                f"read completed with hw {prior_hw}"
+            )
+        max_hw = max(max_hw, r.hw)
+
+    return CheckResult(
+        ok=not violations,
+        violations=violations,
+        n_ops=len(history),
+        n_acked_writes=len(acked),
+    )

@@ -1,0 +1,142 @@
+"""Fault-tolerant workload driver feeding the linearizability checker.
+
+Concurrent writer tasks produce unique values (acks=-1) and reader tasks
+fetch the committed suffix, each op recorded with single-process monotonic
+invoke/response timestamps (gobekli's workload-driver role). Failures are
+recorded as indeterminate ops — never retried with the same value, so the
+checker's uniqueness reasoning stays sound. After the run (and after any
+injected faults heal), ``final_log()`` reads the full committed log from a
+fresh client for ``check_history``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from redpanda_tpu.consistency.checker import Op
+from redpanda_tpu.kafka.client import KafkaClient
+
+
+class LogWorkload:
+    def __init__(self, bootstrap_fn, topic: str, partition: int = 0):
+        """``bootstrap_fn() -> list[(host, port)]`` — re-evaluated on every
+        reconnect so killed nodes drop out of the pool."""
+        self.bootstrap_fn = bootstrap_fn
+        self.topic = topic
+        self.partition = partition
+        self.history: list[Op] = []
+        self._seq = 0
+
+    # ------------------------------------------------------------ clients
+    async def _client(self) -> KafkaClient:
+        last = None
+        for _ in range(40):
+            try:
+                c = await KafkaClient(self.bootstrap_fn()).connect()
+                await c.refresh_metadata([self.topic])
+                return c
+            except Exception as e:
+                last = e
+                await asyncio.sleep(0.25)
+        raise TimeoutError(f"no broker reachable: {last!r}")
+
+    # ------------------------------------------------------------ ops
+    async def writer(self, writer_id: int, n_ops: int, *, op_timeout: float = 8.0):
+        c = await self._client()
+        try:
+            for _ in range(n_ops):
+                self._seq += 1
+                value = b"w%d-%d" % (writer_id, self._seq)
+                op = Op("write", invoke_t=time.monotonic(), value=value)
+                self.history.append(op)
+                try:
+                    off = await asyncio.wait_for(
+                        c.produce(self.topic, self.partition, [value], acks=-1),
+                        op_timeout,
+                    )
+                    op.response_t = time.monotonic()
+                    op.offset = off
+                    op.ok = True
+                except Exception:
+                    op.response_t = None  # indeterminate; value never reused
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+                    c = await self._client()
+                await asyncio.sleep(0)
+        finally:
+            try:
+                await c.close()
+            except Exception:
+                pass
+
+    async def reader(self, n_ops: int, *, op_timeout: float = 8.0, pause: float = 0.05):
+        c = await self._client()
+        try:
+            for _ in range(n_ops):
+                op = Op("read", invoke_t=time.monotonic())
+                self.history.append(op)
+                try:
+                    batches, hw = await asyncio.wait_for(
+                        c.fetch(self.topic, self.partition, 0, max_wait_ms=10),
+                        op_timeout,
+                    )
+                    op.response_t = time.monotonic()
+                    op.hw = hw
+                    op.observed = [
+                        (b.header.base_offset + r.offset_delta, r.value)
+                        for b in batches
+                        for r in b.records()
+                    ]
+                    op.ok = True
+                except Exception:
+                    op.response_t = None
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+                    c = await self._client()
+                await asyncio.sleep(pause)
+        finally:
+            try:
+                await c.close()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ final state
+    async def final_log(self, *, settle_timeout: float = 60.0) -> list[tuple[int, bytes]]:
+        """The committed log [offset -> value] once the cluster has healed:
+        retries until a leader serves a full read from offset 0."""
+        deadline = time.monotonic() + settle_timeout
+        last: object = None
+        while time.monotonic() < deadline:
+            try:
+                c = await self._client()
+                out: list[tuple[int, bytes]] = []
+                offset = 0
+                while time.monotonic() < deadline:
+                    batches, hw = await c.fetch(
+                        self.topic, self.partition, offset, max_wait_ms=10
+                    )
+                    for b in batches:
+                        for r in b.records():
+                            out.append(
+                                (b.header.base_offset + r.offset_delta, r.value)
+                            )
+                        offset = b.last_offset + 1
+                    if offset >= hw:
+                        await c.close()
+                        return out
+                    if not batches:
+                        # hw ahead of what the node serves (recovering
+                        # leader): yield instead of spinning hot, and let
+                        # the deadline fire
+                        last = f"stuck at offset {offset} < hw {hw}"
+                        await asyncio.sleep(0.2)
+                await c.close()
+            except Exception as e:
+                last = e
+                await asyncio.sleep(0.5)
+        raise TimeoutError(f"cluster never healed for the final read: {last!r}")

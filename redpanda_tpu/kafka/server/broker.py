@@ -36,6 +36,11 @@ class BrokerConfig:
     # produce-path memory gate (connection_context.cc:32 memory units)
     kafka_request_max_memory: int = 64 * 1024 * 1024
     fetch_session_cache_size: int = 1000
+    # consistency-testing ONLY: ack quorum produces at leader level,
+    # deliberately violating acks=-1 so the linearizability checker can
+    # prove it catches the violation (tools/consistency; never set this
+    # in production)
+    unsafe_relaxed_acks: bool = False
 
 
 class Broker:
@@ -67,6 +72,7 @@ class Broker:
         self.data_policies = DataPolicyTable()
         self.policy_engine = PolicyEngine()
         self.controller_dispatcher = None  # multi-node: routes security/topic cmds
+        self.controller_leader_fn = None  # multi-node: live controller leader id
         # SCRAM credentials + ACLs; cluster-replicated when a controller is
         # attached, applied locally otherwise (single-node mode)
         from redpanda_tpu.security import Authorizer, SecurityManager
@@ -194,7 +200,11 @@ class Broker:
     async def _await_topic_table(self, pred, what: str, timeout: float = 15.0) -> None:
         """The requesting node applies committed controller commands
         asynchronously (its own STM replay); callers of the kafka API see
-        the mutation once the LOCAL table reflects it."""
+        the mutation once the LOCAL table reflects it.
+
+        Polling is deliberate: TopicTable.wait_for_deltas() is a DRAINING
+        single-consumer queue owned by the controller backend's reconcile
+        loop — a second consumer here would steal its deltas."""
         import asyncio
         import time as _t
 

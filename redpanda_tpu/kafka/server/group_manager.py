@@ -41,6 +41,10 @@ class GroupManager:
         self._expire_task: asyncio.Task | None = None
         self._started = False
         self._start_lock = asyncio.Lock()
+        # group-topic partitions whose failover replay is in flight (the
+        # coordinator_load_in_progress window) + strong refs to the tasks
+        self._loading: set[int] = set()
+        self._recover_tasks: set[asyncio.Task] = set()
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> "GroupManager":
@@ -101,7 +105,14 @@ class GroupManager:
         return NTP.kafka(GROUP_TOPIC, self.partition_for(group_id))
 
     def is_coordinator(self, group_id: str) -> bool:
-        p = self.broker.get_partition(GROUP_TOPIC, self.partition_for(group_id))
+        idx = self.partition_for(group_id)
+        if idx in self._loading:
+            # Failover replay in flight: serving group requests now would
+            # expose empty state and let live commits interleave with the
+            # replay (the reference's coordinator_load_in_progress window —
+            # clients re-discover and retry on not_coordinator).
+            return False
+        p = self.broker.get_partition(GROUP_TOPIC, idx)
         return p is not None and p.is_leader()
 
     # ------------------------------------------------------------ groups
@@ -218,10 +229,32 @@ class GroupManager:
                 offset = b.last_offset + 1
 
     def on_leadership_gained(self, idx: int) -> None:
-        """Sync notification hook (raft leadership callback): schedule the
-        replay; no-op before start (start() replays everything anyway)."""
-        if self._started:
-            asyncio.create_task(self.recover_partition(idx))
+        """Sync notification hook (raft leadership callback): gate the
+        partition and schedule the replay; no-op before start (start()
+        replays everything anyway). Strong task refs are kept — a bare
+        create_task result can be GC'd before it runs — and failures are
+        retried, then surfaced in the log rather than swallowed."""
+        if not self._started:
+            return
+        self._loading.add(idx)
+        task = asyncio.create_task(self._recover_gated(idx))
+        self._recover_tasks.add(task)
+        task.add_done_callback(self._recover_tasks.discard)
+
+    async def _recover_gated(self, idx: int) -> None:
+        try:
+            for attempt in (1, 2, 3):
+                try:
+                    await self.recover_partition(idx)
+                    return
+                except Exception:
+                    logger.exception(
+                        "group partition %d failover replay failed "
+                        "(attempt %d/3)", idx, attempt,
+                    )
+                    await asyncio.sleep(0.5)
+        finally:
+            self._loading.discard(idx)
 
     def _apply_recovered(self, rec: Record) -> None:
         try:

@@ -158,10 +158,17 @@ async def handle_metadata(ctx) -> dict:
                 "rack": None,
             }
         ]
+    # Clustered: report the REAL controller leader (admin clients route
+    # CreateTopics there); only the standalone broker is its own controller.
+    controller_id = cfg.node_id
+    fn = getattr(broker, "controller_leader_fn", None)
+    if fn is not None:
+        leader = fn()
+        controller_id = leader if leader is not None else -1
     return {
         "brokers": brokers,
         "cluster_id": cfg.cluster_id,
-        "controller_id": cfg.node_id,
+        "controller_id": controller_id,
         "topics": topics,
     }
 
@@ -194,6 +201,11 @@ async def handle_produce(ctx) -> dict | None:
         0: ConsistencyLevel.no_ack,
         1: ConsistencyLevel.leader_ack,
     }[acks]
+    if level == ConsistencyLevel.quorum_ack and ctx.broker.config.unsafe_relaxed_acks:
+        # Consistency-testing knob ONLY (tools/consistency, chaostest
+        # posture): deliberately break the acks=-1 contract so the
+        # linearizability checker can prove it detects lost acked writes.
+        level = ConsistencyLevel.leader_ack
     responses = []
     for t in ctx.request["topics"]:
         if not _authorized(ctx, AclOperation.write, t["name"]):

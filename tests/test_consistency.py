@@ -1,0 +1,121 @@
+"""Unit tests for the linearizability checker (consistency/checker.py):
+each violation class must be caught, clean histories must pass. The
+process-level campaign lives in tests/chaos/test_linearizability.py."""
+
+from redpanda_tpu.consistency import CheckResult, Op, check_history
+
+
+def _w(value, invoke, response, offset):
+    return Op("write", invoke_t=invoke, response_t=response, ok=True,
+              value=value, offset=offset)
+
+
+def _r(invoke, response, hw, observed):
+    return Op("read", invoke_t=invoke, response_t=response, ok=True,
+              hw=hw, observed=list(observed))
+
+
+def _indet(value, invoke):
+    return Op("write", invoke_t=invoke, response_t=None, ok=False, value=value)
+
+
+LOG3 = [(0, b"a"), (1, b"b"), (2, b"c")]
+
+
+def test_clean_sequential_history_passes():
+    h = [
+        _w(b"a", 0.0, 0.1, 0),
+        _w(b"b", 0.2, 0.3, 1),
+        _r(0.35, 0.4, 2, [(0, b"a"), (1, b"b")]),
+        _w(b"c", 0.5, 0.6, 2),
+    ]
+    res = check_history(h, LOG3)
+    assert res.ok, res.violations
+    assert res.n_acked_writes == 3
+
+
+def test_clean_concurrent_history_passes():
+    # overlapping writes may land in either order; reads during the window
+    # see whatever is committed so far
+    h = [
+        _w(b"b", 0.0, 0.5, 1),
+        _w(b"a", 0.1, 0.4, 0),
+        _r(0.45, 0.55, 1, [(0, b"a")]),
+        _w(b"c", 0.6, 0.7, 2),
+    ]
+    assert check_history(h, LOG3).ok
+
+
+def test_lost_acked_write_detected():
+    h = [_w(b"a", 0, 0.1, 0), _w(b"b", 0.2, 0.3, 1), _w(b"c", 0.4, 0.5, 2)]
+    res = check_history(h, [(0, b"a"), (1, b"b")])  # c vanished
+    assert not res.ok
+    assert any("LOST ACKED WRITE" in v for v in res.violations)
+
+
+def test_acked_offset_mismatch_detected():
+    h = [_w(b"a", 0, 0.1, 0), _w(b"b", 0.2, 0.3, 1)]
+    res = check_history(h, [(0, b"b"), (1, b"a")])  # swapped
+    assert not res.ok
+
+
+def test_real_time_order_violation_detected():
+    # b completed strictly before a was invoked, yet a got a smaller offset
+    h = [_w(b"b", 0.0, 0.1, 1), _w(b"a", 0.2, 0.3, 0)]
+    res = check_history(h, [(0, b"a"), (1, b"b")])
+    assert not res.ok
+    assert any("REAL-TIME ORDER" in v for v in res.violations)
+
+
+def test_immutability_violation_detected():
+    h = [
+        _w(b"a", 0, 0.1, 0),
+        _r(0.2, 0.3, 1, [(0, b"x")]),  # observed something else at 0
+    ]
+    res = check_history(h, [(0, b"a")])
+    assert not res.ok
+    assert any("IMMUTABILITY" in v for v in res.violations)
+
+
+def test_stale_read_detected():
+    h = [
+        _w(b"a", 0, 0.1, 0),
+        _w(b"b", 0.2, 0.3, 1),
+        _r(0.4, 0.5, 1, [(0, b"a")]),  # hw 1 hides committed write b
+    ]
+    res = check_history(h, LOG3[:2])
+    assert not res.ok
+    assert any("STALE READ" in v for v in res.violations)
+
+
+def test_hw_rollback_detected():
+    h = [
+        _w(b"a", 0, 0.05, 0),
+        _w(b"b", 0.1, 0.15, 1),
+        _r(0.2, 0.3, 2, [(0, b"a"), (1, b"b")]),
+        _r(0.4, 0.5, 1, [(0, b"a")]),  # hw went backwards
+    ]
+    res = check_history(h, LOG3[:2])
+    assert not res.ok
+    assert any("HW ROLLBACK" in v or "STALE READ" in v for v in res.violations)
+
+
+def test_indeterminate_write_may_be_absent_or_present():
+    h = [_w(b"a", 0, 0.1, 0), _indet(b"x", 0.2), _w(b"b", 0.4, 0.5, 1)]
+    assert check_history(h, [(0, b"a"), (1, b"b")]).ok  # absent
+    assert check_history(
+        [_w(b"a", 0, 0.1, 0), _indet(b"x", 0.2), _w(b"b", 0.4, 0.5, 2)],
+        [(0, b"a"), (1, b"x"), (2, b"b")],
+    ).ok  # present once
+
+
+def test_duplicated_acked_write_detected():
+    h = [_w(b"a", 0, 0.1, 0)]
+    res = check_history(h, [(0, b"a"), (1, b"a")])
+    assert not res.ok
+    assert any("duplicated" in v for v in res.violations)
+
+
+def test_result_is_truthy_contract():
+    assert bool(check_history([], [])) is True
+    assert isinstance(check_history([], []), CheckResult)
