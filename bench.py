@@ -317,6 +317,13 @@ def main():
             TpuEngine, force_mode="payload"
         )
         extras["link"] = run_link_profile()
+        from redpanda_tpu.ops.lz4_device import measure_probe
+
+        # the SURVEY §7 "measure first" item: device LZ4 block decode vs
+        # host liblz4, keep-or-kill on the recorded ratio (ops/lz4_device.py)
+        extras["device_lz4_probe"] = measure_probe(
+            n_records=32, record_size=256, reps=1
+        )
     except Exception as exc:  # secondary metrics must never sink the bench
         extras["configs_error"] = repr(exc)
 

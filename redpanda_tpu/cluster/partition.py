@@ -51,6 +51,9 @@ class DirectConsensus:
     def is_leader(self) -> bool:
         return True
 
+    def leadership_settled(self) -> bool:
+        return True  # no elections on a direct log
+
     @property
     def leader_id(self) -> NodeId | None:
         return self.node_id
@@ -118,6 +121,12 @@ class Partition:
     # -------------------------------------------------------------- state
     def is_leader(self) -> bool:
         return self.consensus.is_leader()
+
+    def ready_for_reads(self) -> bool:
+        """Leader AND settled (own-term entry committed): the read barrier
+        consumers need for linearizable fetches right after an election."""
+        settled = getattr(self.consensus, "leadership_settled", None)
+        return self.is_leader() and (settled is None or settled())
 
     @property
     def leader_id(self) -> NodeId | None:

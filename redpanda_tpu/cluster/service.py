@@ -169,20 +169,21 @@ class ControllerDispatcher:
 
                 await asyncio.sleep(0.2)
                 continue
+            # serialization is deterministic: do it OUTSIDE the retry guard
+            # so a bad command surfaces immediately with its real traceback
+            payload = {"type": int(cmd.type), "data_json": json.dumps(cmd.data).encode()}
             try:
                 client = rpc.Client(cluster_service, self.connections.get(leader))
-                reply = await client.replicate_command(
-                    {
-                        "type": int(cmd.type),
-                        "data_json": json.dumps(cmd.data).encode(),
-                    },
-                    timeout=timeout,
-                )
+                reply = await client.replicate_command(payload, timeout=timeout)
             except Exception as e:
-                # leader died mid-RPC: re-resolve after the election — this
-                # is the path startup registration rides through a
-                # SIGKILL/restart (retries=300 must actually outwait it)
-                last = str(e)
+                # Leader died mid-RPC: re-resolve after the election — the
+                # path startup registration rides through a SIGKILL/restart
+                # (retries=300 must actually outwait it). A reply lost
+                # after commit means the retry re-appends the command;
+                # controller commands are apply-idempotent (registrations
+                # and topic ops re-apply as no-ops/exists).
+                last = f"{type(e).__name__}: {e}"
+                logger.debug("controller forward to %s failed", leader, exc_info=True)
                 import asyncio
 
                 await asyncio.sleep(0.2)

@@ -128,7 +128,9 @@ def check_history(history: list[Op], final_log: list[tuple[int, bytes]]) -> Chec
                 f"{max_done_off} before it was invoked"
             )
 
-    # --- R1: observed records match the final log
+    # --- R1: observed records match the final log. Fetch only serves
+    # COMMITTED data (<= hw), so ANY observed offset missing from the final
+    # log — including past its end — is committed data that vanished.
     for r in reads:
         if not r.determinate:
             continue
@@ -139,10 +141,10 @@ def check_history(history: list[Op], final_log: list[tuple[int, bytes]]) -> Chec
                         f"IMMUTABILITY: read observed {v!r} at offset {off}, "
                         f"final log has {log[off]!r}"
                     )
-            elif offsets_sorted and off <= offsets_sorted[-1]:
+            else:
                 violations.append(
-                    f"read observed offset {off} ({v!r}) absent from the "
-                    "final log"
+                    f"COMMITTED DATA LOST: read observed offset {off} "
+                    f"({v!r}) absent from the final log"
                 )
 
     # --- R2: recency — reads see every write completed before they began
@@ -158,23 +160,23 @@ def check_history(history: list[Op], final_log: list[tuple[int, bytes]]) -> Chec
                     )
                     break  # one witness per read keeps the report readable
 
-    # --- R3: hw never moves backwards in real time
+    # --- R3: hw never moves backwards in real time (same completion sweep
+    # as W2: walk by invocation, track the max hw of reads already done)
     done_reads = sorted(
         (r for r in reads if r.determinate and r.hw is not None),
         key=lambda r: r.response_t,
     )
-    max_hw = -1
+    prior_hw = -1
+    ri = 0
     for r in sorted(done_reads, key=lambda r: r.invoke_t):
-        prior_hw = max(
-            (x.hw for x in done_reads if x.response_t < r.invoke_t),
-            default=-1,
-        )
+        while ri < len(done_reads) and done_reads[ri].response_t < r.invoke_t:
+            prior_hw = max(prior_hw, done_reads[ri].hw)
+            ri += 1
         if r.hw < prior_hw:
             violations.append(
                 f"HW ROLLBACK: read observed hw {r.hw} after an earlier "
                 f"read completed with hw {prior_hw}"
             )
-        max_hw = max(max_hw, r.hw)
 
     return CheckResult(
         ok=not violations,

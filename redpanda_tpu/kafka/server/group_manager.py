@@ -42,8 +42,10 @@ class GroupManager:
         self._started = False
         self._start_lock = asyncio.Lock()
         # group-topic partitions whose failover replay is in flight (the
-        # coordinator_load_in_progress window) + strong refs to the tasks
-        self._loading: set[int] = set()
+        # coordinator_load_in_progress window): idx -> generation token, so
+        # an older replay finishing cannot reopen the gate a newer replay
+        # (re-gained leadership) still holds. Strong refs keep tasks alive.
+        self._loading: dict[int, object] = {}
         self._recover_tasks: set[asyncio.Task] = set()
 
     # ------------------------------------------------------------ lifecycle
@@ -236,25 +238,32 @@ class GroupManager:
         retried, then surfaced in the log rather than swallowed."""
         if not self._started:
             return
-        self._loading.add(idx)
-        task = asyncio.create_task(self._recover_gated(idx))
+        token = object()
+        self._loading[idx] = token
+        task = asyncio.create_task(self._recover_gated(idx, token))
         self._recover_tasks.add(task)
         task.add_done_callback(self._recover_tasks.discard)
 
-    async def _recover_gated(self, idx: int) -> None:
-        try:
-            for attempt in (1, 2, 3):
-                try:
-                    await self.recover_partition(idx)
-                    return
-                except Exception:
-                    logger.exception(
-                        "group partition %d failover replay failed "
-                        "(attempt %d/3)", idx, attempt,
-                    )
-                    await asyncio.sleep(0.5)
-        finally:
-            self._loading.discard(idx)
+    async def _recover_gated(self, idx: int, token: object) -> None:
+        for attempt in (1, 2, 3):
+            try:
+                await self.recover_partition(idx)
+                if self._loading.get(idx) is token:
+                    del self._loading[idx]  # only OUR generation reopens
+                return
+            except Exception:
+                logger.exception(
+                    "group partition %d failover replay failed "
+                    "(attempt %d/3)", idx, attempt,
+                )
+                await asyncio.sleep(0.5)
+        # All attempts failed: STAY GATED — answering not_coordinator keeps
+        # clients retrying elsewhere/later; serving empty state would
+        # silently reset committed offsets.
+        logger.error(
+            "group partition %d replay failed permanently; coordinator "
+            "stays unavailable for its groups", idx,
+        )
 
     def _apply_recovered(self, rec: Record) -> None:
         try:

@@ -387,7 +387,12 @@ async def _fetch_once(ctx, topics, max_bytes: int) -> tuple[list, int, bool]:
                 parts.append(_fetch_partition_error(index, E.unknown_topic_or_partition))
                 any_error = True
                 continue
-            if not partition.is_leader():
+            if not partition.is_leader() or (
+                hasattr(partition, "ready_for_reads") and not partition.ready_for_reads()
+            ):
+                # unsettled new leader: serving now could show a hw BELOW
+                # data an earlier leader acked (raft §8 read barrier;
+                # clients refresh metadata and retry)
                 parts.append(_fetch_partition_error(index, E.not_leader_for_partition))
                 any_error = True
                 continue
@@ -485,6 +490,17 @@ async def handle_list_offsets(ctx) -> dict:
                     {
                         "partition_index": index,
                         "error_code": int(E.unknown_topic_or_partition),
+                        "timestamp": -1,
+                        "offset": -1,
+                        "old_style_offsets": [],
+                    }
+                )
+                continue
+            if hasattr(partition, "ready_for_reads") and not partition.ready_for_reads():
+                parts.append(
+                    {
+                        "partition_index": index,
+                        "error_code": int(E.not_leader_for_partition),
                         "timestamp": -1,
                         "offset": -1,
                         "old_style_offsets": [],

@@ -170,6 +170,18 @@ class Consensus:
     def is_leader(self) -> bool:
         return self.role == LEADER
 
+    def leadership_settled(self) -> bool:
+        """Raft §8 read barrier: a NEW leader may only serve linearizable
+        reads once an entry of ITS OWN term has committed (the election
+        configuration batch, _become_leader) — prior-term quorum entries
+        are only then covered by the commit rule, so the high watermark
+        cannot show a reader less than what an earlier leader acked."""
+        return (
+            self.role == LEADER
+            and self._commit_index >= 0
+            and self.term_at(self._commit_index) == self.term
+        )
+
     def config(self) -> GroupConfiguration:
         return self.config_mgr.latest()
 
