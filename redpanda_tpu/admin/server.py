@@ -101,6 +101,8 @@ class AdminServer:
             web.get("/v1/config", self._get_config),
             web.put("/v1/config/log_level/{name}", self._set_log_level),
             web.get("/v1/brokers", self._get_brokers),
+            web.put("/v1/brokers/{node_id}/decommission", self._decommission),
+            web.put("/v1/brokers/{node_id}/recommission", self._recommission),
             web.get("/v1/partitions", self._get_partitions),
             web.post("/v1/raft/{group}/transfer_leadership", self._raft_transfer),
             web.post(
@@ -188,6 +190,39 @@ class AdminServer:
                 "kafka_port": cfg.advertised_port, "membership_status": "active",
             }
         ])
+
+    async def _membership(self, req: web.Request, op: str) -> web.Response:
+        """Drain (or restore) a broker: replicated through the controller,
+        reconciled cluster-wide (members_backend decommission semantics,
+        commands.h:164-173). Works against ANY node — the broker's
+        dispatcher forwards to the controller leader."""
+        if self.controller is None:
+            return web.json_response(
+                {"error": "not a clustered broker"}, status=400
+            )
+        node_id = int(req.match_info["node_id"])
+        dispatcher = getattr(self.broker, "controller_dispatcher", None)
+        from redpanda_tpu.cluster.service import OP_DECOMMISSION, OP_RECOMMISSION
+
+        opcode = OP_DECOMMISSION if op == "decommission" else OP_RECOMMISSION
+        try:
+            if dispatcher is not None:
+                # frontend op, NOT the raw command: the leader-side
+                # decommission kicks replica moves + the drain watcher
+                await dispatcher.topic_op(opcode, {"node_id": node_id})
+            elif op == "decommission":
+                await self.controller.decommission_node(node_id)
+            else:
+                await self.controller.recommission_node(node_id)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({op: node_id})
+
+    async def _decommission(self, req: web.Request) -> web.Response:
+        return await self._membership(req, "decommission")
+
+    async def _recommission(self, req: web.Request) -> web.Response:
+        return await self._membership(req, "recommission")
 
     async def _get_partitions(self, req: web.Request) -> web.Response:
         out = []

@@ -16,6 +16,8 @@ import argparse
 import asyncio
 import base64
 import json
+import os
+import subprocess
 import sys
 
 DEFAULT_BROKERS = "127.0.0.1:9092"
@@ -300,6 +302,14 @@ async def cmd_debug(args) -> int:
 
 
 def cmd_generate(args) -> int:
+    if args.generate_cmd == "k8s-manifests":
+        from redpanda_tpu.cli.k8s import generate_manifests
+
+        print(generate_manifests(
+            name=args.name, namespace=args.namespace,
+            replicas=args.replicas, image=args.image, storage=args.storage,
+        ))
+        return 0
     if args.generate_cmd == "prometheus-config":
         print(json.dumps({
             "scrape_configs": [{
@@ -412,18 +422,78 @@ def build_parser() -> argparse.ArgumentParser:
     db = dsub.add_parser("bundle")
     db.add_argument("-o", "--output")
 
-    gp = sub.add_parser("generate", help="monitoring configs")
+    gp = sub.add_parser("generate", help="monitoring + deployment configs")
     gsub = gp.add_subparsers(dest="generate_cmd", required=True)
     gsub.add_parser("grafana-dashboard")
     gsub.add_parser("prometheus-config")
+    gk = gsub.add_parser("k8s-manifests")
+    gk.add_argument("--name", default="redpanda-tpu")
+    gk.add_argument("--namespace", default="default")
+    gk.add_argument("--replicas", type=int, default=3)
+    gk.add_argument("--image", default="redpanda-tpu:latest")
+    gk.add_argument("--storage", default="20Gi")
 
     sub.add_parser("tune", help="report platform tuners")
     sub.add_parser("iotune", help="report io characterization")
+
+    cnp = sub.add_parser("container", help="local multi-broker dev cluster")
+    cnsub = cnp.add_subparsers(dest="container_cmd")
+    # --dir goes on every SUBparser so `rpk container start --dir X` works
+    # (options on the parent are only accepted before the subcommand)
+    cns = cnsub.add_parser("start")
+    cns.add_argument("-n", "--nodes", type=int, default=1)
+    cns.add_argument("--dir", help="cluster state directory")
+    for name in ("status", "stop", "purge"):
+        cnsub.add_parser(name).add_argument("--dir", help="cluster state directory")
+
+    plp = sub.add_parser("plugin", help="external rpk-<name> plugins")
+    plsub = plp.add_subparsers(dest="plugin_cmd")
+    plsub.add_parser("list")
     return p
 
 
+def _find_plugins() -> dict[str, str]:
+    """rpk-<name> executables on PATH (the reference's plugin discovery,
+    src/go/rpk plugin system: any `rpk-foo` binary serves `rpk foo`)."""
+    out: dict[str, str] = {}
+    for d in os.environ.get("PATH", "").split(os.pathsep):
+        try:
+            entries = os.listdir(d or ".")
+        except OSError:
+            continue
+        for e in entries:
+            if e.startswith("rpk-"):
+                path = os.path.join(d or ".", e)
+                if os.access(path, os.X_OK) and e[4:] not in out:
+                    out[e[4:]] = path
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
+    argv = sys.argv[1:] if argv is None else argv
+    parser = build_parser()
+    # plugin fallback BEFORE parsing: `rpk foo ...` execs `rpk-foo ...`
+    # when foo is not a built-in; the built-in set is derived from the
+    # parser itself so a new subcommand can never silently lose to a
+    # same-named plugin
+    known = next(
+        a.choices.keys()
+        for a in parser._subparsers._group_actions  # noqa: SLF001
+        if hasattr(a, "choices")
+    )
+    if argv and not argv[0].startswith("-") and argv[0] not in known:
+        plugin = _find_plugins().get(argv[0])
+        if plugin is not None:
+            return subprocess.call([plugin, *argv[1:]])
+    args = parser.parse_args(argv)
+    if args.cmd == "container":
+        from redpanda_tpu.cli.container import cmd_container
+
+        return cmd_container(args)
+    if args.cmd == "plugin":
+        for name, path in sorted(_find_plugins().items()):
+            print(f"{name:<20} {path}")
+        return 0
     table = {
         "start": cmd_start,
         "topic": cmd_topic,

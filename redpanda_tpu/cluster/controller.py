@@ -396,6 +396,23 @@ class Controller:
     async def decommission_node(self, node_id: NodeId) -> None:
         if not self.members.contains(node_id):
             raise ClusterError(f"unknown node: {node_id}")
+        # validate BEFORE replicating anything: every replica on the node
+        # must have somewhere to go, or the cluster would be left half-
+        # drained (the reference refuses with "not enough nodes")
+        survivors = sum(
+            1
+            for b in self.members.all_brokers()
+            if b.node_id != node_id and b.state.name == "active"
+        )
+        for md in self.topic_table.topics().values():
+            for pa in md.assignments.values():
+                if node_id in pa.replicas and pa.group >= 0:
+                    if survivors < len(pa.replicas):
+                        raise ClusterError(
+                            f"cannot decommission node {node_id}: "
+                            f"{pa.ntp} needs {len(pa.replicas)} replicas but "
+                            f"only {survivors} active nodes would remain"
+                        )
         await self.replicate_and_wait(cmds.decommission_node_cmd(node_id))
         # kick replica drain: every partition hosted on the node gets a
         # move command to a reallocated set (members_backend semantics)

@@ -59,10 +59,18 @@ cluster_service = rpc.ServiceDef(
 _OK, _NOT_LEADER, _ERROR, _EXISTS = 0, 1, 2, 3
 
 
+OP_CREATE_TOPIC, OP_DELETE_TOPIC, OP_ADD_PARTITIONS = 0, 1, 2
+OP_DECOMMISSION, OP_RECOMMISSION = 3, 4
+
+
 async def apply_topic_op(controller: Controller, op: int, data: dict) -> None:
-    """Leader-side topic mutation; the ONE implementation used by both the
-    RPC handler and the dispatcher's local-leader path."""
-    if op == 0:
+    """Leader-side controller frontend op (topics + membership); the ONE
+    implementation used by both the RPC handler and the dispatcher's
+    local-leader path. Membership ops ride the same channel because they
+    too need LEADER-side logic (decommission kicks the replica drain and
+    the finish_reallocations watcher, controller.decommission_node — the
+    raw replicated command alone only flips membership state)."""
+    if op == OP_CREATE_TOPIC:
         from redpanda_tpu.cluster.topic_table import TopicConfig
 
         cfg = TopicConfig(
@@ -74,10 +82,16 @@ async def apply_topic_op(controller: Controller, op: int, data: dict) -> None:
         for k, v in (data.get("overrides") or {}).items():
             cfg.apply_override(k, v)
         await controller.create_topic(cfg)
-    elif op == 1:
+    elif op == OP_DELETE_TOPIC:
         await controller.delete_topic(data["name"], data.get("ns", "kafka"))
-    else:
+    elif op == OP_ADD_PARTITIONS:
         await controller.create_partitions(data["name"], data["total"])
+    elif op == OP_DECOMMISSION:
+        await controller.decommission_node(data["node_id"])
+    elif op == OP_RECOMMISSION:
+        await controller.recommission_node(data["node_id"])
+    else:
+        raise ClusterError(f"unknown frontend op {op}")
 
 
 class ClusterService:

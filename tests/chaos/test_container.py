@@ -1,0 +1,46 @@
+"""`rpk container` lifecycle against real broker processes (the reference's
+rpk container dev-cluster surface, process-based instead of docker)."""
+
+import asyncio
+
+import pytest
+
+from redpanda_tpu.cli.container import LocalCluster
+from redpanda_tpu.kafka.client import KafkaClient
+
+pytestmark = pytest.mark.chaos
+
+
+def test_container_lifecycle(tmp_path):
+    cluster = LocalCluster(str(tmp_path / "c"))
+    state = cluster.start(1)
+    try:
+        assert len(state["nodes"]) == 1
+        rows = cluster.status()
+        assert rows and rows[0]["alive"] and rows[0]["ready"]
+        # it serves real kafka traffic
+        host, port = cluster.brokers().split(":")
+
+        async def produce_consume():
+            c = await KafkaClient([(host, int(port))]).connect()
+            await c.create_topic("ct", partitions=1)
+            await c.produce("ct", 0, [b"x", b"y"], acks=-1)
+            batches, hw = await c.fetch("ct", 0, 0)
+            await c.close()
+            return [r.value for b in batches for r in b.records()], hw
+
+        vals, hw = asyncio.run(produce_consume())
+        assert vals == [b"x", b"y"] and hw == 2
+        # double start refuses
+        try:
+            cluster.start(1)
+            raised = False
+        except RuntimeError:
+            raised = True
+        assert raised
+    finally:
+        assert cluster.stop() >= 0
+    rows = cluster.status()
+    assert rows and not rows[0]["alive"]
+    cluster.purge()
+    assert cluster.load() is None and cluster.status() == []

@@ -1,0 +1,130 @@
+"""K8s deployment surface: manifest generation, scale reconciler, plugin
+discovery, and the admin decommission endpoints they drive.
+Reference analogue: src/go/k8s (operator) + src/go/rpk plugin system."""
+
+import asyncio
+import os
+import stat
+
+import pytest
+
+from redpanda_tpu.cli.k8s import generate_manifests, reconcile_scale, seed_servers
+
+
+class TestManifests:
+    def test_seed_list_matches_statefulset_dns(self):
+        seeds = seed_servers("rp", "prod", 3)
+        assert seeds.split(",") == [
+            f"{i}@rp-{i}.rp.prod.svc.cluster.local:33145" for i in range(3)
+        ]
+
+    def test_manifests_contain_the_load_bearing_parts(self):
+        y = generate_manifests(name="rp", namespace="prod", replicas=5,
+                               image="img:1", storage="99Gi")
+        assert "clusterIP: None" in y  # headless service
+        assert "replicas: 5" in y
+        assert "podManagementPolicy: Parallel" in y  # majority to elect
+        assert 'node_id="${HOSTNAME##*-}"' in y  # ordinal -> node_id
+        assert seed_servers("rp", "prod", 5) in y
+        assert "/v1/status/ready" in y  # readiness probe
+        assert "maxUnavailable: 1" in y  # PDB: quorum-safe evictions
+        assert "storage: 99Gi" in y and "image: img:1" in y
+
+    def test_cli_prints_manifests(self, capsys):
+        from redpanda_tpu.cli.rpk import main
+
+        assert main(["generate", "k8s-manifests", "--replicas", "4"]) == 0
+        out = capsys.readouterr().out
+        assert "kind: StatefulSet" in out and "replicas: 4" in out
+
+
+class FakeAdmin:
+    def __init__(self, n_active: int, draining=()):
+        self._brokers = [
+            {"node_id": i, "membership_status": "active"} for i in range(n_active)
+        ]
+        for i in draining:
+            self._brokers[i]["membership_status"] = "draining"
+        self.decommissioned = []
+
+    async def brokers(self):
+        return list(self._brokers)
+
+    async def decommission(self, node_id):
+        self.decommissioned.append(node_id)
+        self._brokers[node_id]["membership_status"] = "draining"
+
+
+class TestReconcile:
+    def test_scale_in_drains_highest_ordinals(self):
+        admin = FakeAdmin(5)
+        out = asyncio.run(reconcile_scale(3, admin))
+        assert out == [3, 4] and admin.decommissioned == [3, 4]
+
+    def test_idempotent_skips_already_draining(self):
+        admin = FakeAdmin(5, draining=(3,))
+        out = asyncio.run(reconcile_scale(3, admin))
+        assert out == [4]
+
+    def test_scale_out_is_a_noop(self):
+        admin = FakeAdmin(3)
+        assert asyncio.run(reconcile_scale(5, admin)) == []
+
+
+class TestPluginDiscovery:
+    def test_rpk_dash_executables_found_and_dispatched(self, tmp_path, monkeypatch, capsys):
+        plug = tmp_path / "rpk-hello"
+        plug.write_text("#!/bin/sh\necho plugged $1\n")
+        plug.chmod(plug.stat().st_mode | stat.S_IXUSR)
+        monkeypatch.setenv("PATH", f"{tmp_path}{os.pathsep}" + os.environ["PATH"])
+        from redpanda_tpu.cli.rpk import _find_plugins, main
+
+        assert _find_plugins()["hello"] == str(plug)
+        assert main(["plugin", "list"]) == 0
+        assert "hello" in capsys.readouterr().out
+        # unknown subcommand dispatches to the plugin executable
+        assert main(["hello", "world"]) == 0
+
+
+class TestCliParsing:
+    def test_container_dir_after_subcommand(self):
+        from redpanda_tpu.cli.rpk import build_parser
+
+        args = build_parser().parse_args(["container", "start", "--dir", "/tmp/x", "-n", "2"])
+        assert args.dir == "/tmp/x" and args.nodes == 2
+        args = build_parser().parse_args(["container", "stop", "--dir", "/tmp/x"])
+        assert args.dir == "/tmp/x"
+
+    def test_pod_name_declared_before_fqdn_reference(self):
+        y = generate_manifests()
+        assert y.index("name: POD_NAME") < y.index("name: POD_FQDN")
+
+
+class TestAdminDecommission:
+    def test_standalone_broker_refuses(self, tmp_path):
+        """Decommission is a cluster mutation; a controller-less broker
+        answers 400 instead of pretending (the reconciler treats it as a
+        hard error). The clustered path is exercised end-to-end by the
+        process-cluster drive in tests/chaos and the controller command
+        tests in tests/test_cluster.py."""
+        import aiohttp
+
+        from redpanda_tpu.admin import AdminServer
+        from redpanda_tpu.kafka.server.broker import Broker, BrokerConfig
+        from redpanda_tpu.storage.log_manager import StorageApi
+
+        async def body():
+            storage = await StorageApi(str(tmp_path)).start()
+            broker = Broker(BrokerConfig(data_dir=str(tmp_path)), storage)
+            admin = await AdminServer(broker, port=0).start()
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.put(
+                        f"http://127.0.0.1:{admin.port}/v1/brokers/1/decommission"
+                    ) as r:
+                        assert r.status == 400
+            finally:
+                await admin.stop()
+                await storage.stop()
+
+        asyncio.run(body())

@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""Scale reconciler for k8s deployments (`rpk generate k8s-manifests`).
+
+The one operator behavior a StatefulSet controller cannot provide: scale-in
+must DRAIN the doomed ordinals through the cluster controller before their
+pods (and PVCs) disappear. Point this at the admin API and the desired
+replica count; it decommissions ordinals >= desired, waits for their
+partitions to drain off, then you `kubectl scale`. Scale-out needs no
+operator (new ordinals join via the seed list).
+
+    python tools/k8s_operator.py --admin http://rp-0.rp:9644 --replicas 3
+
+Logic lives in redpanda_tpu/cli/k8s.py reconcile_scale (transport-
+parameterized; tested without k8s in tests/test_k8s.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from redpanda_tpu.cli.k8s import reconcile_scale  # noqa: E402
+
+
+class AdminHttp:
+    def __init__(self, base: str):
+        self.base = base.rstrip("/")
+
+    async def _req(self, method: str, path: str):
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.request(
+                method, self.base + path, timeout=aiohttp.ClientTimeout(total=10)
+            ) as r:
+                if r.status >= 400:
+                    raise RuntimeError(f"{method} {path} -> {r.status}")
+                return await r.json()
+
+    async def brokers(self):
+        return await self._req("GET", "/v1/brokers")
+
+    async def decommission(self, node_id: int):
+        return await self._req("PUT", f"/v1/brokers/{node_id}/decommission")
+
+
+async def _wait_drained(template: str, node_ids: list[int], timeout_s: float) -> bool:
+    """Poll each drained node's OWN admin (`template.format(n=id)`) until it
+    hosts zero partition replicas. Returns True when all drained."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    pending = set(node_ids)
+    while pending and time.monotonic() < deadline:
+        for n in sorted(pending):
+            try:
+                node_admin = AdminHttp(template.format(n=n))
+                parts = await node_admin._req("GET", "/v1/partitions")
+                if not parts:
+                    pending.discard(n)
+                    print(f"node {n} drained")
+            except Exception:
+                pass  # node busy moving replicas; keep polling
+        if pending:
+            await asyncio.sleep(2.0)
+    return not pending
+
+
+async def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--admin", required=True, help="admin API base URL")
+    ap.add_argument("--replicas", type=int, required=True)
+    ap.add_argument(
+        "--admin-template",
+        help="per-node admin URL template, e.g. "
+        "'http://rp-{n}.rp.default.svc.cluster.local:9644' — when given, "
+        "block until the drained nodes host zero partitions",
+    )
+    ap.add_argument("--wait-timeout", type=float, default=600.0)
+    args = ap.parse_args()
+    admin = AdminHttp(args.admin)
+    drained = await reconcile_scale(args.replicas, admin)
+    if not drained:
+        print("nothing to drain")
+        return 0
+    print(f"decommissioned node(s) {drained}")
+    if args.admin_template:
+        ok = await _wait_drained(args.admin_template, drained, args.wait_timeout)
+        if not ok:
+            print("ERROR: drain did not complete; do NOT scale down yet",
+                  file=sys.stderr)
+            return 1
+        print(f"drain complete: kubectl scale statefulset --replicas={args.replicas}")
+    else:
+        print("wait until each drained node's /v1/partitions is empty, then "
+              f"kubectl scale statefulset --replicas={args.replicas}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))
