@@ -196,6 +196,27 @@ int32_t rp_parse_record_values(const uint8_t* payload, size_t payload_len,
   return count;
 }
 
+// Parse MANY batches' record values in one call (the engine's explode
+// stage: one ctypes crossing per launch instead of one per batch).
+// joined = concatenated batch payloads; for batch b, payload bytes are
+// joined[payload_off[b] .. +payload_len[b]) holding counts[b] records.
+// Emits val_off (absolute into joined) / val_len flattened in batch order.
+// Returns the number of records parsed (== sum(counts) on success).
+int64_t rp_parse_many(const uint8_t* joined, const int64_t* payload_off,
+                      const int32_t* payload_len, const int32_t* counts,
+                      int32_t n_batches, int64_t* val_off, int32_t* val_len) {
+  int64_t k = 0;
+  for (int32_t b = 0; b < n_batches; b++) {
+    int32_t parsed = rp_parse_record_values(
+        joined + payload_off[b], (size_t)payload_len[b], counts[b],
+        val_off + k, val_len + k);
+    if (parsed != counts[b]) return k + parsed;
+    for (int32_t i = 0; i < counts[b]; i++) val_off[k + i] += payload_off[b];
+    k += counts[b];
+  }
+  return k;
+}
+
 // Build a records payload from kept transform outputs: record i (where
 // keep[i] != 0) becomes {attrs=0, ts_delta=0, offset_delta=seq, key=null,
 // value=rows[i][:lens[i]], headers=0}. Writes payload to dst (caller sizes

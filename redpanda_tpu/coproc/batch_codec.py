@@ -40,33 +40,49 @@ class ExplodedBatches:
 def explode_batches(batches: list[RecordBatch]) -> ExplodedBatches:
     lib = _native()
     payloads: list[bytes] = []
-    offsets: list[np.ndarray] = []
-    sizes: list[np.ndarray] = []
+    counts = np.empty(len(batches), np.int32)
+    p_off = np.empty(len(batches), np.int64)
+    p_len = np.empty(len(batches), np.int32)
     ranges: list[tuple[int, int]] = []
     base = 0
     n = 0
-    for b in batches:
+    for i, b in enumerate(batches):
         payload = b.payload
         if b.header.compression != Compression.none:
             payload = uncompress(payload, b.header.compression)
         count = b.header.record_count
-        if lib is not None:
-            off, ln = lib.parse_record_values(payload, count)
-        else:
-            off, ln = _parse_record_values_py(payload, count)
         payloads.append(payload)
-        offsets.append(off + base)
-        sizes.append(np.maximum(ln, 0))
+        counts[i] = count
+        p_off[i] = base
+        p_len[i] = len(payload)
         ranges.append((n, n + count))
         base += len(payload)
         n += count
     joined = b"".join(payloads)
-    return ExplodedBatches(
-        joined,
-        np.concatenate(offsets) if offsets else np.zeros(0, np.int64),
-        np.concatenate(sizes) if sizes else np.zeros(0, np.int32),
-        ranges,
-    )
+    if n == 0:
+        return ExplodedBatches(
+            joined, np.zeros(0, np.int64), np.zeros(0, np.int32), ranges
+        )
+    if lib is not None and getattr(lib, "has_parse_many", False):
+        # ONE native crossing for the whole launch (not one per batch)
+        off, ln = lib.parse_many(joined, p_off, p_len, counts)
+    elif lib is not None:
+        offs, lns = [], []
+        for i, payload in enumerate(payloads):
+            o, l = lib.parse_record_values(payload, int(counts[i]))
+            offs.append(o + p_off[i])
+            lns.append(l)
+        off = np.concatenate(offs) if offs else np.zeros(0, np.int64)
+        ln = np.concatenate(lns) if lns else np.zeros(0, np.int32)
+    else:
+        offs, lns = [], []
+        for i, payload in enumerate(payloads):
+            o, l = _parse_record_values_py(payload, int(counts[i]))
+            offs.append(o + p_off[i])
+            lns.append(l)
+        off = np.concatenate(offs)
+        ln = np.concatenate(lns)
+    return ExplodedBatches(joined, off, np.maximum(ln, 0), ranges)
 
 
 def _parse_record_values_py(payload: bytes, count: int):

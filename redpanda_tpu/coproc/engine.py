@@ -40,6 +40,7 @@ removes the script on first failure.
 from __future__ import annotations
 
 import enum
+import queue
 import threading
 import time
 from collections import defaultdict
@@ -131,8 +132,9 @@ class _Launch:
     """
 
     __slots__ = ("script_id", "policy", "mode", "r_out", "ranges", "fits",
-                 "engine", "n", "_packed_dev", "_mask_dev", "_proj_data",
-                 "_proj_ok", "_plan", "_exploded", "_mat", "_lock")
+                 "engine", "n", "_packed_dev", "_mask_dev", "_mask_np",
+                 "_mask_event", "_proj_data", "_proj_ok", "_plan",
+                 "_exploded", "_mat", "_lock")
 
     def __init__(self, script_id: int, policy: ErrorPolicy):
         self.script_id = script_id
@@ -145,6 +147,8 @@ class _Launch:
         self.n = 0
         self._packed_dev = None
         self._mask_dev = None
+        self._mask_np = None
+        self._mask_event: threading.Event | None = None
         self._proj_data = None
         self._proj_ok = None
         self._plan = None
@@ -191,13 +195,24 @@ class _Launch:
                 np.zeros(0, np.int32),
                 np.zeros(0, bool),
             )
-        if self._mask_dev is None:  # no predicate: keep everything present
-            keep = np.ones(n, dtype=bool)
+        if self._mask_dev is None and self._mask_np is None:
+            keep = np.ones(n, dtype=bool)  # no predicate: keep all present
         else:
             t0 = time.perf_counter()
-            bits = np.asarray(self._mask_dev)
+            if self._mask_event is not None:
+                # harvester thread pays the link round trip concurrently
+                # with the caller's host work; worst case we fetch ourselves.
+                # Keep OUR fetch in a local — the harvester may still write
+                # _mask_np (even None, on its own failure) after a timeout.
+                self._mask_event.wait(timeout=30.0)
+                bits = self._mask_np
+                if bits is None:
+                    bits = np.asarray(self._mask_dev)
+            else:
+                bits = np.asarray(self._mask_dev)
             self._stat("t_fetch", t0)
             self._mask_dev = None
+            self._mask_np = None
             keep = np.unpackbits(bits)[:n].astype(bool)
         keep &= self._proj_ok
         t0 = time.perf_counter()
@@ -379,6 +394,34 @@ class TpuEngine:
         self._plans: dict[int, object] = {}  # script_id -> execution plan
         self._stats: dict[str, float] = defaultdict(float)
         self._stats_lock = threading.Lock()
+        # mask harvester: one daemon thread pays the D2H confirmation round
+        # trip per launch while the caller keeps doing host work (~10 ms of
+        # tunnel RTT per harvest otherwise lands on the critical path)
+        self._harvest_q: "queue.Queue[_Launch]" = queue.Queue()
+        self._harvester: threading.Thread | None = None
+
+    def _ensure_harvester(self) -> threading.Thread:
+        # locked: concurrent dispatchers must not each spawn a permanent
+        # thread (check-then-create race)
+        with self._stats_lock:
+            if self._harvester is None or not self._harvester.is_alive():
+                self._harvester = threading.Thread(
+                    target=self._harvest_loop, name="rptpu-mask-harvester",
+                    daemon=True,
+                )
+                self._harvester.start()
+            return self._harvester
+
+    def _harvest_loop(self) -> None:
+        while True:
+            launch = self._harvest_q.get()
+            try:
+                if launch._mask_dev is not None:
+                    launch._mask_np = np.asarray(launch._mask_dev)
+            except Exception:
+                launch._mask_np = None  # materialize() falls back
+            finally:
+                launch._mask_event.set()
 
     # ------------------------------------------------------------ control
     def enable_coprocessors(
@@ -594,6 +637,9 @@ class TpuEngine:
             self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
             self._stat_add("bytes_d2h", n_pad // 8)
             launch._mask_dev = mask
+            launch._mask_event = threading.Event()
+            self._ensure_harvester()
+            self._harvest_q.put(launch)
         # Projection extraction overlaps the device launch.
         t0 = time.perf_counter()
         if plan.passthrough:

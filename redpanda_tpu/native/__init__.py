@@ -48,6 +48,17 @@ class _NativeLib:
             ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
         ]
+        # Newer symbols bind conditionally: a stale .so (make unavailable,
+        # read-only checkout) must degrade to the features it HAS, not
+        # disable the whole native layer.
+        self.has_parse_many = hasattr(dll, "rp_parse_many")
+        if self.has_parse_many:
+            dll.rp_parse_many.restype = ctypes.c_int64
+            dll.rp_parse_many.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
         dll.rp_json_find.restype = ctypes.c_int32
         dll.rp_json_find.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int32,
@@ -123,6 +134,31 @@ class _NativeLib:
             n, dst.ctypes.data, ctypes.byref(kept),
         )
         return dst[:length].tobytes(), kept.value
+
+    def parse_many(
+        self,
+        joined,
+        payload_off: np.ndarray,
+        payload_len: np.ndarray,
+        counts: np.ndarray,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Record value offsets/lengths for MANY batch payloads in one
+        crossing; offsets are absolute into `joined`."""
+        payload_off = np.ascontiguousarray(payload_off, dtype=np.int64)
+        payload_len = np.ascontiguousarray(payload_len, dtype=np.int32)
+        counts = np.ascontiguousarray(counts, dtype=np.int32)
+        total = int(counts.sum())
+        joined_arr = np.frombuffer(joined, dtype=np.uint8)
+        val_off = np.empty(total, dtype=np.int64)
+        val_len = np.empty(total, dtype=np.int32)
+        parsed = self._dll.rp_parse_many(
+            joined_arr.ctypes.data, payload_off.ctypes.data,
+            payload_len.ctypes.data, counts.ctypes.data, len(counts),
+            val_off.ctypes.data, val_len.ctypes.data,
+        )
+        if parsed != total:
+            raise ValueError(f"record framing parse failed at record {parsed}/{total}")
+        return val_off, val_len
 
     def json_find(self, value: bytes, path: str) -> tuple[int, int, int]:
         """(type, value_start, value_end) of `path` in one JSON value.
@@ -218,7 +254,10 @@ def _build_and_load():
         return None
     try:
         return _NativeLib(ctypes.CDLL(_SO))
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError = a stale .so missing a required symbol; a raising
+        # module-level import would evict the module and re-run `make` on
+        # every later _native() call
         return None
 
 
