@@ -214,6 +214,10 @@ class Application:
         proto = rpc.SimpleProtocol()
         self.group_manager.register_service(proto)
         ClusterService(self.controller, dispatcher).register(proto)
+        # tx gateway: cross-node marker fan-out + staged-offset routing
+        from redpanda_tpu.cluster.tx_gateway import TxGatewayService
+
+        TxGatewayService(self.broker).register(proto)
         proto.register_service(
             rpc.ServiceHandler(md_dissemination_service, self.md_dissemination)
         )
@@ -258,6 +262,11 @@ class Application:
         self.broker.data_policies.attach(self.controller)
         self.broker.metadata_cache = MetadataCache(
             self.controller.topic_table, self.controller.members, leaders
+        )
+        from redpanda_tpu.cluster.tx_gateway import TxRouter
+
+        self.broker.tx_coordinator.router = TxRouter(
+            self.broker, self.broker.metadata_cache, self.connections
         )
         # announce ourselves through the controller once a leader exists.
         # In a real multi-process cluster the first election only completes

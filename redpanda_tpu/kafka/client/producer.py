@@ -8,6 +8,8 @@ commit/abort, and send_offsets for consume-transform-produce EOS.
 
 from __future__ import annotations
 
+import asyncio
+
 from redpanda_tpu.kafka.protocol import messages as m
 from redpanda_tpu.kafka.protocol.errors import ErrorCode, KafkaError
 from redpanda_tpu.models.record import Record, RecordBatch
@@ -24,14 +26,39 @@ class TransactionalProducer:
         self._in_tx_partitions: set[tuple[str, int]] = set()
         self._tx_open = False
 
+    # Transient coordination states (elections, dissemination lag, an
+    # in-flight previous transaction) are POLL signals on every tx RPC:
+    _RETRIABLE = frozenset({
+        int(ErrorCode.coordinator_not_available),
+        int(ErrorCode.not_leader_for_partition),
+        int(ErrorCode.concurrent_transactions),
+    })
+
+    async def _tx_request(self, api, body: dict, what: str, get_code) -> dict:
+        deadline = asyncio.get_event_loop().time() + 30.0
+        while True:
+            conn = await self.client.any_connection()
+            resp = await conn.request(api, body)
+            code = get_code(resp)
+            if code == 0:
+                return resp
+            if (
+                code not in self._RETRIABLE
+                or asyncio.get_event_loop().time() > deadline
+            ):
+                raise KafkaError(ErrorCode(code), what)
+            await asyncio.sleep(0.3)
+
     async def init(self) -> "TransactionalProducer":
-        conn = await self.client.any_connection()
-        resp = await conn.request(m.INIT_PRODUCER_ID, {
-            "transactional_id": self.transactional_id,
-            "transaction_timeout_ms": self.timeout_ms,
-        })
-        if resp["error_code"] != 0:
-            raise KafkaError(ErrorCode(resp["error_code"]), "init_producer_id")
+        resp = await self._tx_request(
+            m.INIT_PRODUCER_ID,
+            {
+                "transactional_id": self.transactional_id,
+                "transaction_timeout_ms": self.timeout_ms,
+            },
+            "init_producer_id",
+            lambda r: r["error_code"],
+        )
         self.producer_id = resp["producer_id"]
         self.epoch = resp["producer_epoch"]
         return self
@@ -46,16 +73,17 @@ class TransactionalProducer:
     async def _ensure_partition(self, topic: str, partition: int) -> None:
         if (topic, partition) in self._in_tx_partitions:
             return
-        conn = await self.client.any_connection()
-        resp = await conn.request(m.ADD_PARTITIONS_TO_TXN, {
-            "transactional_id": self.transactional_id,
-            "producer_id": self.producer_id,
-            "producer_epoch": self.epoch,
-            "topics": [{"name": topic, "partitions": [partition]}],
-        })
-        code = resp["results"][0]["results"][0]["error_code"]
-        if code != 0:
-            raise KafkaError(ErrorCode(code), "add_partitions_to_txn")
+        await self._tx_request(
+            m.ADD_PARTITIONS_TO_TXN,
+            {
+                "transactional_id": self.transactional_id,
+                "producer_id": self.producer_id,
+                "producer_epoch": self.epoch,
+                "topics": [{"name": topic, "partitions": [partition]}],
+            },
+            "add_partitions_to_txn",
+            lambda r: r["results"][0]["results"][0]["error_code"],
+        )
         self._in_tx_partitions.add((topic, partition))
 
     async def send(self, topic: str, partition: int, values: list[bytes]) -> int:
@@ -108,15 +136,19 @@ class TransactionalProducer:
                     raise KafkaError(ErrorCode(p["error_code"]), "txn_offset_commit")
 
     async def _end(self, commit: bool) -> None:
-        conn = await self.client.any_connection()
-        resp = await conn.request(m.END_TXN, {
-            "transactional_id": self.transactional_id,
-            "producer_id": self.producer_id,
-            "producer_epoch": self.epoch,
-            "committed": commit,
-        })
-        if resp["error_code"] != 0:
-            raise KafkaError(ErrorCode(resp["error_code"]), "end_txn")
+        # Retriable while the coordinator re-drives marker/offset fan-out
+        # (state stays prepare_*): "again later", not failure.
+        await self._tx_request(
+            m.END_TXN,
+            {
+                "transactional_id": self.transactional_id,
+                "producer_id": self.producer_id,
+                "producer_epoch": self.epoch,
+                "committed": commit,
+            },
+            "end_txn",
+            lambda r: r["error_code"],
+        )
         self._tx_open = False
         self._in_tx_partitions.clear()
 

@@ -20,7 +20,6 @@ import json
 import logging
 import time
 
-from redpanda_tpu.cluster.rm_stm import RmStm
 from redpanda_tpu.kafka.protocol.errors import ErrorCode as E
 from redpanda_tpu.kafka.server.group import OffsetCommit
 from redpanda_tpu.storage.kvstore import KeySpace
@@ -80,6 +79,11 @@ class TxMetadata:
 
 class TxCoordinator:
     def __init__(self, broker, expire_interval_s: float = 1.0) -> None:
+        from redpanda_tpu.cluster.tx_gateway import TxRouter
+
+        # local-only by default; the app swaps in a mesh-routed router
+        # (metadata cache + connection cache) when clustered
+        self.router = TxRouter(broker)
         self.broker = broker
         self.expire_interval_s = expire_interval_s
         self._txs: dict[str, TxMetadata] = {}
@@ -164,13 +168,6 @@ class TxCoordinator:
         self._next_pid += 1
         return pid
 
-    # ------------------------------------------------------------ rm_stm access
-    async def _rm(self, topic: str, partition: int) -> RmStm | None:
-        p = self.broker.get_partition(topic, partition)
-        if p is None or not p.is_leader():
-            return None
-        return await self.broker.recovered_rm_stm(p)
-
     # ------------------------------------------------------------ api
     async def init_producer_id(
         self, tx_id: str | None, timeout_ms: int
@@ -225,11 +222,17 @@ class TxCoordinator:
             return {tp: code for tp in parts}
         out: dict[tuple[str, int], E] = {}
         for topic, p in parts:
-            rm = await self._rm(topic, p)
-            if rm is None:
+            md_t = self.broker.topic_table.get(topic)
+            if md_t is None or p not in md_t.assignments:
                 out[(topic, p)] = E.unknown_topic_or_partition
                 continue
-            out[(topic, p)] = rm.begin_tx(pid, epoch)
+            # begin on the partition LEADER via the tx gateway (local rm_stm
+            # fast path when this broker leads it)
+            try:
+                out[(topic, p)] = E(await self.router.begin_tx(topic, p, pid, epoch))
+            except Exception:
+                logger.exception("tx %s: begin failed on %s/%d", tx_id, topic, p)
+                out[(topic, p)] = E.coordinator_not_available
             if out[(topic, p)] == E.none:
                 md.partitions.add((topic, p))
         if any(c == E.none for c in out.values()):
@@ -286,35 +289,63 @@ class TxCoordinator:
         #    re-drive it — claiming success with a marker missing would pin
         #    that partition's LSO forever.
         failed = False
-        for topic, p in sorted(md.partitions):
-            rm = await self._rm(topic, p)
-            if rm is None:
+        retriable = {
+            int(E.not_leader_for_partition),
+            int(E.coordinator_not_available),
+            int(E.unknown_server_error),
+            int(E.unknown_topic_or_partition),
+        }
+
+        # markers route through the tx gateway: local rm_stm when this
+        # broker leads the partition, internal RPC to the leader otherwise
+        # (cluster/tx_gateway.py). Independent partitions fan out
+        # CONCURRENTLY so one attempt is bounded by the slowest single RPC,
+        # not their sum (the reference's parallel tx_gateway fan-out).
+        import asyncio
+
+        parts = sorted(md.partitions)
+
+        async def one_marker(topic: str, p: int) -> int:
+            try:
+                return await self.router.write_marker(
+                    topic, p, md.pid, md.epoch, commit
+                )
+            except Exception:
+                logger.exception(
+                    "tx %s: marker write failed on %s/%d", md.tx_id, topic, p
+                )
+                return int(E.unknown_server_error)
+
+        codes = await asyncio.gather(*(one_marker(t, p) for t, p in parts))
+        for (topic, p), code in zip(parts, codes):
+            if code in retriable:
                 logger.warning(
-                    "tx %s: partition %s/%d unavailable during end_txn; will retry",
-                    md.tx_id, topic, p,
+                    "tx %s: partition %s/%d unavailable during end_txn "
+                    "(errc %d); will retry", md.tx_id, topic, p, code,
                 )
                 failed = True
                 continue
-            try:
-                code = await rm.end_tx(md.pid, md.epoch, commit)
-            except Exception:
-                logger.exception("tx %s: marker write failed on %s/%d", md.tx_id, topic, p)
-                failed = True
-                continue
-            if code != E.none:
-                return code  # epoch fence: not retriable, caller must re-init
+            if code != 0:
+                return E(code)  # epoch fence: not retriable, must re-init
         if failed:
             return E.coordinator_not_available  # retriable; state stays prepare_*
         # 2. staged group offsets become visible only on commit
-        #    (group_commit_tx / group_abort_tx batches in the reference)
+        #    (group_commit_tx / group_abort_tx batches in the reference),
+        #    routed to the group coordinator node
         if commit:
-            gm = self.broker.group_coordinator
             for group_id, commits in md.staged_offsets.items():
                 if commits:
-                    code = await gm.commit_offsets(
-                        group_id, "", -1, commits, trusted=True
-                    )
-                    if code != E.none:
+                    try:
+                        code = await self.router.commit_group_offsets(
+                            group_id, commits
+                        )
+                    except Exception:
+                        logger.exception(
+                            "tx %s: offset fold failed for group %s",
+                            md.tx_id, group_id,
+                        )
+                        return E.coordinator_not_available
+                    if code != 0:
                         return E.coordinator_not_available
         md.partitions.clear()
         md.staged_offsets.clear()
@@ -324,7 +355,11 @@ class TxCoordinator:
         return E.none
 
     async def expire_stale(self) -> None:
-        """Abort transactions idle past their timeout (tm_stm expiry)."""
+        """Abort timed-out transactions AND re-drive interrupted finishes
+        (tm_stm expiry + re-drive). A tx stuck in prepare_* — the client
+        gave up while a remote partition leader was down — pins every begun
+        partition's LSO until its markers land; the coordinator, not the
+        client, owns completing it."""
         now = time.monotonic()
         for md in list(self._txs.values()):
             if (
@@ -333,3 +368,9 @@ class TxCoordinator:
             ):
                 logger.info("aborting expired tx %s", md.tx_id)
                 await self._finish(md, commit=False)
+            elif md.state in (TxState.prepare_commit, TxState.prepare_abort):
+                code = await self._finish(
+                    md, commit=md.state == TxState.prepare_commit
+                )
+                if code == E.none:
+                    logger.info("re-drove interrupted tx %s", md.tx_id)

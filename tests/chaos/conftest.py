@@ -1,0 +1,29 @@
+"""Shared process-cluster fixture for the chaos family.
+
+Package-scoped: broker processes cost ~20s of interpreter+jax startup
+each, so modules share one healthy 3-node cluster and every test leaves
+all nodes running (kills are followed by restarts)."""
+
+import asyncio
+
+import pytest
+
+from .harness import ProcCluster
+
+
+@pytest.fixture(scope="package")
+def proc_cluster(tmp_path_factory):
+    async def _start():
+        cluster = ProcCluster(
+            str(tmp_path_factory.mktemp("chaos")),
+            3,
+            # replicate EVERYTHING 3x, including __consumer_offsets, so any
+            # single kill is survivable (raft_availability_test shape)
+            extra_config={"default_topic_replication": 3},
+        )
+        await cluster.start()
+        return cluster
+
+    cluster = asyncio.run(_start())
+    yield cluster
+    asyncio.run(cluster.stop())

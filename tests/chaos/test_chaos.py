@@ -111,22 +111,7 @@ async def kill_and_find_leader(cluster, c, topic: str):
 
 
 # ---------------------------------------------------------------- fixtures
-@pytest.fixture(scope="module")
-def proc_cluster(tmp_path_factory):
-    async def _start():
-        cluster = ProcCluster(
-            str(tmp_path_factory.mktemp("chaos")),
-            3,
-            # replicate EVERYTHING 3x, including __consumer_offsets, so any
-            # single kill is survivable (raft_availability_test shape)
-            extra_config={"default_topic_replication": 3},
-        )
-        await cluster.start()
-        return cluster
-
-    cluster = asyncio.run(_start())
-    yield cluster
-    asyncio.run(cluster.stop())
+# proc_cluster is package-scoped in tests/chaos/conftest.py
 
 
 def _run(coro):

@@ -1,0 +1,129 @@
+"""Cross-node exactly-once semantics against a real 3-node cluster.
+
+The tx coordinator lives on the client's bootstrap broker; data partitions
+lead elsewhere. Commit markers and staged group offsets must cross the
+internal mesh (cluster/tx_gateway.py — the reference's tx_gateway fan-out,
+tx_gateway.json). The test FORCES the cross-node shape: it picks/arranges a
+partition whose leader is NOT the coordinator node, then proves
+
+- committed transactional records are visible under read_committed,
+- aborted ones never are (and are filtered by the LSO/aborted-ranges path),
+- a consume-transform-produce cycle's staged offsets land on the group
+  coordinator exactly-once.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from redpanda_tpu.kafka.client import KafkaClient
+from redpanda_tpu.kafka.client.producer import TransactionalProducer
+
+pytestmark = pytest.mark.chaos
+
+
+async def _transfer_leader(node, topic: str, partition: int, target: int) -> bool:
+    url = (
+        f"http://127.0.0.1:{node.ports['admin']}"
+        f"/v1/partitions/kafka/{topic}/{partition}/transfer_leadership"
+        f"?target={target}"
+    )
+    async with aiohttp.ClientSession() as s:
+        async with s.post(url, timeout=aiohttp.ClientTimeout(total=10)) as r:
+            return r.status == 200
+
+
+async def _cross_node_partition(cluster, c, topic: str, coordinator: int) -> int:
+    """A partition of `topic` whose leader != coordinator (forcing the
+    marker fan-out across the mesh); transfers leadership if needed."""
+    # elections may still be running right after create_topic
+    for _ in range(60):
+        await c.refresh_metadata([topic])
+        leaders = {p: c._leaders.get((topic, p)) for p in range(2)}
+        if all(v is not None for v in leaders.values()):
+            break
+        await asyncio.sleep(0.25)
+    for p, leader in leaders.items():
+        if leader is not None and leader != coordinator:
+            return p
+    # every partition is led by the coordinator: move partition 0 away,
+    # asking ITS LEADER's admin to run the transfer
+    target = (coordinator + 1) % 3
+    ok = await _transfer_leader(
+        cluster.nodes[leaders[0]], topic, 0, target
+    )
+    assert ok, "leadership transfer failed"
+    for _ in range(60):
+        await asyncio.sleep(0.25)
+        await c.refresh_metadata([topic])
+        if c._leaders.get((topic, 0)) == target:
+            return 0
+    raise TimeoutError(
+        f"leader never moved off the coordinator node (leaders={leaders})"
+    )
+
+
+async def _fetch_committed_values(c, topic: str, partition: int) -> list[bytes]:
+    batches, _ = await c.fetch(topic, partition, 0, isolation_level=1)
+    return [r.value for b in batches for r in b.records()]
+
+
+def test_cross_node_commit_and_abort(proc_cluster):
+    async def body():
+        cluster = proc_cluster
+        boot = cluster.nodes[0]
+        c = await KafkaClient([("127.0.0.1", boot.ports["kafka"])]).connect()
+        await c.create_topic("txx", partitions=2, replication=3)
+        p = await _cross_node_partition(cluster, c, "txx", coordinator=0)
+
+        prod = await TransactionalProducer(c, "tx-chaos-1").init()
+        prod.begin()
+        await prod.send("txx", p, [b"c1", b"c2"])
+        await prod.commit()
+
+        prod.begin()
+        await prod.send("txx", p, [b"dead1", b"dead2"])
+        await prod.abort()
+
+        prod.begin()
+        await prod.send("txx", p, [b"c3"])
+        await prod.commit()
+
+        vals = await _fetch_committed_values(c, "txx", p)
+        assert vals == [b"c1", b"c2", b"c3"], vals
+        await c.close()
+
+    asyncio.run(asyncio.wait_for(body(), 180))
+
+
+def test_cross_node_consume_transform_produce(proc_cluster):
+    async def body():
+        cluster = proc_cluster
+        boot = cluster.nodes[1]  # coordinator = node 1 this time
+        c = await KafkaClient([("127.0.0.1", boot.ports["kafka"])]).connect()
+        await c.create_topic("tx-src", partitions=1, replication=3)
+        await c.create_topic("tx-dst", partitions=2, replication=3)
+        await c.produce("tx-src", 0, [b"in-%d" % i for i in range(4)], acks=-1)
+        p = await _cross_node_partition(cluster, c, "tx-dst", coordinator=1)
+
+        prod = await TransactionalProducer(c, "tx-chaos-ctp").init()
+        prod.begin()
+        await prod.send("tx-dst", p, [b"out-0", b"out-1"])
+        # stage the consumed position inside the SAME transaction
+        await prod.send_offsets("tx-ctp-group", {("tx-src", 0): 4})
+        await prod.commit()
+
+        vals = await _fetch_committed_values(c, "tx-dst", p)
+        assert vals == [b"out-0", b"out-1"]
+        # the staged offset landed on the group coordinator exactly-once
+        from redpanda_tpu.kafka.client.consumer import GroupConsumer
+
+        consumer = GroupConsumer(c, "tx-ctp-group", ["tx-src"])
+        committed = await consumer.fetch_committed("tx-src", [0])
+        assert committed[0] == 4, committed
+        await c.close()
+
+    asyncio.run(asyncio.wait_for(body(), 180))
