@@ -318,6 +318,9 @@ class Controller:
                 cmds.assignment_payload(ntp, self._alloc_group(), replicas)
             )
         overrides = {k: v for k, v in cfg.config_map().items() if v is not None}
+        # concurrent same-name creates that both pass the contains() check
+        # apply as first-wins no-ops (see topic_table.apply_create), so the
+        # loser observes success with the winner's assignments
         await self.replicate_and_wait(
             cmds.create_topic_cmd(
                 {

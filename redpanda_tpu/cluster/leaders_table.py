@@ -29,6 +29,19 @@ class PartitionLeadersTable:
         cur = self._leaders.get(ntp)
         if cur is not None and term < cur.term:
             return  # stale gossip
+        if (
+            cur is not None
+            and term == cur.term
+            and leader is None
+            and cur.leader is not None
+        ):
+            # A deposed leader gossips (None, term N) while the term-N
+            # winner gossips (winner, term N): raft guarantees ONE leader
+            # per term, so known always beats unknown within a term —
+            # otherwise arrival order could blank the winner's entry
+            # (observed: every node missing exactly the partitions it
+            # leads itself).
+            return
         self._leaders[ntp] = LeaderInfo(leader, term)
         if leader is not None:
             for fut in self._waiters.pop(ntp, []):

@@ -49,7 +49,18 @@ class MetadataCache:
             return leader
         md = self.topic_table.get(ntp.topic)
         if md and ntp.partition in md.assignments:
-            return md.assignments[ntp.partition].leader
+            pa = md.assignments[ntp.partition]
+            if pa.leader is not None:
+                return pa.leader
+            if pa.group < 0:
+                # materialized (non-replicable) partitions have no raft
+                # leader; they are written and served by the SOURCE
+                # partition's leader (materialized_partition fetch routing)
+                from redpanda_tpu.models.fundamental import MaterializedNTP
+
+                m = MaterializedNTP.parse(ntp)
+                if m is not None:
+                    return self.get_leader(m.source)
         return None
 
     async def wait_for_leader(self, ntp: NTP, timeout: float = 5.0) -> NodeId:

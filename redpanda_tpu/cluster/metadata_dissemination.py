@@ -71,6 +71,8 @@ class MetadataDisseminationService:
         self.connections = connection_cache
         self.interval_s = interval_s
         self._pending: list[tuple[NTP, int | None, int]] = []
+        # node_id -> updates that peer has not acked yet (retried alone)
+        self._deferred: dict[int, list] = {}
         self._task: asyncio.Task | None = None
         self._wake = asyncio.Event()
 
@@ -110,29 +112,54 @@ class MetadataDisseminationService:
 
     async def _loop(self) -> None:
         while True:
-            await self._wake.wait()
+            if self._deferred:
+                # a peer still owes us an ack: retry on a timer even with
+                # no fresh elections to coalesce
+                try:
+                    await asyncio.wait_for(self._wake.wait(), timeout=2.0)
+                except asyncio.TimeoutError:
+                    pass
+            else:
+                await self._wake.wait()
             await asyncio.sleep(self.interval_s)  # coalesce a burst of elections
             self._wake.clear()
             updates, self._pending = self._pending, []
-            if not updates:
+            # per-peer payload: fresh updates for everyone + whatever that
+            # peer failed to ack before (a dropped gossip round would leave
+            # it PERMANENTLY stale — elections are events, not a stream).
+            # The term guard in the leaders table makes duplicates no-ops.
+            peers = [
+                b.node_id
+                for b in self.members.all_brokers()
+                if b.node_id != self.self_node_id
+            ]
+            batches: dict[int, list] = {}
+            for node_id in peers:
+                batch = self._deferred.pop(node_id, []) + updates
+                if batch:
+                    batches[node_id] = batch
+            if not batches:
                 continue
-            blob = _encode_updates(updates)
             # gather (not fire-and-forget: unreferenced tasks can be GC'd):
             # sends run concurrently and each has its own short rpc timeout
-            await asyncio.gather(
+            results = await asyncio.gather(
                 *(
-                    self._send(b.node_id, blob)
-                    for b in self.members.all_brokers()
-                    if b.node_id != self.self_node_id
+                    self._send(node_id, _encode_updates(batch))
+                    for node_id, batch in batches.items()
                 )
             )
+            for (node_id, batch), ok in zip(batches.items(), results):
+                if not ok:
+                    self._deferred[node_id] = batch  # ONLY this peer retries
 
-    async def _send(self, node_id: int, blob: bytes) -> None:
+    async def _send(self, node_id: int, blob: bytes) -> bool:
         try:
             client = rpc.Client(md_dissemination_service, self.connections.get(node_id))
             await client.update_leadership({"updates_json": blob}, timeout=2.0)
+            return True
         except Exception:
             logger.debug("leadership gossip to node %d failed", node_id, exc_info=True)
+            return False
 
     async def pull_initial(self, from_node: int) -> None:
         """Joining broker: seed the leaders table from a peer."""

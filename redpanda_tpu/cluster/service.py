@@ -61,6 +61,7 @@ _OK, _NOT_LEADER, _ERROR, _EXISTS = 0, 1, 2, 3
 
 OP_CREATE_TOPIC, OP_DELETE_TOPIC, OP_ADD_PARTITIONS = 0, 1, 2
 OP_DECOMMISSION, OP_RECOMMISSION = 3, 4
+OP_CREATE_NON_REPLICABLE = 5  # coproc materialized topics
 
 
 async def apply_topic_op(controller: Controller, op: int, data: dict) -> None:
@@ -90,6 +91,10 @@ async def apply_topic_op(controller: Controller, op: int, data: dict) -> None:
         await controller.decommission_node(data["node_id"])
     elif op == OP_RECOMMISSION:
         await controller.recommission_node(data["node_id"])
+    elif op == OP_CREATE_NON_REPLICABLE:
+        await controller.create_non_replicable_topic(
+            data["source"], data["name"], data.get("ns", "kafka")
+        )
     else:
         raise ClusterError(f"unknown frontend op {op}")
 

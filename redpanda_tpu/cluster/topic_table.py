@@ -161,9 +161,19 @@ class TopicTable:
 
     def apply_create(self, config: TopicConfig, assignments: list[PartitionAssignment]) -> TopicMetadata:
         """Deterministic apply of a replicated create_topic command: the
-        assignments (incl. raft group ids) were fixed by the leader."""
+        assignments (incl. raft group ids) were fixed by the leader.
+
+        A DUPLICATE create in the log (two brokers raced the same name past
+        the leader's pre-check; both commands committed) applies as a no-op
+        keeping the FIRST winner's assignments — the command sits in the
+        log forever, so raising here would also fail every restart replay."""
         if config.name in self._topics:
-            raise ValueError(f"topic exists: {config.name}")
+            import logging
+
+            logging.getLogger("rptpu.cluster.topics").info(
+                "ignoring duplicate create for existing topic %r", config.name
+            )
+            return self._topics[config.name]
         md = TopicMetadata(config)
         for pa in assignments:
             md.assignments[pa.ntp.partition] = pa

@@ -52,14 +52,34 @@ class CoprocApi:
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> "CoprocApi":
-        if not self.broker.topic_table.contains(COPROC_INTERNAL_TOPIC):
-            try:
-                await self.broker.create_topic(TopicConfig(COPROC_INTERNAL_TOPIC, 1, 1))
-            except ValueError:
-                pass
         await self.pacemaker.start()
+        # topic creation happens inside the listener loop with retries:
+        # at startup the cluster may not have a quorum of REGISTERED nodes
+        # yet (replication = default factor needs them), and blocking app
+        # start on cluster formation would deadlock — every node is doing
+        # the same thing
         self._listener_task = asyncio.create_task(self._listen_loop())
         return self
+
+    async def _ensure_internal_topic(self) -> bool:
+        if self.broker.topic_table.contains(COPROC_INTERNAL_TOPIC):
+            return True
+        try:
+            # replicated to the default factor: every broker's listener
+            # reads its LOCAL raft replica of the event log, so deploys
+            # reconcile cluster-wide without a client hop
+            await self.broker.create_topic(
+                TopicConfig(
+                    COPROC_INTERNAL_TOPIC, 1,
+                    self.broker.config.default_replication,
+                )
+            )
+            return True
+        except ValueError:
+            return True  # lost a concurrent create: it exists
+        except Exception as e:
+            logger.debug("coproc internal topic not creatable yet: %s", e)
+            return False
 
     async def stop(self) -> None:
         if self._listener_task is not None:
@@ -90,7 +110,14 @@ class CoprocApi:
         await self._produce_event(wasm_event.make_remove_record(name))
 
     async def _produce_event(self, rec) -> None:
+        # topic creation is deferred to the listener loop (cluster
+        # formation); a deploy right after start must drive it itself
+        deadline = asyncio.get_event_loop().time() + 10.0
         p = self.broker.get_partition(COPROC_INTERNAL_TOPIC, 0)
+        while p is None and asyncio.get_event_loop().time() < deadline:
+            await self._ensure_internal_topic()
+            await asyncio.sleep(0.05)
+            p = self.broker.get_partition(COPROC_INTERNAL_TOPIC, 0)
         if p is None:
             raise RuntimeError("coproc internal topic missing")
         await p.replicate([wasm_event.deploy_batch([rec])], 0)
@@ -99,14 +126,17 @@ class CoprocApi:
     async def _listen_loop(self) -> None:
         """do_ingest (event_listener.cc:139): poll, validate, reconcile,
         dispatch enable/disable to engine + pacemaker."""
+        created = False
         while True:
             try:
+                if not created:
+                    created = await self._ensure_internal_topic()
                 await self._ingest_once()
             except asyncio.CancelledError:
                 raise
             except Exception:
                 logger.exception("coproc event ingest failed")
-            await asyncio.sleep(self.poll_interval_s)
+            await asyncio.sleep(self.poll_interval_s if created else 0.5)
 
     async def _ingest_once(self) -> None:
         p = self.broker.get_partition(COPROC_INTERNAL_TOPIC, 0)

@@ -99,10 +99,14 @@ class ScriptContext:
                 read_high[ntp] = batches[-1].last_offset
         if not items:
             return False
-        # Submit is async-dispatch (one H2D + launch, no sync); harvest in a
-        # worker thread so other script fibers overlap with the device.
-        ticket = pm.engine.submit(ProcessBatchRequest(items))
+        # Submit AND harvest run in worker threads: the first dispatch of a
+        # spec jit-compiles for seconds, and anything that blocks the
+        # broker's event loop that long stops raft heartbeats and forces
+        # cluster-wide re-elections (measured: every group re-elected ~10s
+        # after the first deploy when submit ran on-loop).
         loop = asyncio.get_running_loop()
+        req = ProcessBatchRequest(items)
+        ticket = await loop.run_in_executor(None, pm.engine.submit, req)
         reply = await loop.run_in_executor(None, ticket.result)
         if self.script_id in reply.deregistered:
             logger.warning("script %s deregistered by engine policy", self.name)
@@ -241,16 +245,45 @@ class Pacemaker:
                 src_md = self.broker.topic_table.get(source.topic)
                 n_parts = src_md.config.partition_count if src_md else 1
                 try:
-                    # Materialized logs live NEXT TO their source partition
-                    # (script_context_backend.cc:70-78 direct storage
-                    # append, no raft) — never controller-allocated.
-                    await self.broker.create_topic(
-                        TopicConfig(mntp.topic, n_parts, 1, ns=mntp.ns),
-                        local_only=True,
-                    )
+                    dispatcher = getattr(self.broker, "controller_dispatcher", None)
+                    if dispatcher is not None:
+                        # Clustered: replicate create_non_replicable_topic
+                        # so every broker's metadata agrees; assignments
+                        # mirror the source (group -1, coproc writes bypass
+                        # raft — commands.h:112 non_replicable semantics)
+                        from redpanda_tpu.cluster.service import (
+                            OP_CREATE_NON_REPLICABLE,
+                        )
+
+                        await dispatcher.topic_op(
+                            OP_CREATE_NON_REPLICABLE,
+                            {"source": source.topic, "name": mntp.topic,
+                             "ns": mntp.ns},
+                        )
+                        await self.broker._await_topic_table(
+                            lambda: self.broker.topic_table.contains(mntp.topic),
+                            f"materialize {mntp.topic}",
+                        )
+                    else:
+                        # Standalone: the materialized log lives NEXT TO its
+                        # source partition (script_context_backend.cc:70-78
+                        # direct storage append, no raft)
+                        await self.broker.create_topic(
+                            TopicConfig(mntp.topic, n_parts, 1, ns=mntp.ns),
+                            local_only=True,
+                        )
                 except ValueError:
                     pass
-            return self.broker.partition_manager.get(mntp)
+            # the local log: reconciled by the backend (clustered) or
+            # created by the local path above
+            p = self.broker.partition_manager.get(mntp)
+            if p is None:
+                for _ in range(100):
+                    await asyncio.sleep(0.05)
+                    p = self.broker.partition_manager.get(mntp)
+                    if p is not None:
+                        break
+            return p
 
     # ------------------------------------------------------------ offsets
     def _kvs(self):

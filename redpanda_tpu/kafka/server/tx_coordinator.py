@@ -29,6 +29,12 @@ logger = logging.getLogger("rptpu.kafka.tx")
 _PID_BLOCK = 1000  # id_allocator_stm hands out ranges, not single ids
 
 
+def _new_lock():
+    import asyncio
+
+    return asyncio.Lock()
+
+
 class TxState(enum.Enum):
     empty = "Empty"
     ongoing = "Ongoing"
@@ -49,6 +55,10 @@ class TxMetadata:
         # group_id -> staged offset commits, applied atomically on commit
         self.staged_offsets: dict[str, dict[tuple[str, int], OffsetCommit]] = {}
         self.last_update = time.monotonic()
+        # runtime-only (not persisted): finish serialization + re-drive pacing
+        self.finish_lock = _new_lock()
+        self.redrive_attempts = 0
+        self.next_redrive = 0.0
 
     def to_dict(self) -> dict:
         return {
@@ -281,9 +291,29 @@ class TxCoordinator:
             return E.none  # nothing to do; kafka allows the no-op commit
         return await self._finish(md, commit)
 
-    async def _finish(self, md: TxMetadata, commit: bool) -> E:
+    async def _finish(self, md: TxMetadata, commit: bool, *, redrive: bool = False) -> E:
+        # Serialized per tx: the 1 Hz re-drive (expire_stale) must never
+        # overlap the client's own EndTxn attempt — a duplicate marker RPC
+        # landing AFTER completion could commit/abort the producer's NEXT
+        # transaction's open data (same pid/epoch spans transactions).
+        async with md.finish_lock:
+            if md.state in (TxState.complete_commit, TxState.complete_abort):
+                return E.none  # the other driver already completed it
+            return await self._finish_locked(md, commit, redrive)
+
+    async def _finish_locked(self, md: TxMetadata, commit: bool, redrive: bool) -> E:
         md.state = TxState.prepare_commit if commit else TxState.prepare_abort
         self._persist_tx(md)
+        # Partitions whose TOPIC no longer exists can never take a marker —
+        # their rm_stm state died with the topic; keeping them would brick
+        # this transactional id in an unfinishable prepare_* loop.
+        for topic, p in list(md.partitions):
+            tmd = self.broker.topic_table.get(topic)
+            if tmd is None or p not in tmd.assignments:
+                logger.warning(
+                    "tx %s: dropping marker for deleted %s/%d", md.tx_id, topic, p
+                )
+                md.partitions.discard((topic, p))
         # 1. control markers on every touched partition (tx_gateway fan-out).
         #    Any failure leaves the tx in prepare_* so EndTxn/recovery can
         #    re-drive it — claiming success with a marker missing would pin
@@ -326,6 +356,21 @@ class TxCoordinator:
                 failed = True
                 continue
             if code != 0:
+                if redrive:
+                    # A fence during RE-DRIVE means a newer epoch already
+                    # superseded this tx on that partition — its markers are
+                    # moot; complete as aborted so the 1 Hz loop terminates
+                    # instead of re-driving a dead tx forever.
+                    logger.warning(
+                        "tx %s: fenced during re-drive (errc %d); "
+                        "completing as aborted", md.tx_id, code,
+                    )
+                    md.partitions.clear()
+                    md.staged_offsets.clear()
+                    md.state = TxState.complete_abort
+                    md.last_update = time.monotonic()
+                    self._persist_tx(md)
+                    return E.none
                 return E(code)  # epoch fence: not retriable, must re-init
         if failed:
             return E.coordinator_not_available  # retriable; state stays prepare_*
@@ -369,8 +414,20 @@ class TxCoordinator:
                 logger.info("aborting expired tx %s", md.tx_id)
                 await self._finish(md, commit=False)
             elif md.state in (TxState.prepare_commit, TxState.prepare_abort):
+                # exponential backoff (1s..60s): a partition that stays
+                # unreachable shouldn't be hammered at 1 Hz forever; the
+                # per-tx finish_lock keeps this from overlapping a client
+                # retry, and skip entirely while one is in flight
+                if md.finish_lock.locked() or now < md.next_redrive:
+                    continue
                 code = await self._finish(
-                    md, commit=md.state == TxState.prepare_commit
+                    md, commit=md.state == TxState.prepare_commit, redrive=True
                 )
                 if code == E.none:
                     logger.info("re-drove interrupted tx %s", md.tx_id)
+                    md.redrive_attempts = 0
+                else:
+                    md.redrive_attempts += 1
+                    md.next_redrive = time.monotonic() + min(
+                        2.0 ** md.redrive_attempts, 60.0
+                    )

@@ -19,7 +19,7 @@ def proc_cluster(tmp_path_factory):
             3,
             # replicate EVERYTHING 3x, including __consumer_offsets, so any
             # single kill is survivable (raft_availability_test shape)
-            extra_config={"default_topic_replication": 3},
+            extra_config={"default_topic_replication": 3, "coproc_enable": 1},
         )
         await cluster.start()
         return cluster

@@ -49,6 +49,9 @@ async def _cross_node_partition(cluster, c, topic: str, coordinator: int) -> int
     for p, leader in leaders.items():
         if leader is not None and leader != coordinator:
             return p
+    assert all(v is not None for v in leaders.values()), (
+        f"leaders never resolved: {leaders}"
+    )
     # every partition is led by the coordinator: move partition 0 away,
     # asking ITS LEADER's admin to run the transfer
     target = (coordinator + 1) % 3

@@ -409,23 +409,31 @@ def test_allocator_constraints():
     assert all(2 not in s for s in sets)
 
 
-def test_duplicate_create_surfaces_apply_error(tmp_path):
+def test_duplicate_create_applies_as_first_wins_noop(tmp_path):
+    """Two brokers can race the same create past the leader's pre-check,
+    committing BOTH commands; the duplicate must apply as a no-op keeping
+    the first winner's assignments — raising would also fail every restart
+    replay of the log (the duplicate sits there forever)."""
+
     async def main():
         fx = await ClusterFixture(tmp_path, 3).start()
         try:
             leader = fx.controller_leader()
             ntp = NTP.kafka("dup", 0)
-            cmd = ccmds.create_topic_cmd(
+            cmd1 = ccmds.create_topic_cmd(
                 {"name": "dup", "ns": "kafka", "replication_factor": 3, "overrides": {}},
                 [ccmds.assignment_payload(ntp, 2000, [0, 1, 2])],
             )
-            await leader.controller.replicate_and_wait(cmd)
-            # identical command again: apply raises "topic exists" on every
-            # node and the caller must see the failure, not silent success
-            from redpanda_tpu.cluster import ClusterError
-
-            with pytest.raises(ClusterError):
-                await leader.controller.replicate_and_wait(cmd)
+            cmd2 = ccmds.create_topic_cmd(
+                {"name": "dup", "ns": "kafka", "replication_factor": 3, "overrides": {}},
+                [ccmds.assignment_payload(ntp, 2001, [2, 1, 0])],  # the loser
+            )
+            await leader.controller.replicate_and_wait(cmd1)
+            await leader.controller.replicate_and_wait(cmd2)  # no raise
+            for node in fx.nodes:
+                md = node.controller.topic_table.get("dup")
+                assert md is not None
+                assert md.assignments[0].group == 2000  # first wins
         finally:
             await fx.stop()
 
