@@ -1,12 +1,16 @@
-"""Gobekli-style linearizability campaign against a real 3-node cluster.
+"""Gobekli-style linearizability campaigns against a real 3-node cluster.
 
-Two campaigns prove the checker works end to end (VERDICT r3 #4; reference
-src/consistency-testing/gobekli/gobekli/consensus.py:65 + chaostest):
+Three campaigns prove the checker works end to end (VERDICT r3 #4;
+reference src/consistency-testing/gobekli/gobekli/consensus.py:65 +
+chaostest):
 
 1. CLEAN: concurrent writers + a reader run through a leader SIGKILL; the
    history must check out — raft must not lose acked writes, reorder real
    time, or serve stale/rolled-back reads.
-2. BROKEN: the broker is deliberately mis-configured
+2. SLOW NETWORK: delay probes on a follower's append_entries (the io-delay
+   campaign shape, on the shared package cluster) slow replication without
+   breaking it; the history must still linearize.
+3. BROKEN: the broker is deliberately mis-configured
    (unsafe_relaxed_acks: acks=-1 served at leader level) with
    append_entries failure probes armed on both followers via the admin
    honey-badger API, then the leader is killed. The checker MUST report
@@ -91,6 +95,42 @@ def test_clean_cluster_history_linearizes(tmp_path):
                 "\n".join(res.violations[:10])
         finally:
             await cluster.stop()
+
+    asyncio.run(body())
+
+
+def test_slow_network_still_linearizes(proc_cluster):
+    """Latency faults instead of kills: delay probes on a follower's
+    append_entries (chaostest's io-delay campaign shape) slow replication
+    without breaking it — acked writes must still linearize."""
+
+    async def body():
+        cluster = proc_cluster
+        c = await KafkaClient(cluster.bootstrap()).connect()
+        await c.create_topic("lin-slow", partitions=1, replication=3)
+        await c.close()
+        leader = await _find_leader(cluster, "lin-slow")
+        slow = cluster.nodes[(leader + 1) % 3]
+        try:
+            # arm INSIDE the try: if the PUT arms server-side but the
+            # response times out client-side, the finally must still
+            # disarm — the cluster is shared by the whole chaos package
+            st = await _admin(
+                slow, "PUT", "/v1/failure-probes/raftgen/append_entries/delay"
+            )
+            assert st == 200, st
+            wl = LogWorkload(cluster.bootstrap, "lin-slow")
+            await asyncio.wait_for(
+                asyncio.gather(wl.writer(1, 20), wl.reader(20)), 180
+            )
+        finally:
+            await _admin(
+                slow, "DELETE", "/v1/failure-probes/raftgen/append_entries"
+            )
+        final = await wl.final_log()
+        res = check_history(wl.history, final)
+        assert res.n_acked_writes >= 15
+        assert res.ok, "\n".join(res.violations[:10])
 
     asyncio.run(body())
 
