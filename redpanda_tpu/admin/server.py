@@ -109,6 +109,7 @@ class AdminServer:
                 "/v1/partitions/kafka/{topic}/{partition}/transfer_leadership",
                 self._partition_transfer,
             ),
+            web.post("/v1/partitions/rebalance_leaders", self._rebalance_leaders),
             web.get("/v1/security/users", self._list_users),
             web.post("/v1/security/users", self._create_user),
             web.delete("/v1/security/users/{user}", self._delete_user),
@@ -262,6 +263,66 @@ class AdminServer:
             return web.json_response({"error": "unknown or non-raft partition"}, status=404)
         ok = await consensus.do_transfer_leadership(target)
         return web.json_response({"success": bool(ok)})
+
+    async def _rebalance_leaders(self, req: web.Request) -> web.Response:
+        """Shed THIS broker's excess leaderships toward under-loaded peers
+        (leadership rebalancing via transfer_leadership, SURVEY §5; each
+        node can only initiate transfers for groups it leads, so the
+        operator — rpk cluster rebalance — calls every node's admin)."""
+        if self.controller is None:
+            return web.json_response({"error": "not clustered"}, status=400)
+        mdc = getattr(self.broker, "metadata_cache", None)
+        me = self.broker.config.node_id
+        # cluster-wide leader counts over raft-backed partitions
+        counts: dict[int, int] = {
+            b.node_id: 0 for b in self.controller.members.all_brokers()
+        }
+        led_here = []  # (ntp, consensus, replicas)
+        for md in self.broker.topic_table.topics().values():
+            for pa in md.assignments.values():
+                if pa.group < 0:
+                    continue
+                leader = mdc.get_leader(pa.ntp) if mdc else pa.leader
+                if leader in counts:
+                    counts[leader] += 1
+                p = self.broker.partition_manager.get(pa.ntp)
+                consensus = getattr(p, "consensus", None)
+                if (
+                    p is not None
+                    and p.is_leader()
+                    and hasattr(consensus, "do_transfer_leadership")
+                ):
+                    led_here.append((pa.ntp, consensus, list(pa.replicas)))
+        if not counts:
+            return web.json_response({"transferred": []})
+        fair = max(1, round(sum(counts.values()) / len(counts)))
+        transferred = []
+        for ntp, consensus, replicas in led_here:
+            if counts.get(me, 0) <= fair:
+                break
+            # most under-loaded replica of THIS partition takes it
+            candidates = [r for r in replicas if r != me and r in counts]
+            if not candidates:
+                continue
+            target = min(candidates, key=lambda r: counts[r])
+            if counts[target] >= counts[me] - 1:
+                continue  # transfer would not improve balance
+            try:
+                ok = await consensus.do_transfer_leadership(target)
+            except Exception as e:
+                # transfer already in flight / target mid-replica-move:
+                # skip this partition, keep balancing the rest
+                logger.debug("rebalance transfer %s -> %d skipped: %s",
+                             ntp, target, e)
+                continue
+            if ok:
+                counts[me] -= 1
+                counts[target] += 1
+                transferred.append(
+                    {"ns": ntp.ns, "topic": ntp.topic, "partition": ntp.partition,
+                     "to": target}
+                )
+        return web.json_response({"transferred": transferred, "leader_counts": counts})
 
     # ------------------------------------------------------------ users
     async def _list_users(self, req: web.Request) -> web.Response:

@@ -229,6 +229,26 @@ async def cmd_wasm(args) -> int:
 
 # ================================================================ cluster / user / config
 async def cmd_cluster(args) -> int:
+    if getattr(args, "cluster_cmd", None) == "rebalance":
+        # each node sheds its own excess leaderships; hit every admin given
+        total = []
+        failures = 0
+        for admin in (args.admin_apis or args.admin_api).split(","):
+            ns = argparse.Namespace(**{**vars(args), "admin_api": admin.strip()})
+            status, body = await _admin_request(
+                ns, "POST", "/v1/partitions/rebalance_leaders"
+            )
+            if status != 200:
+                print(f"{admin}: error {status} {body}", file=sys.stderr)
+                failures += 1
+                continue
+            total.extend(body.get("transferred", []))
+            print(f"{admin}: moved {len(body.get('transferred', []))}, "
+                  f"leader counts {body.get('leader_counts')}")
+        print(f"total transferred: {len(total)}")
+        # nonzero when ANY node could not rebalance: scripted callers must
+        # not read a partial pass as success
+        return 1 if failures else 0
     status, brokers = await _admin_request(args, "GET", "/v1/brokers")
     if status != 200:
         print(f"admin api error {status}", file=sys.stderr)
@@ -398,8 +418,15 @@ def build_parser() -> argparse.ArgumentParser:
     wr = wsub.add_parser("remove")
     wr.add_argument("name")
 
-    cp = sub.add_parser("cluster", help="cluster info")
-    cp.add_subparsers(dest="cluster_cmd").add_parser("info")
+    cp = sub.add_parser("cluster", help="cluster info + leadership balance")
+    csub = cp.add_subparsers(dest="cluster_cmd")
+    csub.add_parser("info")
+    crb = csub.add_parser("rebalance", help="spread partition leaderships")
+    crb.add_argument(
+        "--admin-apis",
+        help="comma-separated admin endpoints, one per broker "
+        "(each node sheds its own excess)",
+    )
 
     up = sub.add_parser("user", help="SCRAM users (admin api)")
     usub = up.add_subparsers(dest="user_cmd", required=True)

@@ -1,0 +1,80 @@
+"""Leadership rebalancing across a real cluster (SURVEY §5: leadership
+rebalancing via transfer_leadership). Initial elections routinely skew
+leaderships onto whichever broker finished startup first; the admin
+rebalance endpoint makes each node shed its excess toward under-loaded
+replicas, and `rpk cluster rebalance` drives every node's admin."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+
+from redpanda_tpu.kafka.client import KafkaClient
+
+pytestmark = pytest.mark.chaos
+
+
+async def _leader_counts(cluster) -> tuple[dict[int, int], int]:
+    """GLOBAL leader counts (the endpoint balances the whole cluster)."""
+    c = await KafkaClient(cluster.bootstrap()).connect()
+    md = await c.refresh_metadata(None)
+    counts: dict[int, int] = {0: 0, 1: 0, 2: 0}
+    total = 0
+    for t in md["topics"]:
+        for p in t.get("partitions") or []:
+            total += 1
+            if p["leader_id"] >= 0:
+                counts[p["leader_id"]] += 1
+    await c.close()
+    return counts, total
+
+
+def test_rebalance_spreads_leaders(proc_cluster):
+    async def body():
+        cluster = proc_cluster
+        c = await KafkaClient(cluster.bootstrap()).connect()
+        topics = []
+        for i in range(2):
+            name = f"bal-{i}"
+            topics.append(name)
+            await c.create_topic(name, partitions=6, replication=3)
+        # wait for every partition's leader to be known
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            await c.refresh_metadata(topics)
+            known = sum(
+                1 for (t, p), v in c._leaders.items()
+                if t in topics and v is not None
+            )
+            if known >= 12:
+                break
+            await asyncio.sleep(0.5)
+        await c.close()
+
+        # run rebalance on every node's admin until stable (each pass a
+        # node sheds toward fair; GLOBAL spread must tighten)
+        for _ in range(6):
+            for n in cluster.nodes:
+                async with aiohttp.ClientSession() as s:
+                    url = (
+                        f"http://127.0.0.1:{n.ports['admin']}"
+                        "/v1/partitions/rebalance_leaders"
+                    )
+                    async with s.post(
+                        url, timeout=aiohttp.ClientTimeout(total=20)
+                    ) as r:
+                        assert r.status == 200, await r.text()
+            await asyncio.sleep(1.0)
+            counts, _ = await _leader_counts(cluster)
+            if max(counts.values()) - min(counts.values()) <= 3:
+                break
+        counts, total = await _leader_counts(cluster)
+        assert sum(counts.values()) >= total - 1, (counts, total)
+        assert max(counts.values()) - min(counts.values()) <= 3, (
+            f"leaderships still skewed after rebalance: {counts}"
+        )
+
+    asyncio.run(asyncio.wait_for(body(), 240))
