@@ -11,6 +11,7 @@ import asyncio
 import logging
 
 from redpanda_tpu.cloud_storage.manifest import PartitionManifest, TopicManifest
+from redpanda_tpu.http import HttpError
 from redpanda_tpu.s3 import S3Client, S3Error
 
 logger = logging.getLogger("rptpu.cloud_storage")
@@ -31,7 +32,7 @@ class Remote:
                 return await fn()
             except FileNotFoundError:
                 raise
-            except (S3Error, OSError, asyncio.TimeoutError) as e:
+            except (S3Error, HttpError, OSError, asyncio.TimeoutError) as e:
                 logger.warning("%s failed (attempt %d): %s", what, attempt, e)
                 if attempt == self.retries:
                     raise

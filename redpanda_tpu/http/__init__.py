@@ -1,0 +1,10 @@
+"""Owned async HTTP/1.1 client layer (reference src/v/http)."""
+
+from redpanda_tpu.http.client import (
+    HttpClient,
+    HttpError,
+    HttpProbe,
+    HttpResponse,
+)
+
+__all__ = ["HttpClient", "HttpError", "HttpProbe", "HttpResponse"]

@@ -1,0 +1,360 @@
+"""Owned async HTTP/1.1 client.
+
+Parity with the reference's Beast-based http layer (http/client.h:71-99
+`client : rpc::base_transport` with get_connected/max_idle_time,
+http/chunk_encoding.h chunked framing, http/probe.h counters): an
+asyncio-streams client that owns its wire framing rather than delegating to
+a third-party HTTP library — request serialization, status-line/header
+parsing, Content-Length and chunked transfer decoding, keep-alive
+connection reuse with an idle deadline, TLS, and per-request timeouts.
+
+One `HttpClient` holds at most one live connection per origin (the
+reference's client is likewise one transport; `s3::client_pool` layers
+pooling above it, as our S3 layer does with retries above this class).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl as ssl_mod
+import time
+import urllib.parse
+from dataclasses import dataclass, field
+
+DEFAULT_CONNECT_TIMEOUT = 5.0  # http/client.h:63 default_connect_timeout = 5s
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 1 << 30
+
+
+class HttpError(Exception):
+    """Transport- or framing-level failure (not a non-2xx status)."""
+
+
+@dataclass
+class HttpProbe:
+    """Client counters (http/probe.h): requests, bytes, errors."""
+
+    requests: int = 0
+    responses: int = 0
+    transport_errors: int = 0
+    bytes_sent: int = 0
+    bytes_received: int = 0
+
+    def as_dict(self) -> dict[str, int]:
+        return dict(self.__dict__)
+
+
+@dataclass
+class HttpResponse:
+    status: int
+    reason: str
+    headers: dict[str, str]  # keys lower-cased; duplicates comma-joined
+    body: bytes
+
+    def header(self, name: str, default: str = "") -> str:
+        return self.headers.get(name.lower(), default)
+
+
+@dataclass
+class _Conn:
+    reader: asyncio.StreamReader
+    writer: asyncio.StreamWriter
+    last_used: float = field(default_factory=time.monotonic)
+
+    def stale(self, max_idle: float) -> bool:
+        return (time.monotonic() - self.last_used) > max_idle
+
+    async def close(self) -> None:
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except (OSError, asyncio.CancelledError):
+            pass
+
+
+def _parse_origin(base_url: str) -> tuple[str, str, int, bool, str]:
+    u = urllib.parse.urlsplit(base_url)
+    if u.scheme not in ("http", "https"):
+        raise HttpError(f"unsupported scheme: {base_url!r}")
+    tls = u.scheme == "https"
+    if not u.hostname:
+        raise HttpError(f"no host in {base_url!r}")
+    prefix = u.path.rstrip("/")  # base path (e.g. reverse-proxy mount point)
+    return u.hostname, u.netloc, u.port or (443 if tls else 80), tls, prefix
+
+
+# methods safe to transparently resend after a connection-level failure
+_IDEMPOTENT = frozenset({"GET", "HEAD", "PUT", "DELETE", "OPTIONS", "TRACE"})
+
+
+class HttpClient:
+    """HTTP/1.1 client for one origin with a keep-alive connection pool.
+
+    `base_url` fixes scheme/host/port (plus an optional base path prefix,
+    e.g. a reverse-proxy mount point); `request()` takes the raw
+    path-and-query string and sends it verbatim (no re-encoding — the S3
+    SigV4 path depends on byte-identical URIs, s3/signature parity).
+
+    Up to `max_connections` requests run concurrently, each on its own
+    connection (the reference layers `s3::client_pool` above its
+    one-connection client; here the pool is built in, client.h:217-227).
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        connect_timeout: float = DEFAULT_CONNECT_TIMEOUT,
+        request_timeout: float = 60.0,
+        max_idle_s: float = 30.0,
+        max_connections: int = 8,
+        ssl_context: ssl_mod.SSLContext | None = None,
+        verify_tls: bool = True,
+    ) -> None:
+        (
+            self.host,
+            self.netloc,
+            self.port,
+            self.tls,
+            self.path_prefix,
+        ) = _parse_origin(base_url)
+        self.connect_timeout = connect_timeout
+        self.request_timeout = request_timeout
+        self.max_idle_s = max_idle_s
+        self.probe = HttpProbe()
+        self._idle: list[_Conn] = []
+        self._closed = False
+        self._sem = asyncio.Semaphore(max_connections)
+        if ssl_context is not None:
+            self._ssl: ssl_mod.SSLContext | None = ssl_context
+        elif self.tls:
+            ctx = ssl_mod.create_default_context()
+            if not verify_tls:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl_mod.CERT_NONE
+            self._ssl = ctx
+        else:
+            self._ssl = None
+
+    # ------------------------------------------------------------ lifecycle
+    async def _checkout(self) -> _Conn:
+        """Adopt an idle keep-alive connection or dial (client.h:97-99)."""
+        if self._closed:
+            raise HttpError("client closed")
+        while self._idle:
+            conn = self._idle.pop()
+            if conn.stale(self.max_idle_s) or conn.writer.is_closing():
+                await conn.close()
+                continue
+            return conn
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port, ssl=self._ssl),
+                timeout=self.connect_timeout,
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            self.probe.transport_errors += 1
+            raise HttpError(f"connect {self.host}:{self.port}: {e}") from e
+        return _Conn(reader, writer)
+
+    def _checkin(self, conn: _Conn) -> None:
+        if self._closed:
+            # a request that was in flight when close() ran must not park
+            # its socket in a pool nobody will drain again
+            conn.writer.close()
+            return
+        conn.last_used = time.monotonic()
+        self._idle.append(conn)
+
+    async def close(self) -> None:
+        self._closed = True
+        while self._idle:
+            await self._idle.pop().close()
+
+    async def __aenter__(self) -> "HttpClient":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # -------------------------------------------------------------- request
+    async def request(
+        self,
+        method: str,
+        path_qs: str,
+        *,
+        headers: dict[str, str] | None = None,
+        body: bytes = b"",
+        chunked: bool = False,
+    ) -> HttpResponse:
+        """Send one request; `chunked=True` frames the body with chunked
+        transfer-encoding (http/chunk_encoding.h) instead of Content-Length."""
+        async with self._sem:
+            # A connection-level failure (peer dropped a keep-alive socket,
+            # reset before the response) is retried ONCE on a fresh dial —
+            # but only for idempotent methods: a POST may have executed
+            # server-side even though the response never arrived.
+            for attempt in (0, 1):
+                conn = await self._checkout()
+                try:
+                    resp = await asyncio.wait_for(
+                        self._round_trip(conn, method, path_qs, headers, body, chunked),
+                        timeout=self.request_timeout,
+                    )
+                except (
+                    HttpError,
+                    OSError,
+                    ValueError,  # int parses + StreamReader limit overruns
+                    asyncio.IncompleteReadError,
+                    asyncio.TimeoutError,
+                ) as e:
+                    # never reuse a connection in an unknown framing state
+                    await conn.close()
+                    # TimeoutError subclasses OSError (3.11+): never retried
+                    retriable = (
+                        isinstance(e, (OSError, asyncio.IncompleteReadError))
+                        and not isinstance(e, asyncio.TimeoutError)
+                        and method in _IDEMPOTENT
+                        and attempt == 0
+                    )
+                    if not retriable:
+                        self.probe.transport_errors += 1
+                        if isinstance(e, asyncio.TimeoutError):
+                            raise HttpError(f"request timeout ({self.request_timeout}s)") from e
+                        raise e if isinstance(e, HttpError) else HttpError(str(e)) from e
+                else:
+                    return resp
+            raise AssertionError("unreachable")
+
+    async def _round_trip(
+        self,
+        conn: _Conn,
+        method: str,
+        path_qs: str,
+        headers: dict[str, str] | None,
+        body: bytes,
+        chunked: bool,
+    ) -> HttpResponse:
+        hdrs = {"host": self.netloc, "connection": "keep-alive"}
+        if headers:
+            hdrs.update({k.lower(): v for k, v in headers.items()})
+        if chunked:
+            hdrs["transfer-encoding"] = "chunked"
+            hdrs.pop("content-length", None)
+        elif body or method in ("PUT", "POST", "PATCH"):
+            hdrs["content-length"] = str(len(body))
+
+        if not path_qs.startswith("/"):
+            path_qs = "/" + path_qs
+        if self.path_prefix:
+            path_qs = self.path_prefix + path_qs
+        head = f"{method} {path_qs} HTTP/1.1\r\n"
+        head += "".join(f"{k}: {v}\r\n" for k, v in hdrs.items())
+        head += "\r\n"
+        wire = head.encode("latin-1")
+        if chunked:
+            # single data chunk + terminal chunk is a valid chunked stream
+            if body:
+                wire += f"{len(body):x}\r\n".encode() + body + b"\r\n"
+            wire += b"0\r\n\r\n"
+        else:
+            wire += body
+        conn.writer.write(wire)
+        await conn.writer.drain()
+        self.probe.requests += 1
+        self.probe.bytes_sent += len(wire)
+
+        resp = await self._read_response(conn.reader, method)
+        self.probe.responses += 1
+        if resp.header("connection").lower() == "close":
+            await conn.close()
+        else:
+            self._checkin(conn)
+        return resp
+
+    # ------------------------------------------------------------- response
+    async def _read_response(
+        self, reader: asyncio.StreamReader, method: str
+    ) -> HttpResponse:
+        status_line = await reader.readline()
+        if not status_line:
+            raise asyncio.IncompleteReadError(b"", None)
+        parts = status_line.decode("latin-1").rstrip("\r\n").split(" ", 2)
+        if len(parts) < 2 or not parts[0].startswith("HTTP/1."):
+            raise HttpError(f"bad status line: {status_line!r}")
+        try:
+            status = int(parts[1])
+        except ValueError as e:
+            raise HttpError(f"bad status line: {status_line!r}") from e
+        reason = parts[2] if len(parts) > 2 else ""
+
+        headers: dict[str, str] = {}
+        total = len(status_line)
+        while True:
+            line = await reader.readline()
+            total += len(line)
+            if total > MAX_HEADER_BYTES:
+                raise HttpError("header section too large")
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode("latin-1").partition(":")
+            k = k.strip().lower()
+            v = v.strip()
+            headers[k] = f"{headers[k]}, {v}" if k in headers else v
+
+        body = b""
+        if method != "HEAD" and not (100 <= status < 200 or status in (204, 304)):
+            if "chunked" in headers.get("transfer-encoding", "").lower():
+                body = await self._read_chunked(reader)
+            elif "content-length" in headers:
+                try:
+                    n = int(headers["content-length"])
+                except ValueError as e:
+                    raise HttpError(
+                        f"bad content-length: {headers['content-length']!r}"
+                    ) from e
+                if n > MAX_BODY_BYTES:
+                    raise HttpError(f"body too large: {n}")
+                body = await reader.readexactly(n) if n else b""
+            else:
+                # no framing info: body runs to connection close (HTTP/1.0
+                # style). StreamReader.read(n) returns what's buffered after
+                # one wait, so loop until true EOF.
+                parts = []
+                got = 0
+                while got <= MAX_BODY_BYTES:
+                    part = await reader.read(64 * 1024)
+                    if not part:
+                        break
+                    parts.append(part)
+                    got += len(part)
+                else:
+                    raise HttpError("unframed body too large")
+                body = b"".join(parts)
+                headers["connection"] = "close"
+        self.probe.bytes_received += len(body)
+        return HttpResponse(status, reason, headers, body)
+
+    async def _read_chunked(self, reader: asyncio.StreamReader) -> bytes:
+        """Chunked transfer decoding (http/chunk_encoding.h inverse)."""
+        out = bytearray()
+        while True:
+            size_line = await reader.readline()
+            if not size_line:
+                raise asyncio.IncompleteReadError(b"", None)
+            try:
+                size = int(size_line.split(b";", 1)[0].strip() or b"0", 16)
+            except ValueError as e:
+                raise HttpError(f"bad chunk size: {size_line!r}") from e
+            if size == 0:
+                # trailers until blank line
+                while True:
+                    t = await reader.readline()
+                    if t in (b"\r\n", b"\n", b""):
+                        return bytes(out)
+            if len(out) + size > MAX_BODY_BYTES:
+                raise HttpError("chunked body too large")
+            out += await reader.readexactly(size)
+            crlf = await reader.readexactly(2)
+            if crlf != b"\r\n":
+                raise HttpError(f"bad chunk terminator: {crlf!r}")

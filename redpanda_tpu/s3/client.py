@@ -2,9 +2,10 @@
 
 Parity with s3/client.h:95-227 + signature.h: request_creator signs
 GET/PUT/DeleteObject/ListObjectsV2 with SigV4 (canonical request →
-string-to-sign → derived signing key), and the client rides the http layer
-(the reference's own Beast-based http::client; here aiohttp, the build's
-http client). ListObjectsV2's XML is parsed with the stdlib ElementTree.
+string-to-sign → derived signing key), and the client rides the build's own
+http layer (`redpanda_tpu.http.HttpClient`, the analogue of the reference's
+Beast-based http::client). ListObjectsV2's XML is parsed with the stdlib
+ElementTree.
 """
 
 from __future__ import annotations
@@ -16,7 +17,7 @@ import logging
 import urllib.parse
 import xml.etree.ElementTree as ET
 
-import aiohttp
+from redpanda_tpu.http import HttpClient
 
 logger = logging.getLogger("rptpu.s3")
 
@@ -115,24 +116,28 @@ class S3Client:
         endpoint: str | None = None,  # e.g. http://127.0.0.1:9000 (minio/imposter)
         access_key: str = "",
         secret_key: str = "",
+        request_timeout: float = 300.0,  # whole-round-trip bound; sized for
+        # full segment uploads on slow links (aiohttp's old default total)
     ) -> None:
         self.bucket = bucket
         self.region = region
         self.endpoint = endpoint or f"https://{bucket}.s3.{region}.amazonaws.com"
         self.access_key = access_key
         self.secret_key = secret_key
-        self._session: aiohttp.ClientSession | None = None
+        self._request_timeout = request_timeout
+        self._http: HttpClient | None = None
         # path-style for custom endpoints (minio), virtual-host for AWS
         self._path_style = endpoint is not None
 
-    async def _sess(self) -> aiohttp.ClientSession:
-        if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession()
-        return self._session
+    def _sess(self) -> HttpClient:
+        if self._http is None:
+            self._http = HttpClient(self.endpoint, request_timeout=self._request_timeout)
+        return self._http
 
     async def close(self) -> None:
-        if self._session is not None and not self._session.closed:
-            await self._session.close()
+        if self._http is not None:
+            await self._http.close()
+            self._http = None
 
     def _url_path(self, key: str) -> str:
         key = key.lstrip("/")
@@ -148,19 +153,15 @@ class S3Client:
             method, host, path, query, payload,
             self.access_key, self.secret_key, self.region,
         )
-        # The URL carries the exact bytes that were signed (canonical URI +
-        # canonical query); yarl must not re-encode them (encoded=True).
-        url = self.endpoint + canonical_uri(path)
+        # The path+query carries the exact bytes that were signed (canonical
+        # URI + canonical query); HttpClient sends them verbatim.
+        path_qs = canonical_uri(path)
         if query:
-            url += "?" + canonical_query_string(query)
-        sess = await self._sess()
-        from yarl import URL
-
-        async with sess.request(
-            method, URL(url, encoded=True), data=payload or None, headers=headers
-        ) as resp:
-            body = await resp.read()
-            return resp.status, body
+            path_qs += "?" + canonical_query_string(query)
+        resp = await self._sess().request(
+            method, path_qs, headers=headers, body=payload
+        )
+        return resp.status, resp.body
 
     # ------------------------------------------------------------ object ops
     async def put_object(self, key: str, data: bytes) -> None:

@@ -1,0 +1,379 @@
+"""Owned HTTP/1.1 client (redpanda_tpu/http) — wire framing tests.
+
+The server side here is a raw asyncio protocol (not an HTTP library), so
+each test controls the exact bytes on the wire: content-length bodies,
+chunked encoding with trailers, keep-alive reuse, connection: close,
+EOF-delimited bodies, and malformed framing. Reference behaviors:
+http/client.h (connect/reuse), http/chunk_encoding.h (chunked framing).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from redpanda_tpu.http import HttpClient, HttpError
+
+
+class RawServer:
+    """Serves canned raw responses; records each request's head+body bytes."""
+
+    def __init__(self) -> None:
+        self.responses: list[bytes] = []
+        self.requests: list[bytes] = []
+        self.connections = 0
+        self._server: asyncio.AbstractServer | None = None
+        self.port = 0
+
+    async def __aenter__(self) -> "RawServer":
+        self._server = await asyncio.start_server(self._on_conn, "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.connections += 1
+        try:
+            while self.responses:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                self.requests.append(req)
+                resp = self.responses.pop(0)
+                writer.write(resp)
+                await writer.drain()
+                if b"connection: close" in resp.lower() or (
+                    b"content-length" not in resp.lower()
+                    and b"transfer-encoding" not in resp.lower()
+                ):
+                    break  # EOF-delimited or explicit close: drop the socket
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    async def _read_request(self, reader: asyncio.StreamReader) -> bytes | None:
+        head = b""
+        while not head.endswith(b"\r\n\r\n"):
+            line = await reader.readline()
+            if not line:
+                return None
+            head += line
+        body = b""
+        lower = head.lower()
+        if b"content-length:" in lower:
+            n = int(
+                [l for l in lower.split(b"\r\n") if l.startswith(b"content-length:")][0]
+                .split(b":")[1]
+            )
+            body = await reader.readexactly(n)
+        elif b"transfer-encoding: chunked" in lower:
+            while True:
+                size = int((await reader.readline()).strip() or b"0", 16)
+                if size == 0:
+                    await reader.readline()
+                    break
+                body += await reader.readexactly(size)
+                await reader.readexactly(2)
+        return head + body
+
+
+def test_content_length_body():
+    async def go():
+        async with RawServer() as srv:
+            srv.responses.append(
+                b"HTTP/1.1 200 OK\r\ncontent-length: 5\r\nx-tag: a\r\nx-tag: b\r\n\r\nhello"
+            )
+            async with HttpClient(f"http://127.0.0.1:{srv.port}") as c:
+                r = await c.request("GET", "/x")
+                assert r.status == 200
+                assert r.body == b"hello"
+                assert r.header("x-tag") == "a, b"  # duplicates comma-joined
+                assert c.probe.responses == 1
+
+    asyncio.run(go())
+
+
+def test_chunked_response_with_trailers():
+    async def go():
+        async with RawServer() as srv:
+            srv.responses.append(
+                b"HTTP/1.1 200 OK\r\ntransfer-encoding: chunked\r\n\r\n"
+                b"4\r\nwiki\r\n5;ext=1\r\npedia\r\n0\r\nx-trailer: t\r\n\r\n"
+            )
+            async with HttpClient(f"http://127.0.0.1:{srv.port}") as c:
+                r = await c.request("GET", "/chunked")
+                assert r.body == b"wikipedia"
+
+    asyncio.run(go())
+
+
+def test_keepalive_reuses_connection():
+    async def go():
+        async with RawServer() as srv:
+            ok = b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok"
+            srv.responses += [ok, ok]
+            async with HttpClient(f"http://127.0.0.1:{srv.port}") as c:
+                await c.request("GET", "/a")
+                await c.request("GET", "/b")
+            assert srv.connections == 1
+
+    asyncio.run(go())
+
+
+def test_connection_close_and_eof_body():
+    async def go():
+        async with RawServer() as srv:
+            # no framing headers: body runs to EOF, connection not reused
+            srv.responses.append(
+                b"HTTP/1.1 200 OK\r\nconnection: close\r\n\r\ntail-bytes"
+            )
+            srv.responses.append(b"HTTP/1.1 200 OK\r\ncontent-length: 0\r\n\r\n")
+            async with HttpClient(f"http://127.0.0.1:{srv.port}") as c:
+                r = await c.request("GET", "/eof")
+                assert r.body == b"tail-bytes"
+                r2 = await c.request("GET", "/next")
+                assert r2.status == 200
+            assert srv.connections == 2
+
+    asyncio.run(go())
+
+
+def test_put_sends_content_length():
+    async def go():
+        async with RawServer() as srv:
+            srv.responses.append(b"HTTP/1.1 201 Created\r\ncontent-length: 0\r\n\r\n")
+            async with HttpClient(f"http://127.0.0.1:{srv.port}") as c:
+                r = await c.request("PUT", "/obj", body=b"payload!")
+                assert r.status == 201
+            head = srv.requests[0]
+            assert b"content-length: 8" in head.lower()
+            assert head.endswith(b"payload!")
+
+    asyncio.run(go())
+
+
+def test_chunked_request_body():
+    async def go():
+        async with RawServer() as srv:
+            srv.responses.append(b"HTTP/1.1 200 OK\r\ncontent-length: 0\r\n\r\n")
+            async with HttpClient(f"http://127.0.0.1:{srv.port}") as c:
+                await c.request("POST", "/up", body=b"streamed", chunked=True)
+            req = srv.requests[0]
+            assert b"transfer-encoding: chunked" in req.lower()
+            assert b"content-length" not in req.lower()
+            # RawServer stores the DECODED chunked body after the head
+            assert req.endswith(b"streamed")
+
+    asyncio.run(go())
+
+
+def test_bad_status_line_raises():
+    async def go():
+        async with RawServer() as srv:
+            srv.responses.append(b"garbage first line\r\n\r\n")
+            async with HttpClient(f"http://127.0.0.1:{srv.port}") as c:
+                with pytest.raises(HttpError):
+                    await c.request("GET", "/bad")
+
+    asyncio.run(go())
+
+
+def test_head_has_no_body():
+    async def go():
+        async with RawServer() as srv:
+            # HEAD advertises a length but carries no body; the next
+            # response on the same connection must still parse cleanly
+            srv.responses.append(b"HTTP/1.1 200 OK\r\ncontent-length: 99\r\n\r\n")
+            srv.responses.append(b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok")
+            async with HttpClient(f"http://127.0.0.1:{srv.port}") as c:
+                r = await c.request("HEAD", "/h")
+                assert r.status == 200 and r.body == b""
+                r2 = await c.request("GET", "/g")
+                assert r2.body == b"ok"
+
+    asyncio.run(go())
+
+
+def test_stale_keepalive_retries_on_fresh_connection():
+    """Server closes idle keep-alive connections between requests; the
+    client's single retry must transparently re-dial (client.h
+    get_connected posture)."""
+    connections = 0
+
+    async def go():
+        nonlocal connections
+
+        async def one_shot(reader, writer):
+            # serve exactly ONE response per connection, then close
+            nonlocal connections
+            connections += 1
+            head = b""
+            while not head.endswith(b"\r\n\r\n"):
+                line = await reader.readline()
+                if not line:
+                    return
+                head += line
+            writer.write(b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok")
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(one_shot, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        async with HttpClient(f"http://127.0.0.1:{port}") as c:
+            assert (await c.request("GET", "/a")).body == b"ok"
+            assert (await c.request("GET", "/b")).body == b"ok"
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(go())
+    assert connections == 2
+
+
+def test_post_not_retried_on_connection_failure():
+    """A POST may have executed server-side even if the connection died
+    before the response — it must surface the error, never resend."""
+    attempts = 0
+
+    async def go():
+        async def reset_then_serve(reader, writer):
+            nonlocal attempts
+            attempts += 1
+            writer.close()  # reset every connection before responding
+
+        server = await asyncio.start_server(reset_then_serve, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        async with HttpClient(f"http://127.0.0.1:{port}") as c:
+            with pytest.raises(HttpError):
+                await c.request("POST", "/side-effect", body=b"x")
+        server.close()
+
+    asyncio.run(go())
+    assert attempts == 1  # GET would retry once; POST must not
+
+
+def test_malformed_response_does_not_poison_pool():
+    """A garbage content-length raises HttpError AND drops the connection;
+    the next request must go out on a fresh socket, not parse leftovers."""
+    async def go():
+        async with RawServer() as srv:
+            srv.responses.append(
+                b"HTTP/1.1 200 OK\r\ncontent-length: banana\r\n\r\nleftover-bytes"
+            )
+            srv.responses.append(b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok")
+            async with HttpClient(f"http://127.0.0.1:{srv.port}") as c:
+                with pytest.raises(HttpError, match="content-length"):
+                    await c.request("GET", "/bad")
+                r = await c.request("GET", "/good")
+                assert r.status == 200 and r.body == b"ok"
+            assert srv.connections == 2
+
+    asyncio.run(go())
+
+
+def test_base_path_prefix():
+    """A base_url with a path (reverse-proxy mount) prefixes every request."""
+    async def go():
+        async with RawServer() as srv:
+            srv.responses.append(b"HTTP/1.1 200 OK\r\ncontent-length: 0\r\n\r\n")
+            async with HttpClient(f"http://127.0.0.1:{srv.port}/admin/") as c:
+                await c.request("GET", "/v1/brokers")
+            assert srv.requests[0].startswith(b"GET /admin/v1/brokers HTTP/1.1")
+
+    asyncio.run(go())
+
+
+def test_pool_runs_requests_concurrently():
+    """Two slow requests must overlap on two connections (pooling), not
+    serialize behind one socket."""
+    async def go():
+        async def slow(reader, writer):
+            head = b""
+            while not head.endswith(b"\r\n\r\n"):
+                head += await reader.readline()
+            await asyncio.sleep(0.3)
+            writer.write(b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok")
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(slow, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        loop = asyncio.get_running_loop()
+        async with HttpClient(f"http://127.0.0.1:{port}") as c:
+            t0 = loop.time()
+            r1, r2 = await asyncio.gather(
+                c.request("GET", "/a"), c.request("GET", "/b")
+            )
+            wall = loop.time() - t0
+        assert r1.body == r2.body == b"ok"
+        assert wall < 0.55, f"requests serialized: {wall:.2f}s"  # 2x0.3 if serial
+        server.close()
+
+    asyncio.run(go())
+
+
+def test_eof_body_spanning_many_segments():
+    """An unframed (read-to-close) body delivered in several writes with
+    pauses must arrive complete — StreamReader.read returns early per wait."""
+    async def go():
+        async def dribble(reader, writer):
+            head = b""
+            while not head.endswith(b"\r\n\r\n"):
+                head += await reader.readline()
+            writer.write(b"HTTP/1.1 200 OK\r\nconnection: close\r\n\r\n")
+            for i in range(5):
+                writer.write(b"%d" % i * 1000)
+                await writer.drain()
+                await asyncio.sleep(0.02)
+            writer.close()
+
+        server = await asyncio.start_server(dribble, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        async with HttpClient(f"http://127.0.0.1:{port}") as c:
+            r = await c.request("GET", "/dribble")
+            assert len(r.body) == 5000, len(r.body)
+        server.close()
+
+    asyncio.run(go())
+
+
+def test_close_during_inflight_request_drops_connection():
+    """close() while a request is in flight must not park the finished
+    connection in the idle pool (fd leak)."""
+    async def go():
+        async with RawServer() as srv:
+            srv.responses.append(b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok")
+            c = HttpClient(f"http://127.0.0.1:{srv.port}")
+            task = asyncio.create_task(c.request("GET", "/slowish"))
+            await asyncio.sleep(0.05)  # request under way
+            await c.close()
+            r = await task
+            assert r.body == b"ok"
+            assert c._idle == []  # finished conn was closed, not pooled
+            with pytest.raises(HttpError, match="closed"):
+                await c.request("GET", "/after-close")
+
+    asyncio.run(go())
+
+
+def test_request_timeout():
+    async def go():
+        async def black_hole(reader, writer):
+            try:
+                await asyncio.sleep(30)
+            finally:
+                writer.close()  # 3.12: Server.wait_closed waits on handlers
+
+        server = await asyncio.start_server(black_hole, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        async with HttpClient(f"http://127.0.0.1:{port}", request_timeout=0.2) as c:
+            with pytest.raises(HttpError, match="timeout"):
+                await c.request("GET", "/slow")
+        server.close()
+
+    asyncio.run(go())

@@ -31,15 +31,15 @@ class AdminHttp:
         self.base = base.rstrip("/")
 
     async def _req(self, method: str, path: str):
-        import aiohttp
+        import json
 
-        async with aiohttp.ClientSession() as s:
-            async with s.request(
-                method, self.base + path, timeout=aiohttp.ClientTimeout(total=10)
-            ) as r:
-                if r.status >= 400:
-                    raise RuntimeError(f"{method} {path} -> {r.status}")
-                return await r.json()
+        from redpanda_tpu.http import HttpClient
+
+        async with HttpClient(self.base, request_timeout=10.0) as c:
+            r = await c.request(method, path)
+            if r.status >= 400:
+                raise RuntimeError(f"{method} {path} -> {r.status}")
+            return json.loads(r.body)
 
     async def brokers(self):
         return await self._req("GET", "/v1/brokers")
