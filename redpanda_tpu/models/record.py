@@ -417,6 +417,15 @@ class RecordBatch:
         return self.header.encode() + self.payload
 
     @staticmethod
+    def peek_size(buf, offset: int = 0) -> int:
+        """Total frame length (size_bytes field, 4 bytes in at offset 4)
+        without decoding — lets readers grow a bounded window to frame
+        boundaries before decode_internal."""
+        if len(buf) - offset < 8:
+            raise CorruptBatchError("truncated batch header")
+        return int(struct.unpack_from("<i", buf, offset + 4)[0])
+
+    @staticmethod
     def decode_internal(buf, offset: int = 0, verify: bool = True) -> tuple["RecordBatch", int]:
         if len(buf) - offset < INTERNAL_HEADER_SIZE:
             raise CorruptBatchError("truncated batch header")

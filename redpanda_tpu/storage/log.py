@@ -72,6 +72,9 @@ class DiskLog:
         # global LRU fronting segment reads (batch_cache.h:99); assigned by
         # the LogManager, None in bare/standalone usage
         self.batch_cache = None
+        # positioned-cursor cache for sequential fetch continuation
+        # (readers_cache.h:36); assigned by the LogManager like batch_cache
+        self.readers_cache = None
 
     def _cache_put(self, batch: RecordBatch) -> None:
         if self.batch_cache is not None:
@@ -80,6 +83,8 @@ class DiskLog:
     def _cache_invalidate(self, **kw) -> None:
         if self.batch_cache is not None:
             self.batch_cache.invalidate(id(self), **kw)
+        if self.readers_cache is not None:
+            self.readers_cache.invalidate(id(self), **kw)
 
     # ------------------------------------------------------------ lifecycle
     @classmethod
@@ -244,14 +249,32 @@ class DiskLog:
                 return cached
             out: list[RecordBatch] = []
             taken = 0
+            # adopt a cached read cursor for the first touched segment: the
+            # scan seeks straight to the frame boundary instead of going
+            # through the sparse index (readers_cache.h continuation)
+            cursor = (
+                self.readers_cache.get(id(self), start)
+                if self.readers_cache is not None
+                else None
+            )
+            end_seg = end_pos = None
             for seg in self.segments:
                 if seg.dirty_offset < start:
                     continue
                 if max_offset is not None and seg.base_offset > max_offset:
                     break
-                batches = seg.read_batches(
-                    start, max_bytes - taken, type_filter=type_filter, max_offset=max_offset
+                start_pos = None
+                if cursor is not None and cursor.segment_base == seg.base_offset:
+                    start_pos = cursor.file_pos
+                cursor = None  # only valid for the first segment touched
+                batches, next_pos = seg.scan(
+                    start,
+                    max_bytes - taken,
+                    type_filter=type_filter,
+                    max_offset=max_offset,
+                    start_pos=start_pos,
                 )
+                end_seg, end_pos = seg, next_pos
                 for b in batches:
                     out.append(b)
                     self._cache_put(b)
@@ -260,6 +283,14 @@ class DiskLog:
                     break
                 if out:
                     start = out[-1].last_offset + 1
+            if self.readers_cache is not None and out and end_seg is not None:
+                from redpanda_tpu.storage.readers_cache import ReadCursor
+
+                self.readers_cache.put(
+                    id(self),
+                    out[-1].last_offset + 1,
+                    ReadCursor(end_seg.base_offset, end_pos),
+                )
             return out
 
     def _read_cached(self, start, max_bytes, max_offset, type_filter):

@@ -18,6 +18,7 @@ from redpanda_tpu.storage.log import DiskLog, LogConfig
 class LogManager:
     def __init__(self, config: LogConfig, *, batch_cache_bytes: int = 64 << 20):
         from redpanda_tpu.storage.batch_cache import BatchCache
+        from redpanda_tpu.storage.readers_cache import ReadersCache
 
         self.config = config
         self._logs: dict[NTP, DiskLog] = {}
@@ -26,12 +27,15 @@ class LogManager:
         # ONE cache across every managed log (batch_cache.h:99 is a global
         # LRU): hot partitions naturally take budget from cold ones
         self.batch_cache = BatchCache(batch_cache_bytes)
+        # positioned read cursors for sequential fetch (readers_cache.h:36)
+        self.readers_cache = ReadersCache()
 
     async def manage(self, ntp: NTP, *, overrides: LogConfig | None = None) -> DiskLog:
         if ntp in self._logs:
             return self._logs[ntp]
         log = await DiskLog.open(ntp, overrides or self.config)
         log.batch_cache = self.batch_cache
+        log.readers_cache = self.readers_cache
         self._logs[ntp] = log
         return log
 

@@ -17,7 +17,12 @@ import os
 import struct
 from dataclasses import dataclass
 
-from redpanda_tpu.models.record import INTERNAL_HEADER_SIZE, RecordBatch, RecordBatchHeader
+from redpanda_tpu.models.record import (
+    INTERNAL_HEADER_SIZE,
+    CorruptBatchError,
+    RecordBatch,
+    RecordBatchHeader,
+)
 
 INDEX_STEP = 32 * 1024
 _INDEX_ENTRY = struct.Struct("<IQq")  # rel_offset u32, file_pos u64, ts i64
@@ -183,46 +188,103 @@ class Segment:
         self.release_appender()
 
     # ------------------------------------------------------------ read
-    def read_from(self, file_pos: int) -> bytes:
+    def read_from(self, file_pos: int, max_len: int | None = None) -> bytes:
         self.flush_buffer()
         if self._file:
             self._file.flush()
         with open(self.data_path, "rb") as f:
             f.seek(file_pos)
-            return f.read()
+            return f.read() if max_len is None else f.read(max_len)
 
-    def read_batches(
+    def scan(
         self,
         start_offset: int,
         max_bytes: int,
         *,
         type_filter=None,
         max_offset: int | None = None,
-    ) -> list[RecordBatch]:
-        """Batches overlapping [start_offset, max_offset], bounded by size."""
-        pos = self.index.lookup(start_offset)
-        blob = self.read_from(pos)
+        start_pos: int | None = None,
+    ) -> tuple[list[RecordBatch], int]:
+        """Batches overlapping [start_offset, max_offset], bounded by size,
+        with cursor support (readers_cache.h continuation).
+
+        `start_pos` is an exact file position of a frame boundary (from a
+        cached read cursor) — when given, the sparse-index lookup and the
+        decode-and-skip scan up to `start_offset` are bypassed. Returns
+        (batches, next_file_pos) where next_file_pos is the byte position
+        just past the last KEPT batch (or the scan start when nothing was
+        kept) — the cursor for the follow-up read at
+        `batches[-1].last_offset + 1`. Frames consumed but filtered out
+        AFTER the last kept batch are deliberately not covered by the
+        cursor, so a continuation under a different type_filter re-scans
+        them instead of silently skipping.
+        """
+        pos = start_pos if start_pos is not None else self.index.lookup(start_offset)
+        # bounded chunked reads off ONE handle instead of slurping the
+        # segment tail: a sequential consumer with a cursor reads only
+        # ~max_bytes per call. The window is a bytearray trimmed as frames
+        # are consumed, so a long filtered scan stays at ~chunk bytes
+        # resident instead of accumulating the whole span.
+        chunk = max(min(max_bytes * 2, 8 << 20), 1 << 16)
+        self.flush_buffer()
+        if self._file:
+            self._file.flush()
         out: list[RecordBatch] = []
         taken = 0
-        at = 0
-        while at + INTERNAL_HEADER_SIZE <= len(blob):
-            batch, consumed = RecordBatch.decode_internal(blob, at)
-            at += consumed
-            if batch.last_offset < start_offset:
-                continue
-            if max_offset is not None and batch.base_offset > max_offset:
-                break
-            if type_filter is not None and batch.header.type not in type_filter:
-                continue
-            # Runtime term context comes from the segment (the packed header
-            # carries no term; the reference derives it the same way, from
-            # the raft configuration tracking / segment naming).
-            batch.header.term = self.term
-            out.append(batch)
-            taken += batch.size_bytes
-            if taken >= max_bytes:
-                break
-        return out
+        base = pos  # file offset of blob[0]
+        at = 0  # decode position within blob
+        kept_end = pos  # file offset just past the last KEPT batch
+        with open(self.data_path, "rb") as f:
+            f.seek(pos)
+            blob = bytearray(f.read(chunk))
+            while True:
+                if at >= chunk:
+                    del blob[:at]
+                    base += at
+                    at = 0
+                # grow the window when the next frame runs past the buffer
+                if at + INTERNAL_HEADER_SIZE > len(blob):
+                    more = f.read(chunk)
+                    if not more:
+                        if at < len(blob):
+                            # a complete frame can't be cut mid-header at
+                            # EOF legitimately (appends are whole-frame and
+                            # recovery truncates torn tails at open)
+                            raise CorruptBatchError(
+                                f"partial batch header at EOF ({self.data_path}"
+                                f" pos {base + at})"
+                            )
+                        break
+                    blob += more
+                    continue
+                batch_size = RecordBatch.peek_size(blob, at)
+                if at + batch_size > len(blob):
+                    more = f.read(chunk)
+                    if not more:
+                        raise CorruptBatchError(
+                            f"batch frame overruns EOF ({self.data_path} pos "
+                            f"{base + at}, size_bytes={batch_size})"
+                        )
+                    blob += more
+                    continue
+                batch, consumed = RecordBatch.decode_internal(blob, at)
+                if max_offset is not None and batch.base_offset > max_offset:
+                    break  # NOT consumed: cursor stays before this frame
+                at += consumed
+                if batch.last_offset < start_offset:
+                    continue
+                if type_filter is not None and batch.header.type not in type_filter:
+                    continue
+                # Runtime term context comes from the segment (the packed
+                # header carries no term; the reference derives it the same
+                # way, from the raft configuration tracking / segment naming)
+                batch.header.term = self.term
+                out.append(batch)
+                kept_end = base + at
+                taken += batch.size_bytes
+                if taken >= max_bytes:
+                    break
+        return out, kept_end
 
     def first_offset_with_ts(self, ts: int) -> int | None:
         """First batch offset whose max_timestamp >= ts (index-accelerated)."""

@@ -1,0 +1,192 @@
+"""Positioned-reader cache (storage/readers_cache.py; reference
+storage/readers_cache.h:36): sequential fetch continuation adopts the
+cached cursor instead of re-seeking through the sparse index, cursors at
+the log tail survive appends (steady-state consumers), and truncation /
+compaction / prefix-truncation drop cursors whose positions went stale.
+
+Integration tests run with batch_cache_bytes=0 so reads always reach the
+segment scan — the cursor path is what's under test, and every cursor-hit
+read is asserted byte-identical to a cold scan of a fresh manager.
+"""
+
+import asyncio
+
+import pytest
+
+from redpanda_tpu.models import NTP, Record, RecordBatch
+from redpanda_tpu.models.record import RecordBatchType
+from redpanda_tpu.storage.log import LogConfig
+from redpanda_tpu.storage.log_manager import LogManager
+from redpanda_tpu.storage.readers_cache import ReadCursor, ReadersCache
+
+
+def _batch(base: int, n: int = 4, pad: int = 64, type=RecordBatchType.raft_data):
+    recs = [
+        Record(offset_delta=i, value=b"v%05d" % (base + i) + b"x" * pad)
+        for i in range(n)
+    ]
+    return RecordBatch.build(recs, base_offset=base, type=type)
+
+
+class TestUnit:
+    def test_lru_and_stats(self):
+        c = ReadersCache(max_entries=2)
+        c.put(1, 10, ReadCursor(0, 100))
+        c.put(1, 20, ReadCursor(0, 200))
+        assert c.get(1, 10) == ReadCursor(0, 100)  # refreshes 10
+        c.put(1, 30, ReadCursor(0, 300))  # evicts 20 (LRU)
+        assert c.get(1, 20) is None
+        assert c.get(1, 10) is not None and c.get(1, 30) is not None
+        assert c.stats()["entries"] == 2
+
+    def test_invalidate_ranges(self):
+        c = ReadersCache()
+        for off in (5, 10, 15):
+            c.put(1, off, ReadCursor(0, off * 10))
+            c.put(2, off, ReadCursor(0, off * 10))
+        c.invalidate(1, from_offset=10)  # drops 10 and 15 of log 1
+        assert c.get(1, 5) and not c.get(1, 10) and not c.get(1, 15)
+        c.invalidate(2, below_offset=10)  # drops 5 of log 2
+        assert not c.get(2, 5) and c.get(2, 10)
+        c.invalidate(2)
+        assert not c.get(2, 10) and not c.get(2, 15)
+
+
+class TestLogIntegration:
+    @pytest.fixture()
+    def mgr(self, tmp_path):
+        # zero batch cache: force every read through the segment scan
+        return LogManager(LogConfig(base_dir=str(tmp_path)), batch_cache_bytes=0)
+
+    def _cold_read(self, base_dir, ntp, start, max_bytes=1 << 20):
+        async def body():
+            m = LogManager(LogConfig(base_dir=base_dir), batch_cache_bytes=0)
+            log = await m.manage(ntp)
+            got = await log.read(start, max_bytes)
+            await m.stop()
+            return [b.encode_internal() for b in got]
+
+        return asyncio.run(body())
+
+    def test_sequential_reads_hit_cursor(self, mgr):
+        async def body():
+            ntp = NTP.kafka("seq", 0)
+            log = await mgr.manage(ntp)
+            for base in range(0, 40, 4):
+                await log.append([_batch(base)], assign_offsets=False)
+            one = _batch(0).size_bytes
+            rc = mgr.readers_cache
+            chunks = []
+            start = 0
+            while True:
+                got = await log.read(start, one * 2)  # two batches per read
+                if not got:
+                    break
+                chunks += got
+                start = got[-1].last_offset + 1
+            # every continuation after the first adopted the stored cursor
+            assert rc.hits >= 4, rc.stats()
+            assert [b.header.base_offset for b in chunks] == list(range(0, 40, 4))
+            return [b.encode_internal() for b in chunks]
+
+        served = asyncio.run(body())
+        assert served == self._cold_read(mgr.config.base_dir, NTP.kafka("seq", 0), 0)
+
+    def test_tail_cursor_survives_append(self, mgr):
+        async def body():
+            ntp = NTP.kafka("tail", 0)
+            log = await mgr.manage(ntp)
+            await log.append([_batch(0)], assign_offsets=False)
+            await log.read(0, 1 << 20)  # stores tail cursor at offset 4
+            await log.append([_batch(4)], assign_offsets=False)
+            rc = mgr.readers_cache
+            h0 = rc.hits
+            got = await log.read(4, 1 << 20)
+            assert rc.hits == h0 + 1, "tail cursor not adopted after append"
+            assert [b.header.base_offset for b in got] == [4]
+            return [b.encode_internal() for b in got]
+
+        served = asyncio.run(body())
+        assert served == self._cold_read(mgr.config.base_dir, NTP.kafka("tail", 0), 4)
+
+    def test_truncate_drops_cursor(self, mgr):
+        async def body():
+            ntp = NTP.kafka("trunc", 0)
+            log = await mgr.manage(ntp)
+            for base in (0, 4, 8):
+                await log.append([_batch(base)], assign_offsets=False)
+            await log.read(0, 1 << 20)  # cursor at offset 12, tail file pos
+            await log.truncate(4)  # rewrites the tail: positions went stale
+            # re-append different content at the same offsets
+            await log.append([_batch(4, n=4, pad=8)], assign_offsets=False)
+            got = await log.read(4, 1 << 20)
+            assert [b.header.base_offset for b in got] == [4]
+            assert got[0].payload == _batch(4, n=4, pad=8).payload
+            # the pre-truncate cursor (offset 12) must be gone
+            assert mgr.readers_cache.get(id(log), 12) is None
+
+        asyncio.run(body())
+
+    def test_compaction_drops_cursor(self, mgr, tmp_path):
+        async def body():
+            cfg = LogConfig(
+                base_dir=str(tmp_path), cleanup_policy="compact",
+                max_segment_size=1024,
+            )
+            log = await mgr.manage(NTP.kafka("comp", 0), overrides=cfg)
+            def kb(base, key):
+                recs = [Record(offset_delta=0, key=key, value=b"v%d" % base)]
+                return RecordBatch.build(recs, base_offset=base)
+            for base in range(0, 12):
+                await log.append([kb(base, b"k%d" % (base % 2))], assign_offsets=False)
+            await log.read(0, 1 << 20)
+            assert any(k[0] == id(log) for k in mgr.readers_cache._lru)
+            await log.compact()
+            # in-place rewrite: every cursor for this log must be gone
+            assert not any(k[0] == id(log) for k in mgr.readers_cache._lru)
+            got = await log.read(0, 1 << 20)
+            # latest value per key survives
+            vals = {r.key: r.value for b in got for r in b.records()}
+            assert vals[b"k0"] in (b"v10",) and vals[b"k1"] in (b"v11",)
+
+        asyncio.run(body())
+
+    def test_corrupt_frame_size_raises_not_short_read(self, mgr):
+        """A frame whose size field overruns EOF is corruption and must
+        raise (the pre-scan read path surfaced it via decode_internal) —
+        never a silent short read that strands consumers."""
+        async def body():
+            from redpanda_tpu.models.record import CorruptBatchError
+
+            ntp = NTP.kafka("corrupt", 0)
+            log = await mgr.manage(ntp)
+            await log.append([_batch(0), _batch(4)], assign_offsets=False)
+            await log.flush()
+            seg = log.segments[-1]
+            one = _batch(0).size_bytes
+            # corrupt the SECOND frame's size_bytes to a huge value
+            with open(seg.data_path, "r+b") as f:
+                f.seek(one + 4)
+                f.write((0x40000000).to_bytes(4, "little"))
+            with pytest.raises(CorruptBatchError):
+                await log.read(0, 1 << 20)
+
+        asyncio.run(body())
+
+    def test_trailing_filtered_frames_not_skipped_by_cursor(self, mgr):
+        async def body():
+            ntp = NTP.kafka("filt", 0)
+            log = await mgr.manage(ntp)
+            await log.append([_batch(0)], assign_offsets=False)
+            cfgb = _batch(4, type=RecordBatchType.raft_configuration)
+            await log.append([cfgb], assign_offsets=False)
+            # filtered read consumes past the config batch but must anchor
+            # its cursor BEFORE it, not after
+            got = await log.read(0, 1 << 20, type_filter={RecordBatchType.raft_data})
+            assert [b.header.base_offset for b in got] == [0]
+            # unfiltered continuation at the cursor offset sees the config batch
+            got2 = await log.read(4, 1 << 20)
+            assert [b.header.base_offset for b in got2] == [4]
+            assert got2[0].header.type == RecordBatchType.raft_configuration
+
+        asyncio.run(body())
