@@ -90,6 +90,11 @@ class Application:
         from redpanda_tpu.syschecks import check_environment
 
         check_environment(c)
+        # rpk iotune's characterization, when present (io-config.json in the
+        # data dir): published below as metrics for operators/dashboards
+        from redpanda_tpu.config.io_config import load_io_config
+
+        self.io_config = load_io_config(c.data_directory)
         self.rpc_tls = self._tls_for("rpc_server")
         self.storage = await StorageApi(c.data_directory).start()
         self._stop_order.append(self.storage)
@@ -336,6 +341,23 @@ class Application:
         registry.gauge(
             "batch_cache_bytes", lambda: bc.bytes_used, "Batch cache bytes"
         )
+        rc = self.storage.log_mgr.readers_cache
+        registry.gauge("readers_cache_hits", lambda: rc.hits, "Read cursor hits")
+        registry.gauge(
+            "readers_cache_misses", lambda: rc.misses, "Read cursor misses"
+        )
+        if self.io_config:
+            io = self.io_config
+            registry.gauge(
+                "iotune_seq_write_mb_s",
+                lambda: io["seq_write_mb_s"],
+                "iotune: sequential write MB/s",
+            )
+            registry.gauge(
+                "iotune_fsync_p99_ms",
+                lambda: io["fsync_4k"]["p99_ms"],
+                "iotune: 4k fsync p99 latency",
+            )
 
     # ------------------------------------------------------------ shutdown
     async def stop(self) -> None:

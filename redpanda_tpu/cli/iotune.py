@@ -1,0 +1,104 @@
+"""rpk iotune: storage characterization for the data directory.
+
+Parity with the reference's `rpk iotune` (src/go/rpk pkg/cli/cmd/iotune.go),
+which benchmarks the data disk and writes an io-properties file consumed by
+the IO scheduler at startup. Here the probe measures what this runtime
+actually depends on — sequential append bandwidth, fsync latency (the
+produce-path acks=-1 cost), and cold sequential read bandwidth — and writes
+`io-config.json` into the data dir. `redpanda start` picks the file up and
+publishes the numbers through config/metrics so operators and the admin API
+see what the disk was measured at.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import time
+
+from redpanda_tpu.config.io_config import (  # noqa: F401  (re-exported)
+    IO_CONFIG_NAME,
+    load_io_config,
+    write_io_config,
+)
+
+
+def _measure_seq_write(path: str, total_bytes: int, block: int) -> float:
+    """MB/s for buffered sequential writes + one final fsync."""
+    buf = os.urandom(block)
+    t0 = time.perf_counter()
+    with open(path, "wb") as f:
+        written = 0
+        while written < total_bytes:
+            f.write(buf)
+            written += block
+        f.flush()
+        os.fsync(f.fileno())
+    dt = time.perf_counter() - t0
+    return (written / dt) / 1e6
+
+
+def _measure_fsync(path: str, iters: int, block: int) -> dict[str, float]:
+    """Latency of small append+fsync cycles (the quorum-ack disk cost)."""
+    lat_ms: list[float] = []
+    buf = os.urandom(block)
+    with open(path, "ab") as f:
+        for _ in range(iters):
+            f.write(buf)
+            f.flush()
+            t0 = time.perf_counter()
+            os.fsync(f.fileno())
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+    lat_ms.sort()
+    return {
+        "p50_ms": round(statistics.median(lat_ms), 4),
+        "p99_ms": round(lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))], 4),
+        "max_ms": round(lat_ms[-1], 4),
+    }
+
+
+def _measure_seq_read(path: str, block: int) -> float:
+    """MB/s sequential read of the file just written (page-cache-warm on
+    most hosts; still bounds the fetch path's best case)."""
+    t0 = time.perf_counter()
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(block)
+            if not chunk:
+                break
+            n += len(chunk)
+    dt = time.perf_counter() - t0
+    return (n / dt) / 1e6 if dt > 0 else float("inf")
+
+
+def measure(
+    data_dir: str,
+    *,
+    file_bytes: int = 64 << 20,
+    block: int = 1 << 20,
+    fsync_iters: int = 50,
+) -> dict:
+    """Run the full characterization inside `data_dir`."""
+    os.makedirs(data_dir, exist_ok=True)
+    probe_path = os.path.join(data_dir, ".iotune.probe")
+    try:
+        seq_write = _measure_seq_write(probe_path, file_bytes, block)
+        fsync = _measure_fsync(probe_path, fsync_iters, 4096)
+        seq_read = _measure_seq_read(probe_path, block)
+    finally:
+        try:
+            os.unlink(probe_path)
+        except OSError:
+            pass
+    return {
+        "version": 1,
+        "data_dir": os.path.abspath(data_dir),
+        "measured_at": int(time.time()),
+        "seq_write_mb_s": round(seq_write, 1),
+        "seq_read_mb_s": round(seq_read, 1),
+        "fsync_4k": fsync,
+        "probe_bytes": file_bytes,
+    }
+
+

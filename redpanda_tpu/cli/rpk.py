@@ -40,15 +40,25 @@ async def _client(args):
 
 
 async def _admin_request(args, method: str, path: str, body=None):
-    import aiohttp
+    import json as _json
+    import urllib.parse
 
-    async with aiohttp.ClientSession() as s:
-        url = f"http://{args.admin_api}{path}"
-        async with s.request(method, url, json=body) as resp:
-            try:
-                return resp.status, await resp.json()
-            except Exception:
-                return resp.status, await resp.text()
+    from redpanda_tpu.http import HttpClient
+
+    # user-supplied segments (names etc.) must be percent-encoded for the
+    # request line; structural separators stay intact
+    path = urllib.parse.quote(path, safe="/?&=")
+    async with HttpClient(f"http://{args.admin_api}") as c:
+        headers = {}
+        payload = b""
+        if body is not None:
+            payload = _json.dumps(body).encode()
+            headers["content-type"] = "application/json"
+        resp = await c.request(method, path, headers=headers, body=payload)
+        try:
+            return resp.status, _json.loads(resp.body)
+        except Exception:
+            return resp.status, resp.body.decode("utf-8", "replace")
 
 
 # ================================================================ redpanda start
@@ -363,6 +373,27 @@ def cmd_tune(args) -> int:
     return 0
 
 
+def cmd_iotune(args) -> int:
+    """Benchmark the data dir and persist io-config.json (the reference's
+    `rpk iotune` io-properties flow); `start` publishes the numbers."""
+    from redpanda_tpu.cli.iotune import measure, write_io_config
+
+    data_dir = args.directory
+    print(f"iotune: characterizing {data_dir} ...")
+    result = measure(
+        data_dir,
+        file_bytes=args.probe_mb << 20,
+        fsync_iters=args.fsync_iters,
+    )
+    path = write_io_config(data_dir, result)
+    print(f"  seq write : {result['seq_write_mb_s']:.1f} MB/s")
+    print(f"  seq read  : {result['seq_read_mb_s']:.1f} MB/s")
+    f = result["fsync_4k"]
+    print(f"  fsync 4k  : p50 {f['p50_ms']} ms, p99 {f['p99_ms']} ms")
+    print(f"written {path}")
+    return 0
+
+
 # ================================================================ arg parsing
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="rpk", description=__doc__)
@@ -461,7 +492,12 @@ def build_parser() -> argparse.ArgumentParser:
     gk.add_argument("--storage", default="20Gi")
 
     sub.add_parser("tune", help="report platform tuners")
-    sub.add_parser("iotune", help="report io characterization")
+    iop = sub.add_parser("iotune", help="benchmark the data dir, write io-config.json")
+    # default must match the broker's data_directory default so a stock
+    # `rpk iotune` + `redpanda start` pair actually connects
+    iop.add_argument("--directory", default="/var/lib/redpanda_tpu")
+    iop.add_argument("--probe-mb", type=int, default=64, help="probe file size")
+    iop.add_argument("--fsync-iters", type=int, default=50)
 
     cnp = sub.add_parser("container", help="local multi-broker dev cluster")
     cnsub = cnp.add_subparsers(dest="container_cmd")
@@ -534,8 +570,10 @@ def main(argv: list[str] | None = None) -> int:
         return asyncio.run(cmd_debug(args))
     if args.cmd == "generate":
         return cmd_generate(args)
-    if args.cmd in ("tune", "iotune"):
+    if args.cmd == "tune":
         return cmd_tune(args)
+    if args.cmd == "iotune":
+        return cmd_iotune(args)
     return asyncio.run(table[args.cmd](args))
 
 

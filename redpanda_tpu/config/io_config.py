@@ -1,0 +1,32 @@
+"""io-config.json: the on-disk contract between `rpk iotune` (writer) and
+the broker (reader) — the analogue of the reference's io-properties file
+that `rpk iotune` produces and the IO scheduler consumes at startup.
+
+Lives under config/ (not cli/) because both the operator tool and the
+data-plane Application depend on the format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+IO_CONFIG_NAME = "io-config.json"
+
+
+def write_io_config(data_dir: str, result: dict) -> str:
+    path = os.path.join(data_dir, IO_CONFIG_NAME)
+    with open(path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def load_io_config(data_dir: str) -> dict | None:
+    """Startup hook: the broker publishes these numbers when present."""
+    try:
+        with open(os.path.join(data_dir, IO_CONFIG_NAME)) as f:
+            loaded = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return loaded if isinstance(loaded, dict) and loaded.get("version") == 1 else None

@@ -162,3 +162,56 @@ def test_cli_wasm_and_generate(live_broker, tmp_path):
     assert json.loads(r.stdout)["scrape_configs"][0]["metrics_path"] == "/metrics"
     r = _rpk("tune")
     assert "platform-managed" in r.stdout
+
+
+def test_iotune_measures_and_broker_publishes(tmp_path):
+    """rpk iotune writes io-config.json; a broker started on that data dir
+    publishes the measured numbers at /metrics (iotune.go io-properties
+    flow, re-read at startup)."""
+    data_dir = tmp_path / "data"
+    r = _rpk("iotune", "--directory", str(data_dir), "--probe-mb", "4",
+             "--fsync-iters", "5", timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "seq write" in r.stdout and "written" in r.stdout
+    cfg = json.loads((data_dir / "io-config.json").read_text())
+    assert cfg["version"] == 1
+    assert cfg["seq_write_mb_s"] > 0 and cfg["seq_read_mb_s"] > 0
+    assert cfg["fsync_4k"]["p99_ms"] >= cfg["fsync_4k"]["p50_ms"] >= 0
+    assert not (data_dir / ".iotune.probe").exists()  # probe cleaned up
+
+    kafka_port, admin_port = _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "redpanda_tpu", "start",
+            "--set", f"data_directory={data_dir}",
+            "--set", f"kafka_api_port={kafka_port}",
+            "--set", f"advertised_kafka_api_port={kafka_port}",
+            "--set", f"admin_api_port={admin_port}",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=REPO,
+    )
+    try:
+        import urllib.request
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{admin_port}/metrics", timeout=1
+                ) as resp:
+                    metrics = resp.read().decode()
+                if "iotune_seq_write_mb_s" in metrics:
+                    break
+            except Exception:
+                if proc.poll() is not None:
+                    raise RuntimeError(f"broker died:\n{proc.stdout.read()}")
+                time.sleep(0.2)
+        else:
+            raise AssertionError("iotune metrics never appeared")
+        assert "iotune_fsync_p99_ms" in metrics
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
