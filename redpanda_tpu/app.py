@@ -341,6 +341,17 @@ class Application:
         registry.gauge(
             "batch_cache_bytes", lambda: bc.bytes_used, "Batch cache bytes"
         )
+        lm = self.storage.log_mgr
+        registry.gauge(
+            "compaction_backlog_bytes",
+            lambda: lm.compaction_backlog(),
+            "Closed un-compacted bytes (backlog controller input)",
+        )
+        registry.gauge(
+            "compaction_interval_s",
+            lambda: lm.backlog_controller.last_interval,
+            "Backlog-controlled compaction pass interval",
+        )
         rc = self.storage.log_mgr.readers_cache
         registry.gauge("readers_cache_hits", lambda: rc.hits, "Read cursor hits")
         registry.gauge(

@@ -380,12 +380,18 @@ def cmd_iotune(args) -> int:
 
     data_dir = args.directory
     print(f"iotune: characterizing {data_dir} ...")
-    result = measure(
-        data_dir,
-        file_bytes=args.probe_mb << 20,
-        fsync_iters=args.fsync_iters,
-    )
-    path = write_io_config(data_dir, result)
+    try:
+        result = measure(
+            data_dir,
+            file_bytes=args.probe_mb << 20,
+            fsync_iters=args.fsync_iters,
+        )
+        path = write_io_config(data_dir, result)
+    except OSError as e:
+        # permission denied / disk full mid-probe: clean refusal, not a
+        # traceback (the default directory needs broker-level privileges)
+        print(f"iotune: cannot characterize {data_dir}: {e}", file=sys.stderr)
+        return 1
     print(f"  seq write : {result['seq_write_mb_s']:.1f} MB/s")
     print(f"  seq read  : {result['seq_read_mb_s']:.1f} MB/s")
     f = result["fsync_4k"]

@@ -20,9 +20,12 @@ from redpanda_tpu.kafka.protocol.errors import ErrorCode, KafkaError
 from redpanda_tpu.kafka.protocol.messages import (
     API_VERSIONS,
     APIS,
+    FETCH,
+    PRODUCE,
     SASL_AUTHENTICATE,
     SASL_HANDSHAKE,
 )
+from redpanda_tpu.metrics import registry as _metrics
 from redpanda_tpu.kafka.protocol.primitives import Reader
 from redpanda_tpu.kafka.protocol.schema import (
     RequestHeader,
@@ -35,6 +38,16 @@ logger = logging.getLogger("rptpu.kafka")
 
 MAX_REQUEST_SIZE = 100 * 1024 * 1024
 MAX_PIPELINE = 64  # max in-flight requests per connection
+
+# HDR latency probes for the two hot APIs (kafka/latency_probe.h:33-43:
+# the reference histograms produce and fetch specifically), exported at
+# /metrics with cumulative buckets + sum/count for quantile queries
+_produce_latency = _metrics.histogram(
+    "kafka_produce_latency_us", "Produce handler latency (microseconds)"
+)
+_fetch_latency = _metrics.histogram(
+    "kafka_fetch_latency_us", "Fetch handler latency (microseconds)"
+)
 
 
 class RequestContext:
@@ -190,6 +203,7 @@ class Connection:
             )
             self.writer.close()
             return None
+        t0 = asyncio.get_running_loop().time()
         try:
             response = await handler(ctx)
         except KafkaError as e:
@@ -198,6 +212,14 @@ class Connection:
             logger.exception("handler %s failed", api.name)
             response = self.server.error_response(
                 api, header.api_version, ctx, ErrorCode.unknown_server_error
+            )
+        if header.api_key == PRODUCE:
+            _produce_latency.record(
+                int((asyncio.get_running_loop().time() - t0) * 1e6)
+            )
+        elif header.api_key == FETCH:
+            _fetch_latency.record(
+                int((asyncio.get_running_loop().time() - t0) * 1e6)
             )
         return self._encode_response(header, api, response)
 

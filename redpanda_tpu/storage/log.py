@@ -378,6 +378,19 @@ class DiskLog:
     def is_compacted(self) -> bool:
         return "compact" in self.config.cleanup_policy
 
+    def compaction_backlog(self) -> int:
+        """Closed-segment bytes accumulated SINCE the last compaction pass —
+        the controller's process variable (backlog_controller.h). Measured
+        against the post-compaction closed-bytes baseline so steady trickle
+        appends into the active segment read as zero backlog (total closed
+        bytes would keep the controller pinned at max pressure forever)."""
+        if not self.is_compacted:
+            return 0
+        if getattr(self, "_compacted_through", None) == self.offsets().dirty_offset:
+            return 0
+        closed = sum(s.size_bytes for s in self.segments if not s.writable)
+        return max(0, closed - getattr(self, "_compacted_closed_bytes", 0))
+
     async def compact(self) -> tuple[int, int]:
         """Self-compact all closed segments (storage/compaction.py); no-op
         until new data has arrived since the previous pass."""
@@ -395,6 +408,11 @@ class DiskLog:
         # dropped keys would resurrect them on a cache-served fetch
         self._cache_invalidate()
         self._compacted_through = offs.dirty_offset
+        # baseline for the backlog measure: closed bytes as they stand
+        # post-rewrite, so only NEW closed data counts as backlog
+        self._compacted_closed_bytes = sum(
+            s.size_bytes for s in self.segments if not s.writable
+        )
         return result
 
     # ------------------------------------------------------------ retention

@@ -29,6 +29,8 @@ class LogManager:
         self.batch_cache = BatchCache(batch_cache_bytes)
         # positioned read cursors for sequential fetch (readers_cache.h:36)
         self.readers_cache = ReadersCache()
+        # set by start_housekeeping; pacing state exported as metrics
+        self.backlog_controller = None
 
     async def manage(self, ntp: NTP, *, overrides: LogConfig | None = None) -> DiskLog:
         if ntp in self._logs:
@@ -55,13 +57,25 @@ class LogManager:
         if log:
             await log.remove()
 
+    def compaction_backlog(self) -> int:
+        """Total compaction backlog across managed logs (controller PV)."""
+        return sum(log.compaction_backlog() for log in self._logs.values())
+
     async def start_housekeeping(
         self, interval_s: float = 10.0, compaction_interval_s: float | None = None
     ):
-        """Retention + compaction fibers (log_manager housekeeping; the
-        compaction cadence mirrors log_compaction_interval_ms)."""
-        compaction_interval_s = (
+        """Retention + compaction fibers (log_manager housekeeping). The
+        compaction cadence is backlog-driven: `compaction_interval_s` (or
+        `interval_s`) is the controller's lazy ceiling, and the pass rate
+        rises as closed un-compacted bytes pile past the setpoint
+        (compaction_controller/backlog_controller.h posture)."""
+        from redpanda_tpu.storage.backlog_controller import BacklogController
+
+        ceiling = (
             compaction_interval_s if compaction_interval_s is not None else interval_s
+        )
+        self.backlog_controller = BacklogController(
+            max_interval_s=ceiling, min_interval_s=min(0.5, ceiling)
         )
 
         async def housekeep_once(log) -> None:
@@ -80,8 +94,15 @@ class LogManager:
 
         async def compaction_loop():
             while True:
-                await asyncio.sleep(compaction_interval_s)
-                for log in list(self._logs.values()):
+                # one backlog sample drives both the interval and the order
+                backlogs = {
+                    log: log.compaction_backlog() for log in self._logs.values()
+                }
+                await asyncio.sleep(
+                    self.backlog_controller.update(sum(backlogs.values()))
+                )
+                # biggest backlog first, so pressure relieves fastest
+                for log in sorted(backlogs, key=backlogs.get, reverse=True):
                     if not log.is_compacted:
                         continue
                     try:

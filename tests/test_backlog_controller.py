@@ -1,0 +1,121 @@
+"""Backlog-driven compaction pacing (storage/backlog_controller.py;
+reference storage/backlog_controller.h + compaction_controller wired in
+application.cc:445-489): compaction cadence responds to the measured
+backlog instead of running on a fixed timer.
+"""
+
+import asyncio
+
+import pytest
+
+from redpanda_tpu.models import NTP, Record, RecordBatch
+from redpanda_tpu.storage.backlog_controller import BacklogController
+from redpanda_tpu.storage.log import LogConfig
+from redpanda_tpu.storage.log_manager import LogManager
+
+
+class TestController:
+    def test_idle_below_setpoint_runs_lazy(self):
+        c = BacklogController(setpoint_bytes=1000, min_interval_s=0.1, max_interval_s=10)
+        assert c.update(0) == 10
+        assert c.update(1000) == 10  # at setpoint: still lazy
+
+    def test_interval_shrinks_monotonically_with_backlog(self):
+        c = BacklogController(setpoint_bytes=1000, min_interval_s=0.1, max_interval_s=10)
+        intervals = [c.update(b) for b in (2000, 5000, 20000, 10**9)]
+        assert intervals == sorted(intervals, reverse=True)
+        assert intervals[0] < 10
+        assert intervals[-1] == pytest.approx(0.1)  # clamped at the floor
+
+    def test_pressure_relaxes_when_backlog_drains(self):
+        c = BacklogController(setpoint_bytes=1000, min_interval_s=0.1, max_interval_s=10)
+        under_pressure = c.update(50_000)
+        assert under_pressure < 1
+        assert c.update(0) == 10
+
+
+def _kb(base: int, key: bytes, pad: int = 256) -> RecordBatch:
+    recs = [Record(offset_delta=0, key=key, value=b"v%06d" % base + b"x" * pad)]
+    return RecordBatch.build(recs, base_offset=base)
+
+
+class TestIntegration:
+    def test_backlog_measured_and_compaction_drains_it(self, tmp_path):
+        async def body():
+            mgr = LogManager(LogConfig(base_dir=str(tmp_path)))
+            cfg = LogConfig(
+                base_dir=str(tmp_path), cleanup_policy="compact",
+                max_segment_size=2048,
+            )
+            log = await mgr.manage(NTP.kafka("bl", 0), overrides=cfg)
+            assert mgr.compaction_backlog() == 0
+            for i in range(64):  # rolls several segments at 2 KiB
+                await log.append([_kb(i, b"k%d" % (i % 4))], assign_offsets=False)
+            backlog = mgr.compaction_backlog()
+            assert backlog > 0, "closed segments should count as backlog"
+            await log.compact()
+            assert mgr.compaction_backlog() == 0, "compaction must drain backlog"
+            await mgr.stop()
+
+        asyncio.run(body())
+
+    def test_trickle_appends_do_not_refill_backlog(self, tmp_path):
+        """After a pass, appends into the ACTIVE segment must read as zero
+        backlog — total-closed-bytes would pin the controller at max
+        pressure and re-rewrite the whole log every interval forever."""
+        async def body():
+            mgr = LogManager(LogConfig(base_dir=str(tmp_path)))
+            cfg = LogConfig(
+                base_dir=str(tmp_path), cleanup_policy="compact",
+                max_segment_size=2048,
+            )
+            log = await mgr.manage(NTP.kafka("trickle", 0), overrides=cfg)
+            for i in range(64):
+                await log.append([_kb(i, b"k%d" % (i % 4))], assign_offsets=False)
+            await log.compact()
+            assert mgr.compaction_backlog() == 0
+            # trickle: one small append, stays in the active segment
+            await log.append([_kb(64, b"k0")], assign_offsets=False)
+            assert mgr.compaction_backlog() == 0
+            # rolling new CLOSED segments counts as fresh backlog again
+            for i in range(65, 90):
+                await log.append([_kb(i, b"k%d" % (i % 4))], assign_offsets=False)
+            fresh = mgr.compaction_backlog()
+            closed_total = sum(
+                s.size_bytes for s in log.segments if not s.writable
+            )
+            assert 0 < fresh < closed_total, (fresh, closed_total)
+            await mgr.stop()
+
+        asyncio.run(body())
+
+    def test_housekeeping_loop_compacts_under_pressure(self, tmp_path):
+        async def body():
+            mgr = LogManager(LogConfig(base_dir=str(tmp_path)))
+            cfg = LogConfig(
+                base_dir=str(tmp_path), cleanup_policy="compact",
+                max_segment_size=2048,
+            )
+            log = await mgr.manage(NTP.kafka("hk", 0), overrides=cfg)
+            for i in range(64):
+                await log.append([_kb(i, b"k%d" % (i % 4))], assign_offsets=False)
+            # the housekeeping cadence is configured glacial (3600s); only
+            # backlog pressure can drive a pass within the test window.
+            # start_housekeeping creates the tasks but they first run at the
+            # next await, so these overrides land before the first update()
+            await mgr.start_housekeeping(interval_s=3600, compaction_interval_s=3600)
+            mgr.backlog_controller.setpoint_bytes = 1024
+            mgr.backlog_controller.max_interval_s = 5.0
+            mgr.backlog_controller.min_interval_s = 0.05
+            deadline = asyncio.get_event_loop().time() + 15
+            while mgr.compaction_backlog() > 0:
+                assert asyncio.get_event_loop().time() < deadline, (
+                    "controller never drove a compaction pass"
+                )
+                await asyncio.sleep(0.1)
+            # the drain itself is the proof: a fixed 3600s cadence could
+            # not have compacted inside the window. (last_interval may
+            # already reflect the post-drain relaxed update.)
+            await mgr.stop()
+
+        asyncio.run(body())

@@ -215,3 +215,10 @@ def test_iotune_measures_and_broker_publishes(tmp_path):
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_iotune_unwritable_directory_fails_cleanly():
+    r = _rpk("iotune", "--directory", "/proc/definitely-not-writable")
+    assert r.returncode == 1
+    assert "cannot characterize" in r.stderr
+    assert "Traceback" not in r.stderr

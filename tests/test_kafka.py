@@ -375,3 +375,28 @@ def test_pipelined_requests_preserve_order(tmp_path):
             await _stop(server, broker, client)
 
     run(main())
+
+
+def test_latency_probes_record_produce_and_fetch(tmp_path):
+    """The protocol loop histograms produce/fetch handler latency
+    (kafka/latency_probe.h) and /metrics exposes buckets + sum/count."""
+    async def main():
+        from redpanda_tpu.metrics import registry
+
+        p = registry.histogram("kafka_produce_latency_us")
+        f = registry.histogram("kafka_fetch_latency_us")
+        p0, f0 = p.hist.count, f.hist.count
+        broker, server = await _start_broker(tmp_path)
+        client = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        try:
+            await client.create_topic("lat", partitions=1)
+            await client.produce("lat", 0, [b"x"])
+            await client.fetch("lat", 0, 0)
+        finally:
+            await _stop(server, broker, client)
+        assert p.hist.count > p0 and f.hist.count > f0
+        text = registry.render_prometheus()
+        assert "kafka_produce_latency_us_count" in text
+        assert "kafka_fetch_latency_us_bucket" in text
+
+    run(main())
