@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 
 from redpanda_tpu import rpc
 from redpanda_tpu.admin import AdminServer
@@ -61,6 +62,9 @@ class Application:
             sasl_enabled=c.enable_sasl,
             superusers=[u for u in c.superusers.split(",") if u],
             unsafe_relaxed_acks=c.unsafe_relaxed_acks,
+            target_quota_byte_rate=c.target_quota_byte_rate or None,
+            kafka_qdc_enable=c.kafka_qdc_enable,
+            kafka_qdc_max_latency_ms=float(c.kafka_qdc_max_latency_ms),
         )
 
     def _tls_for(self, prefix: str):
@@ -96,7 +100,19 @@ class Application:
 
         self.io_config = load_io_config(c.data_directory)
         self.rpc_tls = self._tls_for("rpc_server")
-        self.storage = await StorageApi(c.data_directory).start()
+        log_config = None
+        if c.debug_sanitize_files:
+            from redpanda_tpu.storage import file_sanitizer
+            from redpanda_tpu.storage.log import LogConfig
+
+            # arm BEFORE any storage handle opens (the kvstore WAL opens
+            # during StorageApi.start, ahead of the first DiskLog.open)
+            file_sanitizer.enable()
+            log_config = LogConfig(
+                base_dir=os.path.join(c.data_directory, "data"),
+                sanitize_files=True,
+            )
+        self.storage = await StorageApi(c.data_directory, log_config).start()
         self._stop_order.append(self.storage)
         self.broker = Broker(self._broker_config(), self.storage)
 

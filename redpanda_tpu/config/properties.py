@@ -108,6 +108,9 @@ PROPERTIES: list[Property] = [
     Property("fetch_poll_interval_ms", "Long-poll re-check cadence", 20, int, _positive, needs_restart=False),
     Property("unsafe_relaxed_acks", "CONSISTENCY-TESTING ONLY: ack acks=-1 at leader level (deliberately unsafe)", False, bool),
     Property("target_quota_byte_rate", "Per-client produce quota B/s (0 off)", 0, int, _non_negative, needs_restart=False),
+    Property("kafka_qdc_enable", "Queue-depth latency control on the kafka path", False, bool),
+    Property("kafka_qdc_max_latency_ms", "qdc target handler latency", 80, int, _positive),
+    Property("debug_sanitize_files", "Debug file-handle sanitizer on storage I/O", False, bool),
     # --- security
     Property("enable_sasl", "Require SASL on the kafka listener", False, bool),
     Property("superusers", "Comma-separated superuser principals", ""),

@@ -35,6 +35,13 @@ class BrokerConfig:
     target_fetch_quota_byte_rate: int | None = None
     # produce-path memory gate (connection_context.cc:32 memory units)
     kafka_request_max_memory: int = 64 * 1024 * 1024
+    # queue-depth latency control (qdc, application.cc:1002-1016); off by
+    # default like the reference's kafka_qdc_enable
+    kafka_qdc_enable: bool = False
+    kafka_qdc_max_latency_ms: float = 80.0
+    kafka_qdc_window_s: float = 1.0
+    kafka_qdc_min_depth: int = 1
+    kafka_qdc_max_depth: int = 100
     fetch_session_cache_size: int = 1000
     # consistency-testing ONLY: ack quorum produces at leader level,
     # deliberately violating acks=-1 so the linearizability checker can

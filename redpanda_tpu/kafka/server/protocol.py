@@ -203,6 +203,14 @@ class Connection:
             )
             self.writer.close()
             return None
+        # qdc gate: bound concurrent execution so latency tracks the target
+        # (no-op unless kafka_qdc_enable). FETCH is exempt: a long-poll
+        # parks inside the handler up to max_wait_ms, which is waiting for
+        # data, not queue pressure — sampling it would collapse the window
+        # and let idle consumers starve produces.
+        gated = header.api_key != FETCH
+        if gated:
+            await self.server.qdc.acquire()
         t0 = asyncio.get_running_loop().time()
         try:
             response = await handler(ctx)
@@ -213,6 +221,9 @@ class Connection:
             response = self.server.error_response(
                 api, header.api_version, ctx, ErrorCode.unknown_server_error
             )
+        finally:
+            if gated:
+                await self.server.qdc.release(asyncio.get_running_loop().time() - t0)
         if header.api_key == PRODUCE:
             _produce_latency.record(
                 int((asyncio.get_running_loop().time() - t0) * 1e6)
@@ -311,6 +322,16 @@ class KafkaServer:
         from redpanda_tpu.resource_mgmt import MemoryBudget
 
         self.memory = MemoryBudget(broker.config.kafka_request_max_memory)
+        from redpanda_tpu.kafka.server.qdc import QdcMonitor
+
+        cfg = broker.config
+        self.qdc = QdcMonitor(
+            enabled=cfg.kafka_qdc_enable,
+            target_latency_ms=cfg.kafka_qdc_max_latency_ms,
+            window_s=cfg.kafka_qdc_window_s,
+            min_depth=cfg.kafka_qdc_min_depth,
+            max_depth=cfg.kafka_qdc_max_depth,
+        )
         self._server: asyncio.AbstractServer | None = None
         self._conn_tasks: set[asyncio.Task] = set()
 

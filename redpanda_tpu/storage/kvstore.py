@@ -13,6 +13,7 @@ import os
 import struct
 
 from redpanda_tpu.hashing.crc32c import crc32c
+from redpanda_tpu.storage import file_sanitizer
 from redpanda_tpu.storage.snapshot import SnapshotManager, SnapshotError
 
 
@@ -50,7 +51,9 @@ class KvStore:
             _, payload = snap
             self._load_payload(payload)
         self._replay_wal()
-        self._wal = open(self._wal_path, "ab")
+        self._wal = file_sanitizer.maybe_wrap(
+            open(self._wal_path, "ab"), self._wal_path
+        )
         return self
 
     def stop(self):
@@ -143,4 +146,6 @@ class KvStore:
             self._wal.close()
         with open(self._wal_path, "wb"):
             pass  # truncate
-        self._wal = open(self._wal_path, "ab")
+        self._wal = file_sanitizer.maybe_wrap(
+            open(self._wal_path, "ab"), self._wal_path
+        )

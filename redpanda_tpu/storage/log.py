@@ -36,6 +36,8 @@ class LogConfig:
     use_device_recovery: bool = False  # batch CRC scan on the TPU
     # cleanup.policy: "delete", "compact", or "compact,delete"
     cleanup_policy: str = "delete"
+    # debug file-handle sanitizer (storage::debug_sanitize_files)
+    sanitize_files: bool = False
     delete_retention_ms: int | None = 86_400_000  # tombstone retention
     compaction_max_keys_in_memory: int = 128 * 1024  # key-index spill bound
 
@@ -89,6 +91,10 @@ class DiskLog:
     # ------------------------------------------------------------ lifecycle
     @classmethod
     async def open(cls, ntp: NTP, config: LogConfig) -> "DiskLog":
+        if config.sanitize_files:
+            from redpanda_tpu.storage import file_sanitizer
+
+            file_sanitizer.enable()
         log = cls(ntp, config)
         os.makedirs(log.dir, exist_ok=True)
         stems = sorted(

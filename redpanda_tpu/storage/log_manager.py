@@ -8,6 +8,7 @@ applies retention).
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 
 from redpanda_tpu.models.fundamental import NTP
@@ -144,3 +145,12 @@ class StorageApi:
     async def stop(self):
         await self.log_mgr.stop()
         self.kvs.stop()
+        from redpanda_tpu.storage import file_sanitizer
+
+        if file_sanitizer.enabled():
+            leaked = file_sanitizer.verify_all_closed()
+            if leaked:
+                logging.getLogger("rptpu.storage").warning(
+                    "file sanitizer: %d handle(s) leaked at shutdown: %s",
+                    len(leaked), leaked,
+                )

@@ -23,6 +23,7 @@ from redpanda_tpu.models.record import (
     RecordBatch,
     RecordBatchHeader,
 )
+from redpanda_tpu.storage import file_sanitizer
 
 INDEX_STEP = 32 * 1024
 _INDEX_ENTRY = struct.Struct("<IQq")  # rel_offset u32, file_pos u64, ts i64
@@ -129,13 +130,17 @@ class Segment:
 
     # ------------------------------------------------------------ lifecycle
     def create(self):
-        self._file = open(self.data_path, "wb")
+        self._file = file_sanitizer.maybe_wrap(
+            open(self.data_path, "wb"), self.data_path
+        )
         return self
 
     def open_existing(self, writable: bool):
         self.size_bytes = os.path.getsize(self.data_path)
         if writable:
-            self._file = open(self.data_path, "ab")
+            self._file = file_sanitizer.maybe_wrap(
+                open(self.data_path, "ab"), self.data_path
+            )
         loaded = self.index.load()
         if loaded is None:
             self.rebuild_index()
@@ -329,7 +334,9 @@ class Segment:
         self.max_timestamp = new_max_ts
         self.index.truncate_at_pos(file_pos)
         if was_writable:
-            self._file = open(self.data_path, "ab")
+            self._file = file_sanitizer.maybe_wrap(
+                open(self.data_path, "ab"), self.data_path
+            )
 
     def remove(self):
         self.release_appender()

@@ -1,0 +1,93 @@
+"""Debug file-handle sanitizer (storage/file_sanitizer.py; reference
+utils/file_sanitizer.h:51 + the storage::debug_sanitize_files knob):
+armed runs catch write-after-close, double close, and handle leaks at the
+misuse site; disarmed runs pay nothing and behave identically.
+"""
+
+import asyncio
+
+import pytest
+
+from redpanda_tpu.models import NTP, Record, RecordBatch
+from redpanda_tpu.storage import file_sanitizer
+from redpanda_tpu.storage.file_sanitizer import FileSanitizerError
+from redpanda_tpu.storage.log import DiskLog, LogConfig
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    file_sanitizer.disable()
+
+
+def _batch(base: int) -> RecordBatch:
+    return RecordBatch.build(
+        [Record(offset_delta=0, value=b"v%d" % base)], base_offset=base
+    )
+
+
+def test_write_after_close_raises(tmp_path):
+    file_sanitizer.enable()
+    f = file_sanitizer.maybe_wrap(open(tmp_path / "x", "wb"), "x")
+    f.write(b"ok")
+    f.close()
+    with pytest.raises(FileSanitizerError, match="write on closed"):
+        f.write(b"boom")
+
+
+def test_double_close_raises(tmp_path):
+    file_sanitizer.enable()
+    f = file_sanitizer.maybe_wrap(open(tmp_path / "x", "wb"), "x")
+    f.close()
+    with pytest.raises(FileSanitizerError, match="double close"):
+        f.close()
+
+
+def test_leak_detection(tmp_path):
+    file_sanitizer.enable()
+    file_sanitizer.maybe_wrap(open(tmp_path / "leaky", "wb"), "leaky")
+    assert file_sanitizer.verify_all_closed() == ["leaky"]
+    assert file_sanitizer.verify_all_closed() == []  # registry cleared
+
+
+def test_disarmed_is_passthrough(tmp_path):
+    assert not file_sanitizer.enabled()
+    f = file_sanitizer.maybe_wrap(open(tmp_path / "x", "wb"), "x")
+    assert not isinstance(f, file_sanitizer.SanitizedFile)
+    f.close()
+
+
+def test_truncate_keeps_sanitizer_coverage(tmp_path):
+    """truncate_to_file_pos reopens the appender handle; the new handle
+    must stay wrapped so post-truncation misuse is still caught."""
+    async def body():
+        cfg = LogConfig(base_dir=str(tmp_path), sanitize_files=True)
+        log = await DiskLog.open(NTP.kafka("tr", 0), cfg)
+        for i in range(4):
+            await log.append([_batch(i)], assign_offsets=False)
+        await log.truncate(2)
+        seg = log.segments[-1]
+        assert isinstance(seg._file, file_sanitizer.SanitizedFile)
+        await log.append([_batch(2)], assign_offsets=False)  # still usable
+        await log.close()
+        assert file_sanitizer.verify_all_closed() == []
+
+    asyncio.run(body())
+
+
+def test_sanitized_log_lifecycle_is_clean(tmp_path):
+    """A normal append/read/roll/close cycle under the armed sanitizer
+    must neither raise nor leak — proving storage closes what it opens."""
+    async def body():
+        cfg = LogConfig(
+            base_dir=str(tmp_path), sanitize_files=True, max_segment_size=256
+        )
+        log = await DiskLog.open(NTP.kafka("san", 0), cfg)
+        for i in range(12):  # rolls several segments
+            await log.append([_batch(i)], assign_offsets=False)
+        got = await log.read(0, 1 << 20)
+        assert len(got) == 12
+        await log.close()
+        assert file_sanitizer.verify_all_closed() == []
+
+    asyncio.run(body())
