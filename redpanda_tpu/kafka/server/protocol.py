@@ -21,9 +21,11 @@ from redpanda_tpu.kafka.protocol.messages import (
     API_VERSIONS,
     APIS,
     FETCH,
+    JOIN_GROUP,
     PRODUCE,
     SASL_AUTHENTICATE,
     SASL_HANDSHAKE,
+    SYNC_GROUP,
 )
 from redpanda_tpu.metrics import registry as _metrics
 from redpanda_tpu.kafka.protocol.primitives import Reader
@@ -204,14 +206,18 @@ class Connection:
             self.writer.close()
             return None
         # qdc gate: bound concurrent execution so latency tracks the target
-        # (no-op unless kafka_qdc_enable). FETCH is exempt: a long-poll
-        # parks inside the handler up to max_wait_ms, which is waiting for
-        # data, not queue pressure — sampling it would collapse the window
-        # and let idle consumers starve produces.
-        gated = header.api_key != FETCH
+        # (no-op unless kafka_qdc_enable). APIs that PARK inside their
+        # handler are exempt — a long-poll fetch waits for data and a
+        # join/sync waits for the rest of the group, not queue pressure;
+        # gating them would let one parked request hold the window's slots
+        # and starve produces (or deadlock a rebalance at depth 1), while
+        # their multi-second waits would poison the latency EWMA.
+        gated = header.api_key not in (FETCH, JOIN_GROUP, SYNC_GROUP)
+        # t0 BEFORE acquire: the latency sample and histograms must include
+        # queue-wait, or an overloaded-but-queueing broker reads as healthy
+        t0 = asyncio.get_running_loop().time()
         if gated:
             await self.server.qdc.acquire()
-        t0 = asyncio.get_running_loop().time()
         try:
             response = await handler(ctx)
         except KafkaError as e:

@@ -87,9 +87,19 @@ def maybe_wrap(f, path: str):
     return SanitizedFile(f, path) if _enabled else f
 
 
-def verify_all_closed() -> list[str]:
-    """Shutdown check: paths of handles never closed (leaks). Clears the
-    registry so test runs don't bleed into each other."""
-    leaked = [sf._path for sf in _open_files.values()]
-    _open_files.clear()
+def verify_all_closed(prefix: str | None = None) -> list[str]:
+    """Shutdown check: paths of handles never closed (leaks), cleared from
+    the registry as they are reported.
+
+    The arm knob is process-global (matching the reference's debug-build
+    flag), but MULTIPLE storage instances can coexist in one process
+    (in-process multi-node fixtures) — pass `prefix` (a base directory) so
+    one instance's shutdown only reports and clears its own handles
+    instead of wiping another instance's live ones."""
+    doomed = [
+        key
+        for key, sf in _open_files.items()
+        if prefix is None or sf._path.startswith(prefix)
+    ]
+    leaked = [_open_files.pop(key)._path for key in doomed]
     return leaked

@@ -148,7 +148,9 @@ class StorageApi:
         from redpanda_tpu.storage import file_sanitizer
 
         if file_sanitizer.enabled():
-            leaked = file_sanitizer.verify_all_closed()
+            # scope to this instance's tree: another StorageApi in the same
+            # process (multi-node fixtures) keeps its live handles
+            leaked = file_sanitizer.verify_all_closed(prefix=self.base_dir)
             if leaked:
                 logging.getLogger("rptpu.storage").warning(
                     "file sanitizer: %d handle(s) leaked at shutdown: %s",

@@ -1,0 +1,139 @@
+"""Fuzzy node operations under continuous load.
+
+Mirrors the reference's node_operations_fuzzy_test.py: a SEEDED random
+sequence of disruptive cluster operations — SIGKILL+restart of a random
+node, admin leadership transfers, cluster-wide leadership rebalance —
+runs interleaved with a continuous acks=-1 produce workload, and the
+invariant is checked at the end: every acked value is fetchable, exactly
+once, in produce order. The seed is fixed so a failure reproduces.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import urllib.request
+
+import pytest
+
+from redpanda_tpu.kafka.client import KafkaClient
+
+from .test_chaos import (
+    connect_live,
+    fetch_all_values,
+    produce_acked,
+)
+
+pytestmark = pytest.mark.chaos
+
+TOPIC = "fuzz-ops"
+SEED = 0xC0FFEE
+N_OPS = 6
+VALUES_PER_PHASE = 12
+
+
+def _run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 400))
+
+
+async def _admin_post(cluster, path: str) -> int:
+    """POST to any live node's admin API; returns HTTP status."""
+    for node in cluster.nodes:
+        if not node.alive:
+            continue
+        url = f"http://127.0.0.1:{node.ports['admin']}{path}"
+        try:
+            req = urllib.request.Request(url, method="POST", data=b"")
+            loop = asyncio.get_running_loop()
+            resp = await loop.run_in_executor(
+                None, lambda: urllib.request.urlopen(req, timeout=10)
+            )
+            return resp.status
+        except Exception:
+            continue
+    return -1
+
+
+async def _op_kill_restart(cluster, rng):
+    node = rng.choice(cluster.nodes)
+    node.kill()
+    # let the cluster notice + re-elect while the node is down
+    await asyncio.sleep(1.0)
+    await cluster.restart(node)
+
+
+async def _op_transfer_leadership(cluster, rng):
+    await _admin_post(
+        cluster, f"/v1/partitions/kafka/{TOPIC}/0/transfer_leadership"
+    )
+
+
+async def _op_rebalance(cluster, rng):
+    await _admin_post(cluster, "/v1/partitions/rebalance_leaders")
+
+
+OPS = [_op_kill_restart, _op_transfer_leadership, _op_rebalance]
+
+
+def test_fuzzy_node_ops_no_acked_loss(proc_cluster):
+    async def body():
+        cluster = proc_cluster
+        rng = random.Random(SEED)
+        client = await KafkaClient(cluster.bootstrap()).connect()
+        await client.create_topic(TOPIC, partitions=1, replication=3)
+
+        all_acked: list[bytes] = []
+        seq = 0
+        # phase 0: baseline load before any disruption
+        client, acked = await produce_acked(
+            cluster, TOPIC,
+            [b"v-%05d" % (seq + i) for i in range(VALUES_PER_PHASE)],
+            client=client,
+        )
+        seq += VALUES_PER_PHASE
+        all_acked += acked
+
+        ops_run = []
+        for _ in range(N_OPS):
+            op = rng.choice(OPS)
+            ops_run.append(op.__name__)
+            # the disruption and the produce phase overlap: values are
+            # acked while the operation is in flight
+            produce_task = asyncio.ensure_future(
+                produce_acked(
+                    cluster, TOPIC,
+                    [b"v-%05d" % (seq + i) for i in range(VALUES_PER_PHASE)],
+                )
+            )
+            try:
+                await op(cluster, rng)
+            finally:
+                client2, acked = await produce_task
+            seq += VALUES_PER_PHASE
+            all_acked += acked
+            if client2 is not None:
+                await client2.close()
+
+        # every node alive at the end (conftest contract) and every acked
+        # value present exactly once, in order
+        assert all(n.alive for n in cluster.nodes), ops_run
+        verifier = await connect_live(cluster, TOPIC)
+        got = await fetch_all_values(verifier, TOPIC)
+        await verifier.close()
+        got_set = set(got)
+        missing = [v for v in all_acked if v not in got_set]
+        assert not missing, (
+            f"lost {len(missing)} acked values after {ops_run}: {missing[:5]}"
+        )
+        # acked values appear in produce order. The workload is
+        # at-least-once (a produce retried around a kill may land twice),
+        # so the check is: all_acked is a SUBSEQUENCE of the fetched log.
+        it = iter(got)
+        for v in all_acked:
+            for g in it:
+                if g == v:
+                    break
+            else:
+                raise AssertionError(f"order violated for {v!r} after {ops_run}")
+
+    _run(body())

@@ -50,6 +50,23 @@ def test_leak_detection(tmp_path):
     assert file_sanitizer.verify_all_closed() == []  # registry cleared
 
 
+def test_scoped_leak_check_spares_other_instances(tmp_path):
+    """Two storage instances in one process: one instance's shutdown check
+    must not report or clear the other's live handles."""
+    file_sanitizer.enable()
+    a = file_sanitizer.maybe_wrap(open(tmp_path / "a.wal", "wb"), str(tmp_path / "a.wal"))
+    b_dir = tmp_path / "other"
+    b_dir.mkdir()
+    file_sanitizer.maybe_wrap(open(b_dir / "b.wal", "wb"), str(b_dir / "b.wal"))
+    # instance B shuts down: only its (leaked) handle is reported
+    leaked = file_sanitizer.verify_all_closed(prefix=str(b_dir))
+    assert leaked == [str(b_dir / "b.wal")]
+    # instance A's handle survived the scoped sweep and still works
+    a.write(b"still live")
+    a.close()
+    assert file_sanitizer.verify_all_closed() == []
+
+
 def test_disarmed_is_passthrough(tmp_path):
     assert not file_sanitizer.enabled()
     f = file_sanitizer.maybe_wrap(open(tmp_path / "x", "wb"), "x")
