@@ -213,11 +213,17 @@ class Connection:
         # and starve produces (or deadlock a rebalance at depth 1), while
         # their multi-second waits would poison the latency EWMA.
         gated = header.api_key not in (FETCH, JOIN_GROUP, SYNC_GROUP)
-        # t0 BEFORE acquire: the latency sample and histograms must include
-        # queue-wait, or an overloaded-but-queueing broker reads as healthy
-        t0 = asyncio.get_running_loop().time()
+        # t0 BEFORE acquire: the HISTOGRAMS must include queue-wait, or an
+        # overloaded-but-queueing broker reads as healthy to operators.
+        # The qdc control signal is sampled from t_svc (AFTER acquire):
+        # feeding queue-wait back into the controller would make the
+        # measured latency depend inversely on the depth being controlled —
+        # a positive feedback loop that pins depth at the floor.
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
         if gated:
             await self.server.qdc.acquire()
+        t_svc = loop.time()
         try:
             response = await handler(ctx)
         except KafkaError as e:
@@ -229,15 +235,11 @@ class Connection:
             )
         finally:
             if gated:
-                await self.server.qdc.release(asyncio.get_running_loop().time() - t0)
+                await self.server.qdc.release(loop.time() - t_svc)
         if header.api_key == PRODUCE:
-            _produce_latency.record(
-                int((asyncio.get_running_loop().time() - t0) * 1e6)
-            )
+            _produce_latency.record(int((loop.time() - t0) * 1e6))
         elif header.api_key == FETCH:
-            _fetch_latency.record(
-                int((asyncio.get_running_loop().time() - t0) * 1e6)
-            )
+            _fetch_latency.record(int((loop.time() - t0) * 1e6))
         return self._encode_response(header, api, response)
 
     def _encode_response(self, header: RequestHeader, api, response: dict | None) -> bytes | None:

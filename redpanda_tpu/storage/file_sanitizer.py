@@ -14,6 +14,7 @@ zero-cost when disarmed (`maybe_wrap` returns the raw handle).
 
 from __future__ import annotations
 
+import os
 import traceback
 
 _enabled = False
@@ -96,6 +97,9 @@ def verify_all_closed(prefix: str | None = None) -> list[str]:
     (in-process multi-node fixtures) — pass `prefix` (a base directory) so
     one instance's shutdown only reports and clears its own handles
     instead of wiping another instance's live ones."""
+    if prefix is not None:
+        # path-separator boundary: '<tmp>/d' must not claim '<tmp>/d2'
+        prefix = prefix.rstrip(os.sep) + os.sep
     doomed = [
         key
         for key, sf in _open_files.items()

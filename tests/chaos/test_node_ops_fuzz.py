@@ -92,6 +92,8 @@ def test_fuzzy_node_ops_no_acked_loss(proc_cluster):
         )
         seq += VALUES_PER_PHASE
         all_acked += acked
+        if client is not None:
+            await client.close()
 
         ops_run = []
         for _ in range(N_OPS):
