@@ -34,16 +34,27 @@ def _tool(*argv: str) -> subprocess.CompletedProcess:
 
 def test_compaction_preserves_latest_per_key(tmp_path):
     kafka_port, admin_port = _free_port(), _free_port()
+    # log to a FILE, not a pipe nobody drains (64KB of broker logging would
+    # deadlock the pipe); force the cpu jax backend like the chaos harness
+    log_path = tmp_path / "broker.log"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def _log_tail() -> str:
+        try:
+            return log_path.read_text()[-4000:]
+        except OSError:
+            return "<no log>"
+
     proc = subprocess.Popen(
         [
             sys.executable, "-m", "redpanda_tpu", "start",
-            "--set", f"data_directory={tmp_path}",
+            "--set", f"data_directory={tmp_path / 'data'}",
             "--set", f"kafka_api_port={kafka_port}",
             "--set", f"advertised_kafka_api_port={kafka_port}",
             "--set", f"admin_api_port={admin_port}",
             "--set", "log_compaction_interval_ms=500",
         ],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=REPO,
+        stdout=open(log_path, "ab"), stderr=subprocess.STDOUT, env=env, cwd=REPO,
     )
     try:
         import urllib.request
@@ -58,11 +69,11 @@ def test_compaction_preserves_latest_per_key(tmp_path):
                         break
             except Exception:
                 if proc.poll() is not None:
-                    raise RuntimeError(f"broker died:\n{proc.stdout.read()}")
+                    raise RuntimeError(f"broker died:\n{_log_tail()}")
             time.sleep(0.2)
         else:
             proc.kill()
-            raise RuntimeError(f"broker never ready:\n{proc.stdout.read()}")
+            raise RuntimeError(f"broker never ready:\n{_log_tail()}")
 
         # create the compacted topic (tiny segments so compaction has
         # closed segments to rewrite), then let the TOOL produce the known
@@ -105,15 +116,29 @@ def test_compaction_preserves_latest_per_key(tmp_path):
             raise AssertionError("compaction never ran (still 60 records)")
         assert surviving >= 5  # latest value of each of the 5 keys survives
 
-        # negative case: doctor the state to expect a key that never
-        # existed — the verifier must catch it
-        doctored = json.load(open(state))
+        # negative case 1: doctor the state to expect a key that never
+        # existed — the verifier must report it lost
+        with open(state) as f:
+            recorded = json.load(f)
+        doctored = json.loads(json.dumps(recorded))
         doctored["partitions"]["0"]["f" * 40] = ["a" * 40]
         bad_state = str(tmp_path / "bad.json")
-        json.dump(doctored, open(bad_state, "w"))
+        with open(bad_state, "w") as f:
+            json.dump(doctored, f)
         r = _tool("verify", "--brokers", brokers, "--topic", "cmp", "--state", bad_state)
         assert r.returncode == 1
         assert "lost entirely" in r.stderr
+
+        # negative case 2: drop a recorded key from the state — the topic
+        # now contains a key the state never saw: resurrected data
+        doctored2 = json.loads(json.dumps(recorded))
+        doctored2["partitions"]["0"].pop(next(iter(doctored2["partitions"]["0"])))
+        bad2 = str(tmp_path / "bad2.json")
+        with open(bad2, "w") as f:
+            json.dump(doctored2, f)
+        r = _tool("verify", "--brokers", brokers, "--topic", "cmp", "--state", bad2)
+        assert r.returncode == 1
+        assert "resurrected" in r.stderr
     finally:
         proc.terminate()
         try:

@@ -23,6 +23,10 @@ Usage:
   python tools/compacted_log_verifier.py verify --brokers h:p --topic t \
       --state /tmp/state.json
 Exit code 0 = invariants hold, 1 = violation (details on stderr).
+
+The topic must contain only the recorded workload (use a dedicated topic,
+as the reference's verifier does): any surviving key or partition absent
+from the recorded state is reported as resurrected data.
 """
 
 from __future__ import annotations
@@ -156,6 +160,15 @@ async def cmd_verify(args) -> int:
                     f"p{p} key {kh[:12]}: surviving values resurrected or "
                     f"reordered vs recorded history"
                 )
+        # reverse direction: anything in the topic that was never recorded
+        # is resurrected data (a key fully removed before `record`, or
+        # records duplicated into the partition)
+        for kh in surviving:
+            if kh not in expected:
+                errors.append(f"p{p} key {kh[:12]}: resurrected (never recorded)")
+    for p in got:
+        if str(p) not in state["partitions"]:
+            errors.append(f"p{p}: partition has data but was never recorded")
     if errors:
         for e in errors:
             print(f"VIOLATION: {e}", file=sys.stderr)
