@@ -213,7 +213,7 @@ def test_quorum_outage_and_recovery_linearizes(proc_cluster):
         await c.create_topic("lin-outage", partitions=1, replication=3)
         await c.close()
         leader = await _find_leader(cluster, "lin-outage")
-        followers = [cluster.nodes[(leader + 1) % 3], cluster.nodes[(leader + 2) % 3]]
+        followers = [n for n in cluster.nodes if n.node_id != leader]
         wl = LogWorkload(cluster.bootstrap, "lin-outage")
 
         try:

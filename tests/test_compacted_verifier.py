@@ -45,6 +45,7 @@ def test_compaction_preserves_latest_per_key(tmp_path):
         except OSError:
             return "<no log>"
 
+    log_f = open(log_path, "ab")
     proc = subprocess.Popen(
         [
             sys.executable, "-m", "redpanda_tpu", "start",
@@ -54,7 +55,7 @@ def test_compaction_preserves_latest_per_key(tmp_path):
             "--set", f"admin_api_port={admin_port}",
             "--set", "log_compaction_interval_ms=500",
         ],
-        stdout=open(log_path, "ab"), stderr=subprocess.STDOUT, env=env, cwd=REPO,
+        stdout=log_f, stderr=subprocess.STDOUT, env=env, cwd=REPO,
     )
     try:
         import urllib.request
@@ -145,3 +146,4 @@ def test_compaction_preserves_latest_per_key(tmp_path):
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+        log_f.close()

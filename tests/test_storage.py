@@ -428,6 +428,85 @@ def test_opfuzz_random_interleaving(tmp_path):
     _run(main())
 
 
+def test_opfuzz_with_caches_and_cursors(tmp_path):
+    """The same randomized interleaving, but through a LogManager so the
+    batch cache AND the readers cache (positioned cursors) front every
+    read — plus a chunked sequential-read op that walks the log in small
+    continuation reads (the cursor hot path). Any stale-cursor or
+    stale-cache bug after truncate/prefix/reopen diverges from the model."""
+
+    async def main():
+        rng = np.random.default_rng(987654)
+        ntp = NTP.kafka("fuzzc", 0)
+        mgr = LogManager(
+            LogConfig(base_dir=str(tmp_path), max_segment_size=600),
+            batch_cache_bytes=8 << 10,  # tiny: constant eviction pressure
+        )
+        log = await mgr.manage(ntp)
+        model: list[RecordBatch] = []
+        start_offset = 0
+
+        def dirty():
+            return model[-1].last_offset if model else start_offset - 1
+
+        for step in range(150):
+            op = rng.choice(
+                ["append", "read", "read_seq", "truncate", "prefix", "reopen"],
+                p=[0.4, 0.15, 0.2, 0.1, 0.05, 0.1],
+            )
+            if op == "append":
+                n = int(rng.integers(1, 4))
+                b = _batch(n, value_size=int(rng.integers(8, 80)))
+                r = await log.append([b])
+                model.append(b.with_base_offset(r.base_offset))
+            elif op == "read":
+                got = await log.read(start_offset, max_bytes=1 << 30)
+                want = [b for b in model if b.last_offset >= start_offset]
+                assert [g.base_offset for g in got] == [
+                    w.base_offset for w in want
+                ], f"step {step}"
+                assert all(g.verify_kafka_crc() for g in got)
+            elif op == "read_seq" and model and dirty() >= start_offset:
+                # chunked continuation walk from a random start: every
+                # follow-up read adopts the cursor stored by the previous
+                lo = int(rng.integers(start_offset, dirty() + 1))
+                cur = lo
+                seen = []
+                while True:
+                    got = await log.read(cur, max_bytes=200)
+                    if not got:
+                        break
+                    seen += got
+                    cur = got[-1].last_offset + 1
+                want = [b for b in model if b.last_offset >= lo]
+                assert [g.base_offset for g in seen] == [
+                    w.base_offset for w in want
+                ], f"step {step} from {lo}"
+                assert [g.payload for g in seen] == [w.payload for w in want]
+            elif op == "truncate" and model:
+                cut = int(rng.integers(start_offset, dirty() + 2))
+                await log.truncate(cut)
+                model = [b for b in model if b.last_offset < cut]
+            elif op == "prefix" and model:
+                cut = int(rng.integers(start_offset, dirty() + 2))
+                await log.prefix_truncate(cut)
+                start_offset = max(start_offset, cut)
+            elif op == "reopen":
+                await log.flush()
+                await mgr.stop()
+                mgr = LogManager(
+                    LogConfig(base_dir=str(tmp_path), max_segment_size=600),
+                    batch_cache_bytes=8 << 10,
+                )
+                log = await mgr.manage(ntp)
+                assert log.offsets().dirty_offset == dirty(), f"step {step}"
+        # the cursor path was actually exercised
+        assert mgr.readers_cache.hits > 0, mgr.readers_cache.stats()
+        await mgr.stop()
+
+    _run(main())
+
+
 # ------------------------------------------------------------------ compaction
 def _kv_batch(pairs, ts=0):
     """pairs: [(key, value-or-None)]"""
