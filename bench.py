@@ -49,7 +49,10 @@ RECORD_JSON_PAD = 900  # ~1KB records
 ROW_STRIDE = 1152
 GROUP = int(os.environ.get("BENCH_GROUP", "16"))  # ticks fused per launch
 DEPTH = int(os.environ.get("BENCH_DEPTH", "3"))  # launch groups in flight
-MEASURE_TICKS = int(os.environ.get("BENCH_TICKS", "48"))
+# long enough that DEPTH-deep pipelining reaches steady state: with 3
+# launch groups the fill+drain tunnel round trips (~2x67ms) dominate a
+# ~0.27s run and understate the sustained rate by ~40%
+MEASURE_TICKS = int(os.environ.get("BENCH_TICKS", "160"))
 BASELINE_TICKS = int(os.environ.get("BENCH_BASELINE_TICKS", "4"))
 
 

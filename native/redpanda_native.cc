@@ -254,6 +254,50 @@ int64_t rp_frame_records(const uint8_t* rows, size_t row_stride,
   return out - dst;
 }
 
+// Frame MANY batch ranges in one crossing (one ctypes call per LAUNCH
+// instead of one per batch — the per-call Python/ctypes overhead was the
+// single biggest host cost at 32-record batches). For each range r,
+// records [starts[r], ends[r]) are framed contiguously into dst;
+// out_off/out_len give the payload slice and out_kept the surviving
+// record count per range. Returns total bytes written.
+int64_t rp_frame_many(const uint8_t* rows, size_t row_stride,
+                      const int32_t* lens, const uint8_t* keep,
+                      const int64_t* starts, const int64_t* ends,
+                      int64_t n_ranges, uint8_t* dst,
+                      int64_t* out_off, int64_t* out_len,
+                      int32_t* out_kept) {
+  uint8_t* out = dst;
+  uint8_t body_buf[16];
+  for (int64_t r = 0; r < n_ranges; r++) {
+    uint8_t* range_start = out;
+    int32_t seq = 0;
+    for (int64_t i = starts[r]; i < ends[r]; i++) {
+      if (!keep[i]) continue;
+      int32_t vlen = lens[i] < 0 ? 0 : lens[i];
+      if ((size_t)vlen > row_stride) vlen = (int32_t)row_stride;
+      uint8_t* b = body_buf;
+      *b++ = 0;                      // attributes
+      b = write_zigzag(b, 0);        // timestamp delta
+      b = write_zigzag(b, seq);      // offset delta
+      b = write_zigzag(b, -1);       // null key
+      b = write_zigzag(b, vlen);     // value length
+      size_t pre_len = (size_t)(b - body_buf);
+      int64_t body_len = (int64_t)pre_len + vlen + 1;  // +1 header count
+      out = write_zigzag(out, body_len);
+      std::memcpy(out, body_buf, pre_len);
+      out += pre_len;
+      std::memcpy(out, rows + (size_t)i * row_stride, vlen);
+      out += vlen;
+      out = write_zigzag(out, 0);    // header count
+      seq++;
+    }
+    out_off[r] = range_start - dst;
+    out_len[r] = out - range_start;
+    out_kept[r] = seq;
+  }
+  return out - dst;
+}
+
 // ---------------------------------------------------------------- columnar
 // JSON field extraction for the columnar pushdown path (coproc engine v2).
 // The device link charges per byte (tools/link_probe.py: H2D ~15-70 MB/s,

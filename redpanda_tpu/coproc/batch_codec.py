@@ -134,22 +134,42 @@ def frame_records(rows: np.ndarray, lens: np.ndarray, keep: np.ndarray) -> tuple
     return bytes(out), seq
 
 
-def rebuild_batch(
-    source: RecordBatch,
+def frame_ranges(
     rows: np.ndarray,
     lens: np.ndarray,
     keep: np.ndarray,
+    ranges: list[tuple[int, int]],
+) -> list[tuple[bytes, int]]:
+    """Frame every [start, end) record range of a LAUNCH in one native
+    crossing (rp_frame_many): [(payload, kept)] per range. The per-batch
+    ctypes call overhead dominated rebuild at 32-record batches; this is
+    the same loop, moved below the language boundary."""
+    lib = _native()
+    if lib is not None and getattr(lib, "has_frame_many", False) and ranges:
+        starts = np.fromiter((s for s, _ in ranges), np.int64, len(ranges))
+        ends = np.fromiter((e for _, e in ranges), np.int64, len(ranges))
+        dst, off, ln, kept = lib.frame_many(rows, lens, keep, starts, ends)
+        return [
+            (dst[off[i] : off[i] + ln[i]].tobytes(), int(kept[i]))
+            for i in range(len(ranges))
+        ]
+    return [frame_records(rows[s:e], lens[s:e], keep[s:e]) for s, e in ranges]
+
+
+def build_output_batch(
+    source: RecordBatch,
+    payload: bytes,
+    kept: int,
     *,
     compress_threshold: int = 512,
     codec: Compression = Compression.zstd,
 ) -> RecordBatch | None:
-    """Assemble a materialized output batch from kept transform rows.
+    """Seal a framed payload into a materialized output batch.
 
     Mirrors the reference's write side (script_context_backend.cc:40-68):
     term reset, zstd recompression above a size threshold, fresh CRCs.
     Returns None when no record survives the transform.
     """
-    payload, kept = frame_records(rows, lens, keep)
     if kept == 0:
         return None
     attrs = 0
@@ -169,3 +189,21 @@ def rebuild_batch(
     batch = RecordBatch(hdr, payload)
     batch.reseal()
     return batch
+
+
+def rebuild_batch(
+    source: RecordBatch,
+    rows: np.ndarray,
+    lens: np.ndarray,
+    keep: np.ndarray,
+    *,
+    compress_threshold: int = 512,
+    codec: Compression = Compression.zstd,
+) -> RecordBatch | None:
+    """Single-batch rebuild (frame + seal); the engine's launch path uses
+    frame_ranges + build_output_batch to amortize the native crossing."""
+    payload, kept = frame_records(rows, lens, keep)
+    return build_output_batch(
+        source, payload, kept,
+        compress_threshold=compress_threshold, codec=codec,
+    )

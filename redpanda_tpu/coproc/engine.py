@@ -134,7 +134,7 @@ class _Launch:
     __slots__ = ("script_id", "policy", "mode", "r_out", "ranges", "fits",
                  "engine", "n", "_packed_dev", "_mask_dev", "_mask_np",
                  "_mask_event", "_proj_data", "_proj_ok", "_plan",
-                 "_exploded", "_mat", "_lock")
+                 "_exploded", "_mat", "_framed", "_lock")
 
     def __init__(self, script_id: int, policy: ErrorPolicy):
         self.script_id = script_id
@@ -154,23 +154,9 @@ class _Launch:
         self._plan = None
         self._exploded = None
         self._mat = None
+        self._framed = None
         self._lock = threading.Lock()
 
-    def materialize(self):
-        """(out, out_len, keep) host arrays; fetch happens at most once.
-
-        Locked: tickets of one submit_group share this launch and may be
-        harvested from different threads (the pacemaker harvests via
-        run_in_executor)."""
-        with self._lock:
-            if self._mat is None:
-                if self.mode == "payload":
-                    self._mat = self._mat_payload()
-                elif self.mode == "columnar":
-                    self._mat = self._mat_columnar()
-                else:
-                    self._mat = self._mat_host()
-            return self._mat
 
     def _mat_payload(self):
         if self._packed_dev is None:  # zero-record launch
@@ -271,6 +257,33 @@ class _Launch:
         self._exploded = None
         return rows, lens, keep
 
+    def framed(self) -> list[tuple[bytes, int]]:
+        """Per-range (payload, kept), framed launch-wide in ONE native
+        crossing the first time any ticket rebuilds. Locked: tickets of one
+        submit_group share this launch and may harvest from different
+        threads (the pacemaker harvests via run_in_executor)."""
+        with self._lock:
+            if self._framed is None:
+                out, out_len, keep = self._materialize_locked()
+                t0 = time.perf_counter()
+                self._framed = batch_codec.frame_ranges(
+                    out, out_len, keep, self.ranges
+                )
+                self._stat("t_rebuild", t0)
+            return self._framed
+
+    def _materialize_locked(self):
+        """(out, out_len, keep) host arrays; fetch happens at most once.
+        Caller holds self._lock."""
+        if self._mat is None:
+            if self.mode == "payload":
+                self._mat = self._mat_payload()
+            elif self.mode == "columnar":
+                self._mat = self._mat_columnar()
+            else:
+                self._mat = self._mat_host()
+        return self._mat
+
     def _stat(self, key: str, t0: float):
         if self.engine is not None:
             self.engine._stat_add(key, time.perf_counter() - t0)
@@ -344,17 +357,16 @@ class Ticket:
         return reply
 
     def _rebuild(self, item: ProcessBatchItem, launch: _Launch, rng) -> list[RecordBatch]:
-        out, out_len, keep = launch.materialize()
+        framed = launch.framed()  # one native crossing for the whole launch
         e = self._engine
         t0 = time.perf_counter()
         item_out: list[RecordBatch] = []
         for batch, ridx in zip(item.batches, rng):
-            start, end = launch.ranges[ridx]
-            rebuilt = batch_codec.rebuild_batch(
+            payload, kept = framed[ridx]
+            rebuilt = batch_codec.build_output_batch(
                 batch,
-                out[start:end],
-                out_len[start:end],
-                keep[start:end],
+                payload,
+                kept,
                 compress_threshold=e._compress_threshold,
                 codec=e._output_codec,
             )

@@ -59,6 +59,15 @@ class _NativeLib:
                 ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
                 ctypes.c_void_p,
             ]
+        self.has_frame_many = hasattr(dll, "rp_frame_many")
+        if self.has_frame_many:
+            dll.rp_frame_many.restype = ctypes.c_int64
+            dll.rp_frame_many.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
         dll.rp_json_find.restype = ctypes.c_int32
         dll.rp_json_find.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int32,
@@ -134,6 +143,47 @@ class _NativeLib:
             n, dst.ctypes.data, ctypes.byref(kept),
         )
         return dst[:length].tobytes(), kept.value
+
+    def frame_many(
+        self,
+        rows: np.ndarray,
+        lens: np.ndarray,
+        keep: np.ndarray,
+        starts: np.ndarray,
+        ends: np.ndarray,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Frame many [start, end) record ranges in ONE crossing.
+
+        Returns (dst, payload_off[r], payload_len[r], kept[r]); a range's
+        payload is dst[off : off + len].tobytes()."""
+        rows = np.ascontiguousarray(rows, dtype=np.uint8)
+        lens = np.ascontiguousarray(lens, dtype=np.int32)
+        keep = np.ascontiguousarray(keep, dtype=np.uint8)
+        starts = np.ascontiguousarray(starts, dtype=np.int64)
+        ends = np.ascontiguousarray(ends, dtype=np.int64)
+        n, stride = rows.shape
+        n_ranges = len(starts)
+        # guard the unchecked C walk: out-of-bounds or overlapping ranges
+        # must be a ValueError here, not a heap write past dst
+        if len(ends) != n_ranges:
+            raise ValueError("starts/ends length mismatch")
+        if n_ranges and (
+            (starts > ends).any()
+            or starts.min() < 0
+            or ends.max() > n
+            or int((ends - starts).sum()) > n
+        ):
+            raise ValueError("frame_many ranges out of bounds or overlapping")
+        dst = np.empty(n * (stride + 16) + 16, dtype=np.uint8)
+        out_off = np.empty(n_ranges, dtype=np.int64)
+        out_len = np.empty(n_ranges, dtype=np.int64)
+        out_kept = np.empty(n_ranges, dtype=np.int32)
+        self._dll.rp_frame_many(
+            rows.ctypes.data, stride, lens.ctypes.data, keep.ctypes.data,
+            starts.ctypes.data, ends.ctypes.data, n_ranges, dst.ctypes.data,
+            out_off.ctypes.data, out_len.ctypes.data, out_kept.ctypes.data,
+        )
+        return dst, out_off, out_len, out_kept
 
     def parse_many(
         self,
