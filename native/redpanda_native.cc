@@ -471,6 +471,184 @@ enum {
   RP_F_NULL = 16,
 };
 
+// Shared numeric classification from a found (type, vs, ve) span —
+// extract_num and gather_num MUST agree byte-for-byte (parity contract
+// with the Python oracle, ops/exprs.py host_field).
+static void num_from_span(const uint8_t* rec, int32_t t, int64_t vs,
+                          int64_t ve, float* out_f32, int32_t* out_i32,
+                          uint8_t* out_flags) {
+  *out_f32 = 0.0f;
+  *out_i32 = 0;
+  *out_flags = 0;
+  if (t == 0) return;
+  if (t == 3) {  // true
+    *out_f32 = 1.0f;
+    *out_i32 = 1;
+    *out_flags = RP_F_PRESENT | RP_F_BOOL;
+  } else if (t == 4) {  // false
+    *out_flags = RP_F_PRESENT | RP_F_BOOL;
+  } else if (t == 5) {  // null
+    *out_flags = RP_F_PRESENT | RP_F_NULL;
+  } else if (t == 2) {  // number
+    char buf[48];
+    int64_t tl = ve - vs;
+    // Restrict to decimal-number characters BEFORE strtod: strtod also
+    // accepts hex (0x10) / inf / nan, which the Python oracle rejects.
+    bool decimal_chars = tl > 0;
+    for (int64_t k = 0; k < tl && decimal_chars; k++) {
+      uint8_t c = rec[vs + k];
+      decimal_chars = (c >= '0' && c <= '9') || c == '-' || c == '+' ||
+                      c == '.' || c == 'e' || c == 'E';
+    }
+    if (decimal_chars && tl < (int64_t)sizeof(buf)) {
+      std::memcpy(buf, rec + vs, (size_t)tl);
+      buf[tl] = 0;
+      char* endp = nullptr;
+      double d = strtod(buf, &endp);
+      if (endp == buf + tl) {
+        *out_f32 = (float)d;
+        uint8_t fl = RP_F_PRESENT | RP_F_NUMBER;
+        if (std::isfinite(d) && d == (double)(int64_t)d &&
+            d >= -2147483648.0 && d <= 2147483647.0) {
+          fl |= RP_F_INT_EXACT;
+          *out_i32 = (int32_t)d;
+        }
+        *out_flags = fl;
+      } else {
+        *out_flags = RP_F_PRESENT;  // malformed number token
+      }
+    } else {
+      *out_flags = RP_F_PRESENT;  // token too long for exact parse
+    }
+  } else {  // string/object/array
+    *out_flags = RP_F_PRESENT;
+  }
+}
+
+// Classify the value starting at s[i] exactly like rp_json_find's
+// last-segment logic; returns the type and fills vs/ve.
+static int32_t classify_value(const uint8_t* s, int64_t i, int64_t end,
+                              int64_t* vs, int64_t* ve) {
+  if (i >= end) return 0;
+  uint8_t c = s[i];
+  if (c == '"') {
+    int64_t j = skip_string(s, i, end);
+    *vs = i + 1;
+    *ve = j - 1;
+    return 1;
+  }
+  if (c == '{') {
+    *vs = i;
+    *ve = skip_value(s, i, end);
+    return 6;
+  }
+  if (c == '[') {
+    *vs = i;
+    *ve = skip_value(s, i, end);
+    return 7;
+  }
+  int64_t j = skip_value(s, i, end);
+  *vs = i;
+  *ve = j;
+  int64_t tl = j - i;
+  if (tl == 4 && std::memcmp(s + i, "true", 4) == 0) return 3;
+  if (tl == 5 && std::memcmp(s + i, "false", 5) == 0) return 4;
+  if (tl == 4 && std::memcmp(s + i, "null", 4) == 0) return 5;
+  return 2;
+}
+
+// Single pass over each record's TOP-LEVEL object: span tables for k
+// single-segment paths in ONE walk instead of one rp_json_find per path
+// (the engine's specs typically reference 2-4 fields of the same record).
+// types/vs/ve are [n, k] row-major; type 0 = missing. First occurrence of
+// a duplicate key wins, matching rp_json_find's scan order.
+int64_t rp_find_multi(const uint8_t* joined, const int64_t* offsets,
+                      const int32_t* sizes, int64_t n,
+                      const char* paths_blob, const int32_t* path_off,
+                      const int32_t* path_lens, int32_t k, int8_t* types,
+                      int64_t* vs_arr, int64_t* ve_arr) {
+  for (int64_t r = 0; r < n; r++) {
+    int8_t* trow = types + r * k;
+    int64_t* vrow = vs_arr + r * k;
+    int64_t* erow = ve_arr + r * k;
+    std::memset(trow, 0, (size_t)k);
+    int32_t sz = sizes[r];
+    if (sz <= 0) continue;
+    const uint8_t* s = joined + offsets[r];
+    int64_t end = sz;
+    int64_t i = skip_ws(s, 0, end);
+    if (i >= end || s[i] != '{') continue;
+    i++;
+    int32_t found = 0;
+    for (;;) {
+      i = skip_ws(s, i, end);
+      if (i >= end || s[i] == '}') break;
+      if (s[i] != '"') break;  // malformed
+      int64_t kstart = i + 1;
+      i = skip_string(s, i, end);
+      int64_t kend = i - 1;
+      i = skip_ws(s, i, end);
+      if (i >= end || s[i] != ':') break;
+      i++;
+      i = skip_ws(s, i, end);
+      int64_t klen = kend - kstart;
+      bool matched = false;
+      for (int32_t p = 0; p < k; p++) {
+        if (trow[p] != 0) continue;  // first occurrence wins
+        if (klen == path_lens[p] &&
+            std::memcmp(s + kstart, paths_blob + path_off[p],
+                        (size_t)path_lens[p]) == 0) {
+          int64_t vs, ve;
+          int32_t t = classify_value(s, i, end, &vs, &ve);
+          if (t == 0) break;
+          trow[p] = (int8_t)t;
+          vrow[p] = vs;
+          erow[p] = ve;
+          matched = true;
+          found++;
+          // value consumed by classification: resume after it
+          i = (t == 1) ? ve + 1 : ve;
+          break;
+        }
+      }
+      if (!matched) i = skip_value(s, i, end);
+      i = skip_ws(s, i, end);
+      if (i < end && s[i] == ',') i++;
+      if (found == k) break;  // everything located
+    }
+  }
+  return n;
+}
+
+// Gather a string column from a precomputed span table column.
+void rp_gather_str(const uint8_t* joined, const int64_t* offsets, int64_t n,
+                   const int8_t* types, const int64_t* vs, const int64_t* ve,
+                   int32_t w, uint8_t* out_bytes, int32_t* out_vlen) {
+  for (int64_t i = 0; i < n; i++) {
+    uint8_t* dst = out_bytes + i * (int64_t)w;
+    std::memset(dst, 0, (size_t)w);
+    if (types[i] != 1) {
+      out_vlen[i] = -1;
+      continue;
+    }
+    int64_t vlen = ve[i] - vs[i];
+    if (vlen > (1 << 30)) vlen = 1 << 30;
+    out_vlen[i] = (int32_t)vlen;
+    int64_t cp = vlen < w ? vlen : w;
+    std::memcpy(dst, joined + offsets[i] + vs[i], (size_t)cp);
+  }
+}
+
+// Gather a numeric column from a precomputed span table column.
+void rp_gather_num(const uint8_t* joined, const int64_t* offsets, int64_t n,
+                   const int8_t* types, const int64_t* vs, const int64_t* ve,
+                   float* out_f32, int32_t* out_i32, uint8_t* out_flags) {
+  for (int64_t i = 0; i < n; i++) {
+    num_from_span(joined + offsets[i], types[i], vs[i], ve[i], out_f32 + i,
+                  out_i32 + i, out_flags + i);
+  }
+}
+
 // Extract a numeric/bool/null field as (f32, i32, flags) per record.
 // Numbers parse as double then narrow: INT_EXACT when integral and within
 // int32. Strings/objects/arrays set PRESENT only. Missing -> flags 0.
@@ -489,49 +667,8 @@ int64_t rp_extract_num(const uint8_t* joined, const int64_t* offsets,
     int32_t t = rp_json_find(joined + offsets[i], sz, path, path_len, &vs, &ve);
     if (t == 0) continue;
     hits++;
-    if (t == 3) {  // true
-      out_f32[i] = 1.0f;
-      out_i32[i] = 1;
-      out_flags[i] = RP_F_PRESENT | RP_F_BOOL;
-    } else if (t == 4) {  // false
-      out_flags[i] = RP_F_PRESENT | RP_F_BOOL;
-    } else if (t == 5) {  // null
-      out_flags[i] = RP_F_PRESENT | RP_F_NULL;
-    } else if (t == 2) {  // number
-      char buf[48];
-      int64_t tl = ve - vs;
-      // Restrict to decimal-number characters BEFORE strtod: strtod also
-      // accepts hex (0x10) / inf / nan, which the Python oracle rejects —
-      // the token must stay PRESENT-only on both paths (parity contract).
-      bool decimal_chars = tl > 0;
-      for (int64_t k = 0; k < tl && decimal_chars; k++) {
-        uint8_t c = joined[offsets[i] + vs + k];
-        decimal_chars = (c >= '0' && c <= '9') || c == '-' || c == '+' ||
-                        c == '.' || c == 'e' || c == 'E';
-      }
-      if (decimal_chars && tl < (int64_t)sizeof(buf)) {
-        std::memcpy(buf, joined + offsets[i] + vs, (size_t)tl);
-        buf[tl] = 0;
-        char* endp = nullptr;
-        double d = strtod(buf, &endp);
-        if (endp == buf + tl) {
-          out_f32[i] = (float)d;
-          uint8_t fl = RP_F_PRESENT | RP_F_NUMBER;
-          if (std::isfinite(d) && d == (double)(int64_t)d &&
-              d >= -2147483648.0 && d <= 2147483647.0) {
-            fl |= RP_F_INT_EXACT;
-            out_i32[i] = (int32_t)d;
-          }
-          out_flags[i] = fl;
-        } else {
-          out_flags[i] = RP_F_PRESENT;  // malformed number token
-        }
-      } else {
-        out_flags[i] = RP_F_PRESENT;  // token too long for exact parse
-      }
-    } else {  // string/object/array
-      out_flags[i] = RP_F_PRESENT;
-    }
+    num_from_span(joined + offsets[i], t, vs, ve, out_f32 + i, out_i32 + i,
+                  out_flags + i);
   }
   return hits;
 }

@@ -94,6 +94,14 @@ class Application:
         from redpanda_tpu.syschecks import check_environment
 
         check_environment(c)
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            # Operator asked for the CPU backend: ALSO drop the axon TPU
+            # backend factory. The plugin registers regardless of
+            # JAX_PLATFORMS, and an unhealthy tunnel would hang the coproc
+            # engine's first dispatch inside an otherwise CPU-only broker.
+            from redpanda_tpu.utils.platform import force_cpu_platform
+
+            force_cpu_platform()
         # rpk iotune's characterization, when present (io-config.json in the
         # data dir): published below as metrics for operators/dashboards
         from redpanda_tpu.config.io_config import load_io_config

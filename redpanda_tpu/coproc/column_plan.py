@@ -59,6 +59,37 @@ class DevCol:
     w: int = 0  # str byte width (merged across uses)
 
 
+class FindCache:
+    """Span tables from ONE native JSON walk per record for every
+    single-segment path a plan references (rp_find_multi) — the extractors
+    gather from these tables instead of re-walking the record per field."""
+
+    def __init__(self, lib, joined, offsets, sizes, paths: list[str]):
+        self._lib = lib
+        self._joined = joined
+        self._offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        self.col = {p: i for i, p in enumerate(paths)}
+        self.types, self.vs, self.ve = lib.find_multi(joined, offsets, sizes, paths)
+
+    def gather_str(self, path: str, w: int):
+        i = self.col[path]
+        return self._lib.gather_str(
+            self._joined, self._offsets,
+            self.types[:, i], self.vs[:, i], self.ve[:, i], w,
+        )
+
+    def gather_num(self, path: str):
+        i = self.col[path]
+        return self._lib.gather_num(
+            self._joined, self._offsets,
+            self.types[:, i], self.vs[:, i], self.ve[:, i],
+        )
+
+    def gather_exists(self, path: str):
+        i = self.col[path]
+        return (self.types[:, i] != 0).astype(np.uint8)
+
+
 @dataclass
 class ColumnarPlan:
     spec: TransformSpec
@@ -69,6 +100,29 @@ class ColumnarPlan:
     _fn_cache: dict = dc_field(default_factory=dict)
 
     mode = "columnar"
+
+    def flat_paths(self) -> list[str]:
+        """Distinct TOP-LEVEL (single-segment) paths the plan references;
+        nested paths keep the per-path walker."""
+        seen: dict[str, None] = {}
+        for c in self.dev_cols:
+            seen.setdefault(c.path)
+        for f in self.proj:
+            if isinstance(f, Concat):
+                seen.setdefault(f.a)
+                seen.setdefault(f.b)
+            else:
+                seen.setdefault(f.key)
+        return [p for p in seen if "." not in p]
+
+    def build_find_cache(self, joined, offsets, sizes) -> FindCache | None:
+        lib = _native()
+        if lib is None or not getattr(lib, "has_find_multi", False):
+            return None
+        paths = self.flat_paths()
+        if not paths:
+            return None
+        return FindCache(lib, joined, offsets, sizes, paths)
 
     # ------------------------------------------------------------ device
     def compile_device(self, mesh=None):
@@ -130,28 +184,28 @@ class ColumnarPlan:
         return fn
 
     # ------------------------------------------------------------ host side
-    def extract_device_inputs(self, joined, offsets, sizes, n_pad: int):
+    def extract_device_inputs(self, joined, offsets, sizes, n_pad: int, cache=None):
         """Native pass over the records -> ordered device input arrays."""
         out = []
         for c in self.dev_cols:
             if c.kind == "str":
-                b, v = _extract_str(joined, offsets, sizes, c.path, c.w, n_pad)
+                b, v = _extract_str(joined, offsets, sizes, c.path, c.w, n_pad, cache)
                 out += [b, v]
             elif c.kind == "num":
-                f32, i32, fl = _extract_num(joined, offsets, sizes, c.path, n_pad)
+                f32, i32, fl = _extract_num(joined, offsets, sizes, c.path, n_pad, cache)
                 out += [f32, i32, fl]
             else:
-                out.append(_extract_exists(joined, offsets, sizes, c.path, n_pad))
+                out.append(_extract_exists(joined, offsets, sizes, c.path, n_pad, cache))
         return out
 
-    def extract_projection(self, joined, offsets, sizes):
+    def extract_projection(self, joined, offsets, sizes, cache=None):
         """Host-side projection columns -> (per-field data, ok mask [n])."""
         n = len(sizes)
         ok = np.ones(n, dtype=bool)
         data = []
         for f in self.proj:
             if isinstance(f, Int):
-                _, i32, fl = _extract_num(joined, offsets, sizes, f.key, n)
+                _, i32, fl = _extract_num(joined, offsets, sizes, f.key, n, cache)
                 fok = (
                     (fl & (E.F_PRESENT | E.F_NUMBER | E.F_INT_EXACT))
                     == (E.F_PRESENT | E.F_NUMBER | E.F_INT_EXACT)
@@ -159,26 +213,26 @@ class ColumnarPlan:
                 ok &= fok
                 data.append(("int", i32))
             elif isinstance(f, Float):
-                f32, _, fl = _extract_num(joined, offsets, sizes, f.key, n)
+                f32, _, fl = _extract_num(joined, offsets, sizes, f.key, n, cache)
                 ok &= (fl & (E.F_PRESENT | E.F_NUMBER)) == (
                     E.F_PRESENT | E.F_NUMBER
                 )
                 data.append(("float", f32))
             elif isinstance(f, Substr):
                 b, v = _extract_str(
-                    joined, offsets, sizes, f.key, f.start + f.length, n
+                    joined, offsets, sizes, f.key, f.start + f.length, n, cache
                 )
                 ok &= v >= 0
                 body = b[:, f.start : f.start + f.length]
                 slen = np.clip(v - f.start, 0, f.length).astype(np.int32)
                 data.append(("str", body, slen, f.length))
             elif isinstance(f, Concat):
-                ba, va = _extract_str(joined, offsets, sizes, f.a, f.max_len, n)
-                bb, vb = _extract_str(joined, offsets, sizes, f.b, f.max_len, n)
+                ba, va = _extract_str(joined, offsets, sizes, f.a, f.max_len, n, cache)
+                bb, vb = _extract_str(joined, offsets, sizes, f.b, f.max_len, n, cache)
                 ok &= (va >= 0) & (vb >= 0)
                 data.append(("concat", ba, va, bb, vb, f.max_len))
             else:  # Str
-                b, v = _extract_str(joined, offsets, sizes, f.key, f.max_len, n)
+                b, v = _extract_str(joined, offsets, sizes, f.key, f.max_len, n, cache)
                 ok &= (v >= 0) & (v <= f.max_len)
                 data.append(("str", b, np.clip(v, 0, f.max_len), f.max_len))
         return data, ok
@@ -404,10 +458,12 @@ def _native():
         return None
 
 
-def _extract_str(joined, offsets, sizes, path, w, n_pad):
+def _extract_str(joined, offsets, sizes, path, w, n_pad, cache=None):
     lib = _native()
     n = len(sizes)
-    if lib is not None:
+    if cache is not None and path in cache.col:
+        b, v = cache.gather_str(path, w)
+    elif lib is not None:
         b, v = lib.extract_str(joined, offsets, sizes, path, w)
     else:
         b = np.zeros((n, w), dtype=np.uint8)
@@ -425,10 +481,12 @@ def _extract_str(joined, offsets, sizes, path, w, n_pad):
     return b, v
 
 
-def _extract_num(joined, offsets, sizes, path, n_pad):
+def _extract_num(joined, offsets, sizes, path, n_pad, cache=None):
     lib = _native()
     n = len(sizes)
-    if lib is not None:
+    if cache is not None and path in cache.col:
+        f32, i32, fl = cache.gather_num(path)
+    elif lib is not None:
         f32, i32, fl = lib.extract_num(joined, offsets, sizes, path)
     else:
         f32 = np.zeros(n, np.float32)
@@ -445,10 +503,12 @@ def _extract_num(joined, offsets, sizes, path, n_pad):
     return f32, i32, fl
 
 
-def _extract_exists(joined, offsets, sizes, path, n_pad):
+def _extract_exists(joined, offsets, sizes, path, n_pad, cache=None):
     lib = _native()
     n = len(sizes)
-    if lib is not None:
+    if cache is not None and path in cache.col:
+        ex = cache.gather_exists(path)
+    elif lib is not None:
         ex = lib.extract_exists(joined, offsets, sizes, path)
     else:
         ex = np.zeros(n, np.uint8)

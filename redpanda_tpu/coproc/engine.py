@@ -634,11 +634,19 @@ class TpuEngine:
         if n == 0:
             launch._proj_ok = np.zeros(0, bool)
             return
+        # ONE JSON walk per record locates every referenced top-level field
+        # (rp_find_multi); predicate and projection extraction then gather
+        # from the span tables instead of re-walking per field
+        t0 = time.perf_counter()
+        cache = plan.build_find_cache(
+            exploded.joined, exploded.offsets, exploded.sizes
+        )
+        self._stat_add("t_find", time.perf_counter() - t0)
         if plan.dev_cols:
             t0 = time.perf_counter()
             n_pad = _bucket_rows(n)
             cols = plan.extract_device_inputs(
-                exploded.joined, exploded.offsets, exploded.sizes, n_pad
+                exploded.joined, exploded.offsets, exploded.sizes, n_pad, cache
             )
             self._stat_add("t_extract_pred", time.perf_counter() - t0)
             t0 = time.perf_counter()
@@ -659,7 +667,7 @@ class TpuEngine:
             launch._exploded = exploded
         else:
             data, ok = plan.extract_projection(
-                exploded.joined, exploded.offsets, exploded.sizes
+                exploded.joined, exploded.offsets, exploded.sizes, cache
             )
             launch._proj_data = data
             launch._proj_ok = ok

@@ -59,6 +59,27 @@ class _NativeLib:
                 ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
                 ctypes.c_void_p,
             ]
+        self.has_find_multi = hasattr(dll, "rp_find_multi")
+        if self.has_find_multi:
+            dll.rp_find_multi.restype = ctypes.c_int64
+            dll.rp_find_multi.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_char_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            dll.rp_gather_str.restype = None
+            dll.rp_gather_str.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            dll.rp_gather_num.restype = None
+            dll.rp_gather_num.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ]
         self.has_frame_many = hasattr(dll, "rp_frame_many")
         if self.has_frame_many:
             dll.rp_frame_many.restype = ctypes.c_int64
@@ -143,6 +164,69 @@ class _NativeLib:
             n, dst.ctypes.data, ctypes.byref(kept),
         )
         return dst[:length].tobytes(), kept.value
+
+    def find_multi(
+        self, joined, offsets: np.ndarray, sizes: np.ndarray, paths: list[str]
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """One top-level JSON walk per record locating ALL `paths`
+        (single-segment keys). Returns (types[n,k] i8, vs[n,k] i64,
+        ve[n,k] i64); type 0 = missing."""
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+        n = len(sizes)
+        k = len(paths)
+        encoded = [p.encode() for p in paths]
+        blob = b"".join(encoded)
+        path_off = np.zeros(k, dtype=np.int32)
+        path_len = np.fromiter((len(e) for e in encoded), np.int32, k)
+        np.cumsum(path_len[:-1], out=path_off[1:])
+        joined_arr = np.frombuffer(joined, dtype=np.uint8)
+        types = np.empty((n, k), dtype=np.int8)
+        vs = np.empty((n, k), dtype=np.int64)
+        ve = np.empty((n, k), dtype=np.int64)
+        self._dll.rp_find_multi(
+            joined_arr.ctypes.data, offsets.ctypes.data, sizes.ctypes.data, n,
+            blob, path_off.ctypes.data, path_len.ctypes.data, k,
+            types.ctypes.data, vs.ctypes.data, ve.ctypes.data,
+        )
+        return types, vs, ve
+
+    def gather_str(
+        self, joined, offsets, types_col, vs_col, ve_col, w: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        types_col = np.ascontiguousarray(types_col, dtype=np.int8)
+        vs_col = np.ascontiguousarray(vs_col, dtype=np.int64)
+        ve_col = np.ascontiguousarray(ve_col, dtype=np.int64)
+        n = len(offsets)
+        joined_arr = np.frombuffer(joined, dtype=np.uint8)
+        out = np.empty((n, w), dtype=np.uint8)
+        vlen = np.empty(n, dtype=np.int32)
+        self._dll.rp_gather_str(
+            joined_arr.ctypes.data, offsets.ctypes.data, n,
+            types_col.ctypes.data, vs_col.ctypes.data, ve_col.ctypes.data,
+            w, out.ctypes.data, vlen.ctypes.data,
+        )
+        return out, vlen
+
+    def gather_num(
+        self, joined, offsets, types_col, vs_col, ve_col
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        types_col = np.ascontiguousarray(types_col, dtype=np.int8)
+        vs_col = np.ascontiguousarray(vs_col, dtype=np.int64)
+        ve_col = np.ascontiguousarray(ve_col, dtype=np.int64)
+        n = len(offsets)
+        joined_arr = np.frombuffer(joined, dtype=np.uint8)
+        f32 = np.empty(n, dtype=np.float32)
+        i32 = np.empty(n, dtype=np.int32)
+        flags = np.empty(n, dtype=np.uint8)
+        self._dll.rp_gather_num(
+            joined_arr.ctypes.data, offsets.ctypes.data, n,
+            types_col.ctypes.data, vs_col.ctypes.data, ve_col.ctypes.data,
+            f32.ctypes.data, i32.ctypes.data, flags.ctypes.data,
+        )
+        return f32, i32, flags
 
     def frame_many(
         self,

@@ -400,3 +400,76 @@ class TestEngineColumnar:
             assert k in st, k
         assert st["bytes_d2h"] < st["bytes_h2d"]
         assert st["n_records"] == len(DOCS)
+
+
+class TestFindMultiParity:
+    """rp_find_multi + gathers (ONE JSON walk for all fields) must agree
+    with the per-path extractors on every corpus doc, including malformed
+    JSON, duplicate keys, escapes, and zero-size records."""
+
+    def _joined(self):
+        vals = _vals() + [
+            b'{"level":"error","level":"info","code":1}',  # dup keys
+            b'{"msg":"a\\"b\\\\","code":-3.5e2}',  # escapes + float
+            b'{"code":}',  # malformed value
+            b"not json at all",
+            b"",
+            b'{"other":{"level":"nested-not-top"},"level":"top"}',
+        ]
+        joined = b"".join(vals)
+        offsets = np.cumsum([0] + [len(v) for v in vals[:-1]]).astype(np.int64)
+        sizes = np.array([len(v) for v in vals], np.int32)
+        return joined, offsets, sizes
+
+    def test_gathers_match_per_path_extract(self):
+        from redpanda_tpu.native import lib
+
+        if lib is None or not getattr(lib, "has_find_multi", False):
+            pytest.skip("native find_multi unavailable")
+        joined, offsets, sizes = self._joined()
+        paths = ["level", "code", "msg", "other", "absent"]
+        types, vs, ve = lib.find_multi(joined, offsets, sizes, paths)
+        for i, p in enumerate(paths):
+            # string gather vs extract_str at two widths
+            for w in (8, 64):
+                gb, gv = lib.gather_str(joined, offsets, types[:, i], vs[:, i], ve[:, i], w)
+                eb, ev = lib.extract_str(joined, offsets, sizes, p, w)
+                assert (gv == ev).all(), (p, w)
+                assert (gb == eb).all(), (p, w)
+            # numeric gather vs extract_num
+            gf, gi, gfl = lib.gather_num(joined, offsets, types[:, i], vs[:, i], ve[:, i])
+            ef, ei, efl = lib.extract_num(joined, offsets, sizes, p)
+            assert (gfl == efl).all(), p
+            assert (gi == ei).all(), p
+            assert (gf == ef).all(), p
+            # exists
+            ge = (types[:, i] != 0).astype(np.uint8)
+            ee = lib.extract_exists(joined, offsets, sizes, p)
+            assert (ge == ee).all(), p
+
+    def test_plan_cache_end_to_end_parity(self):
+        """The full columnar plan produces identical device inputs and
+        projection columns with and without the find cache."""
+        from redpanda_tpu.coproc.column_plan import plan_spec
+        from redpanda_tpu.ops.transforms import Int, Str, map_project, where
+
+        spec = where(
+            (field("level") == "error") & (field("code") >= 0)
+        ) | map_project(Int("code"), Str("msg", 32))
+        plan = plan_spec(spec)
+        joined, offsets, sizes = self._joined()
+        cache = plan.build_find_cache(joined, offsets, sizes)
+        if cache is None:
+            pytest.skip("native find_multi unavailable")
+        n_pad = len(sizes)
+        with_c = plan.extract_device_inputs(joined, offsets, sizes, n_pad, cache)
+        without = plan.extract_device_inputs(joined, offsets, sizes, n_pad, None)
+        for a, b in zip(with_c, without):
+            assert (np.asarray(a) == np.asarray(b)).all()
+        dc, okc = plan.extract_projection(joined, offsets, sizes, cache)
+        dn, okn = plan.extract_projection(joined, offsets, sizes, None)
+        assert (okc == okn).all()
+        for ic, un in zip(dc, dn):
+            assert ic[0] == un[0]
+            for x, y in zip(ic[1:], un[1:]):
+                assert (np.asarray(x) == np.asarray(y)).all()
