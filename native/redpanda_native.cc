@@ -360,6 +360,40 @@ static int64_t skip_value(const uint8_t* s, int64_t i, int64_t end) {
   return i;
 }
 
+// Classify the value starting at s[i]; returns the type (0 missing,
+// 1 string, 2 number, 3 true, 4 false, 5 null, 6 object, 7 array) and
+// fills vs/ve (string extent excludes quotes). The ONE classification
+// used by rp_json_find and rp_find_multi alike.
+static int32_t classify_value(const uint8_t* s, int64_t i, int64_t end,
+                              int64_t* vs, int64_t* ve) {
+  if (i >= end) return 0;
+  uint8_t c = s[i];
+  if (c == '"') {
+    int64_t j = skip_string(s, i, end);
+    *vs = i + 1;
+    *ve = j - 1;
+    return 1;
+  }
+  if (c == '{') {
+    *vs = i;
+    *ve = skip_value(s, i, end);
+    return 6;
+  }
+  if (c == '[') {
+    *vs = i;
+    *ve = skip_value(s, i, end);
+    return 7;
+  }
+  int64_t j = skip_value(s, i, end);
+  *vs = i;
+  *ve = j;
+  int64_t tl = j - i;
+  if (tl == 4 && std::memcmp(s + i, "true", 4) == 0) return 3;
+  if (tl == 5 && std::memcmp(s + i, "false", 5) == 0) return 4;
+  if (tl == 4 && std::memcmp(s + i, "null", 4) == 0) return 5;
+  return 2;
+}
+
 // Locate dot-separated `path` in JSON object s[0:len]. Returns type
 // (0 missing, 1 string, 2 number, 3 true, 4 false, 5 null, 6 object,
 // 7 array) and value extent via vs/ve (string extent excludes quotes).
@@ -400,32 +434,7 @@ int32_t rp_json_find(const uint8_t* s, int64_t len, const char* path,
       seg_start = seg_end + 1;
       continue;  // descend: value must parse as an object
     }
-    if (i >= end) return 0;
-    uint8_t c = s[i];
-    if (c == '"') {
-      int64_t j = skip_string(s, i, end);
-      *vs = i + 1;
-      *ve = j - 1;
-      return 1;
-    }
-    if (c == '{') {
-      *vs = i;
-      *ve = skip_value(s, i, end);
-      return 6;
-    }
-    if (c == '[') {
-      *vs = i;
-      *ve = skip_value(s, i, end);
-      return 7;
-    }
-    int64_t j = skip_value(s, i, end);
-    *vs = i;
-    *ve = j;
-    int64_t tl = j - i;
-    if (tl == 4 && std::memcmp(s + i, "true", 4) == 0) return 3;
-    if (tl == 5 && std::memcmp(s + i, "false", 5) == 0) return 4;
-    if (tl == 4 && std::memcmp(s + i, "null", 4) == 0) return 5;
-    return 2;
+    return classify_value(s, i, end, vs, ve);
   }
 }
 
@@ -453,6 +462,10 @@ int64_t rp_extract_str(const uint8_t* joined, const int64_t* offsets,
       continue;
     }
     int64_t vlen = ve - vs;
+    // a record truncated inside an unterminated string yields ve < vs;
+    // clamp to an empty-but-present value (memcpy with (size_t)-1 would
+    // corrupt the heap)
+    if (vlen < 0) vlen = 0;
     if (vlen > (1 << 30)) vlen = 1 << 30;
     out_vlen[i] = (int32_t)vlen;
     int64_t cp = vlen < w ? vlen : w;
@@ -523,38 +536,6 @@ static void num_from_span(const uint8_t* rec, int32_t t, int64_t vs,
   } else {  // string/object/array
     *out_flags = RP_F_PRESENT;
   }
-}
-
-// Classify the value starting at s[i] exactly like rp_json_find's
-// last-segment logic; returns the type and fills vs/ve.
-static int32_t classify_value(const uint8_t* s, int64_t i, int64_t end,
-                              int64_t* vs, int64_t* ve) {
-  if (i >= end) return 0;
-  uint8_t c = s[i];
-  if (c == '"') {
-    int64_t j = skip_string(s, i, end);
-    *vs = i + 1;
-    *ve = j - 1;
-    return 1;
-  }
-  if (c == '{') {
-    *vs = i;
-    *ve = skip_value(s, i, end);
-    return 6;
-  }
-  if (c == '[') {
-    *vs = i;
-    *ve = skip_value(s, i, end);
-    return 7;
-  }
-  int64_t j = skip_value(s, i, end);
-  *vs = i;
-  *ve = j;
-  int64_t tl = j - i;
-  if (tl == 4 && std::memcmp(s + i, "true", 4) == 0) return 3;
-  if (tl == 5 && std::memcmp(s + i, "false", 5) == 0) return 4;
-  if (tl == 4 && std::memcmp(s + i, "null", 4) == 0) return 5;
-  return 2;
 }
 
 // Single pass over each record's TOP-LEVEL object: span tables for k
@@ -632,6 +613,7 @@ void rp_gather_str(const uint8_t* joined, const int64_t* offsets, int64_t n,
       continue;
     }
     int64_t vlen = ve[i] - vs[i];
+    if (vlen < 0) vlen = 0;  // unterminated string: empty-but-present
     if (vlen > (1 << 30)) vlen = 1 << 30;
     out_vlen[i] = (int32_t)vlen;
     int64_t cp = vlen < w ? vlen : w;

@@ -472,8 +472,10 @@ def _extract_str(joined, offsets, sizes, path, w, n_pad, cache=None):
             rec = joined[offsets[i] : offsets[i] + sizes[i]]
             t, vs, ve = E.json_find(rec, path)
             if t == 1:
-                v[i] = ve - vs
-                cp = min(ve - vs, w)
+                # ve < vs when the record is truncated inside an
+                # unterminated string: empty-but-present (native clamp)
+                v[i] = max(ve - vs, 0)
+                cp = min(v[i], w)
                 b[i, :cp] = np.frombuffer(rec[vs : vs + cp], np.uint8)
     if n_pad > n:
         b = np.concatenate([b, np.zeros((n_pad - n, w), np.uint8)])

@@ -473,3 +473,38 @@ class TestFindMultiParity:
             assert ic[0] == un[0]
             for x, y in zip(ic[1:], un[1:]):
                 assert (np.asarray(x) == np.asarray(y)).all()
+
+
+def test_truncated_string_value_does_not_corrupt():
+    """A record cut inside an unterminated string (b'{"a":"') used to make
+    the native extractors memcpy (size_t)-1 bytes — heap corruption. It
+    must read as an empty-but-present string everywhere."""
+    from redpanda_tpu.native import lib
+
+    vals = [b'{"a":"', b'{"a":"ok"}', b'{"a":']
+    joined = b"".join(vals)
+    offsets = np.cumsum([0] + [len(v) for v in vals[:-1]]).astype(np.int64)
+    sizes = np.array([len(v) for v in vals], np.int32)
+    if lib is not None:
+        b, v = lib.extract_str(joined, offsets, sizes, "a", 8)
+        assert v[0] == 0 and not b[0].any()  # empty-but-present
+        assert v[1] == 2 and bytes(b[1][:2]) == b"ok"
+        if getattr(lib, "has_find_multi", False):
+            types, vs, ve = lib.find_multi(joined, offsets, sizes, ["a"])
+            gb, gv = lib.gather_str(joined, offsets, types[:, 0], vs[:, 0], ve[:, 0], 8)
+            assert (gv == v).all() and (gb == b).all()
+    # python fallback path agrees
+    from redpanda_tpu.coproc.column_plan import _extract_str
+
+    class _NoLib:
+        pass
+
+    import redpanda_tpu.coproc.column_plan as cp
+
+    orig = cp._native
+    cp._native = lambda: None
+    try:
+        pb, pv = _extract_str(joined, offsets, sizes, "a", 8, len(sizes))
+    finally:
+        cp._native = orig
+    assert pv[0] == 0 and pv[1] == 2

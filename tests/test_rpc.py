@@ -238,3 +238,59 @@ def test_connection_cache_shard_assignment():
     cc = rpc.ConnectionCache(n_shards=8)
     shards = {cc.shard_for(n) for n in range(64)}
     assert shards <= set(range(8)) and len(shards) > 1
+
+
+def test_tron_style_soak_with_connection_churn():
+    """Soak the RPC stack the way the reference's tron echo tool does
+    (src/v/raft/tron): many concurrent echo clients hammer one server
+    while connections are periodically torn down mid-flight; every
+    response must match its request (correlation never crosses wires)
+    and the server must end the run with zero leaked connections."""
+
+    async def go():
+        server = rpc.Server()
+        proto = rpc.SimpleProtocol()
+        proto.register_service(rpc.ServiceHandler(echo_service, EchoImpl()))
+        server.set_protocol(proto)
+        await server.start()
+
+        N_CLIENTS = 8
+        OPS = 60
+        errors: list[str] = []
+
+        async def soak_client(cid: int):
+            rt = rpc.ReconnectTransport(
+                "127.0.0.1", server.port, rpc.BackoffPolicy(base_ms=1)
+            )
+            client = rpc.Client(echo_service, rt)
+            done = 0
+            for i in range(OPS):
+                text = f"c{cid}-{i}"
+                try:
+                    resp = await client.echo({"text": text})
+                    if resp["text"] != text:
+                        errors.append(f"cross-talk: sent {text} got {resp['text']}")
+                    done += 1
+                except (TransportClosed, RpcError, OSError):
+                    pass  # churn window: retried ops are not required
+                # churn: every 17th op this client drops its own socket
+                if i % 17 == 16:
+                    await rt.close()
+            await rt.close()
+            return done
+
+        totals = await asyncio.gather(*(soak_client(c) for c in range(N_CLIENTS)))
+        # all client sockets are closed: the server's connection handlers
+        # must all have drained (no leaked connection tasks)
+        for _ in range(50):
+            if not server._conn_tasks:
+                break
+            await asyncio.sleep(0.1)
+        leaked = len(server._conn_tasks)
+        await server.stop()
+        assert leaked == 0, f"{leaked} server connection task(s) leaked"
+        assert not errors, errors[:5]
+        # the vast majority of ops complete despite the churn
+        assert sum(totals) >= N_CLIENTS * OPS * 0.8, totals
+
+    asyncio.run(asyncio.wait_for(go(), 120))
