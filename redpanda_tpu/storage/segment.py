@@ -225,82 +225,85 @@ class Segment:
         them instead of silently skipping.
         """
         pos = start_pos if start_pos is not None else self.index.lookup(start_offset)
-        # bounded chunked reads off ONE handle instead of slurping the
-        # segment tail: a sequential consumer with a cursor reads only
-        # ~max_bytes per call. The window is a bytearray trimmed as frames
-        # are consumed, so a long filtered scan stays at ~chunk bytes
-        # resident instead of accumulating the whole span.
+        # bounded chunked reads (ONE handle, window trimmed as frames are
+        # consumed): a sequential consumer with a cursor reads ~max_bytes
+        # per call instead of slurping the segment tail
         chunk = max(min(max_bytes * 2, 8 << 20), 1 << 16)
+        out: list[RecordBatch] = []
+        taken = 0
+        kept_end = pos  # file offset just past the last KEPT batch
+        for batch, end_pos in self._frames_from(pos, chunk):
+            if max_offset is not None and batch.base_offset > max_offset:
+                break  # NOT consumed: cursor stays before this frame
+            if batch.last_offset < start_offset:
+                continue
+            if type_filter is not None and batch.header.type not in type_filter:
+                continue
+            # Runtime term context comes from the segment (the packed
+            # header carries no term; the reference derives it the same
+            # way, from the raft configuration tracking / segment naming)
+            batch.header.term = self.term
+            out.append(batch)
+            kept_end = end_pos
+            taken += batch.size_bytes
+            if taken >= max_bytes:
+                break
+        return out, kept_end
+
+    def _frames_from(self, pos: int, chunk: int):
+        """Yield (batch, end_file_pos) for each frame from file position
+        `pos`, reading the file in `chunk`-sized windows trimmed as frames
+        are consumed. A frame cut at EOF raises CorruptBatchError: appends
+        are whole-frame and recovery truncates torn tails at open, so a
+        partial frame is corruption, never a legitimate state."""
         self.flush_buffer()
         if self._file:
             self._file.flush()
-        out: list[RecordBatch] = []
-        taken = 0
-        base = pos  # file offset of blob[0]
-        at = 0  # decode position within blob
-        kept_end = pos  # file offset just past the last KEPT batch
         with open(self.data_path, "rb") as f:
             f.seek(pos)
             blob = bytearray(f.read(chunk))
+            base = pos  # file offset of blob[0]
+            at = 0  # decode position within blob
             while True:
                 if at >= chunk:
                     del blob[:at]
                     base += at
                     at = 0
-                # grow the window when the next frame runs past the buffer
                 if at + INTERNAL_HEADER_SIZE > len(blob):
                     more = f.read(chunk)
                     if not more:
                         if at < len(blob):
-                            # a complete frame can't be cut mid-header at
-                            # EOF legitimately (appends are whole-frame and
-                            # recovery truncates torn tails at open)
                             raise CorruptBatchError(
                                 f"partial batch header at EOF ({self.data_path}"
                                 f" pos {base + at})"
                             )
-                        break
+                        return
                     blob += more
                     continue
-                batch_size = RecordBatch.peek_size(blob, at)
-                if at + batch_size > len(blob):
+                frame_len = RecordBatch.peek_size(blob, at)
+                if at + frame_len > len(blob):
                     more = f.read(chunk)
                     if not more:
                         raise CorruptBatchError(
                             f"batch frame overruns EOF ({self.data_path} pos "
-                            f"{base + at}, size_bytes={batch_size})"
+                            f"{base + at}, size_bytes={frame_len})"
                         )
                     blob += more
                     continue
                 batch, consumed = RecordBatch.decode_internal(blob, at)
-                if max_offset is not None and batch.base_offset > max_offset:
-                    break  # NOT consumed: cursor stays before this frame
                 at += consumed
-                if batch.last_offset < start_offset:
-                    continue
-                if type_filter is not None and batch.header.type not in type_filter:
-                    continue
-                # Runtime term context comes from the segment (the packed
-                # header carries no term; the reference derives it the same
-                # way, from the raft configuration tracking / segment naming)
-                batch.header.term = self.term
-                out.append(batch)
-                kept_end = base + at
-                taken += batch.size_bytes
-                if taken >= max_bytes:
-                    break
-        return out, kept_end
+                yield batch, base + at
 
     def first_offset_with_ts(self, ts: int) -> int | None:
-        """First batch offset whose max_timestamp >= ts (index-accelerated)."""
+        """First batch offset whose max_timestamp >= ts (index-accelerated).
+
+        Bounded chunked reads via the shared frame iterator: a timequery
+        that resolves near the index point must not slurp the rest of the
+        segment file; corruption raises loudly like every read path."""
         pos = self.index.lookup_time(ts)
-        blob = self.read_from(pos)
-        at = 0
-        while at + INTERNAL_HEADER_SIZE <= len(blob):
-            batch, consumed = RecordBatch.decode_internal(blob, at)
+        for batch, _end in self._frames_from(pos, 1 << 20):
             if batch.header.max_timestamp >= ts:
                 return batch.base_offset
-            at += consumed
         return None
 
     def rebuild_index(self, blob: bytes | None = None):
