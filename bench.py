@@ -342,6 +342,14 @@ def main():
                 "vs_baseline": round(value / baseline, 2),
                 "baseline_cpu_single_core": round(baseline, 1),
                 "device": str(jax.devices()[0]),
+                # honest fallback marker: when the axon tunnel is
+                # unavailable the whole bench runs on the CPU device and
+                # the number is NOT a TPU measurement
+                **(
+                    {}
+                    if tpu_ok
+                    else {"device_note": "TPU tunnel unavailable; CPU-device fallback"}
+                ),
                 "partitions": P,
                 "records_per_batch": RECORDS_PER_BATCH,
                 "group_ticks_per_launch": GROUP,
