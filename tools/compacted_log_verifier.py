@@ -40,6 +40,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from redpanda_tpu.cli.rpk import _parse_brokers as _parse  # noqa: E402
+
 
 def _h(b: bytes | None) -> str:
     return "null" if b is None else hashlib.sha1(b).hexdigest()
@@ -176,14 +178,6 @@ async def cmd_verify(args) -> int:
     n_keys = sum(len(v) for v in got.values())
     print(f"verified {len(records)} surviving records, {n_keys} keys: OK")
     return 0
-
-
-def _parse(brokers: str) -> list[tuple[str, int]]:
-    out = []
-    for hp in brokers.split(","):
-        host, _, port = hp.strip().rpartition(":")
-        out.append((host, int(port)))
-    return out
 
 
 def main(argv=None) -> int:

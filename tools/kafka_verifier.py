@@ -1,0 +1,157 @@
+"""Black-box verifiable producer/consumer.
+
+Parity with the reference's tests/java/kafka-verifier (the ducktape
+suites' verifiable_producer/verifiable_consumer pair): a standalone tool
+that produces a self-describing sequenced workload over the Kafka API and
+later verifies, purely from what a consumer reads back, that
+
+1. every acked sequence number is present (no acked loss),
+2. per partition, sequence numbers are strictly increasing in offset
+   order (no reordering),
+3. duplicates are reported (at-least-once retries are legal but counted).
+
+Usage:
+  python tools/kafka_verifier.py produce --brokers h:p --topic t \
+      --partitions 4 --count 1000 --state /tmp/kv.json
+  python tools/kafka_verifier.py verify --brokers h:p --topic t \
+      --state /tmp/kv.json
+Exit code 0 = invariants hold, 1 = violation (details on stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+from redpanda_tpu.cli.rpk import _parse_brokers as _parse
+
+
+async def cmd_produce(args) -> int:
+    from redpanda_tpu.kafka.client.client import KafkaClient
+
+    c = await KafkaClient(_parse(args.brokers)).connect()
+    acked: dict[str, list[int]] = {str(p): [] for p in range(args.partitions)}
+    try:
+        for seq in range(args.count):
+            p = seq % args.partitions
+            value = b"kv-%010d" % seq
+            # acks=-1 with retry: an op only counts as acked when the
+            # produce RETURNS (the verifier's loss invariant is about
+            # acked writes, like the reference's verifiable producer).
+            # The client caches leaders/connections, so a failed attempt
+            # RECONNECTS before retrying — riding through failover is the
+            # point of the tool.
+            for attempt in range(8):
+                try:
+                    await c.produce(args.topic, p, [value], acks=-1)
+                    acked[str(p)].append(seq)
+                    break
+                except Exception:
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.3 * (attempt + 1))
+                    if attempt == 7:
+                        raise
+                    c = await KafkaClient(_parse(args.brokers)).connect()
+    finally:
+        try:
+            await c.close()
+        except Exception:
+            pass
+        # even on a fatal produce error, what WAS acked must be durable
+        # state — otherwise the loss invariant can never be checked
+        with open(args.state, "w") as f:
+            json.dump({"topic": args.topic, "acked": acked}, f)
+    n = sum(len(v) for v in acked.values())
+    print(f"produced+acked {n}/{args.count} -> {args.state}")
+    return 0
+
+
+async def cmd_verify(args) -> int:
+    from redpanda_tpu.kafka.client.client import KafkaClient
+
+    with open(args.state) as f:
+        state = json.load(f)
+    if state["topic"] != args.topic:
+        print(f"state is for topic {state['topic']!r}", file=sys.stderr)
+        return 1
+    c = await KafkaClient(_parse(args.brokers)).connect()
+    errors: list[str] = []
+    dupes = 0
+    try:
+        for p_str, acked in state["acked"].items():
+            p = int(p_str)
+            seen: list[int] = []
+            offset = 0
+            stalled = 0
+            while True:
+                batches, hwm = await c.fetch(args.topic, p, offset, max_wait_ms=50)
+                for b in batches:
+                    for r in b.records():
+                        v = r.value or b""
+                        if v.startswith(b"kv-"):
+                            seen.append(int(v[3:]))
+                if batches:
+                    offset = batches[-1].last_offset + 1
+                    stalled = 0
+                else:
+                    # a region of filtered control batches (or a transient
+                    # empty response) must not spin forever
+                    stalled += 1
+                    if stalled > 40:
+                        errors.append(
+                            f"p{p}: fetch stalled at offset {offset} (hwm {hwm})"
+                        )
+                        break
+                if offset >= hwm:
+                    break
+            seen_set = set(seen)
+            missing = [s for s in acked if s not in seen_set]
+            if missing:
+                errors.append(
+                    f"p{p}: {len(missing)} acked seq(s) lost, first {missing[:3]}"
+                )
+            # strictly increasing in offset order (dupes excepted, counted)
+            last = -1
+            for s in seen:
+                if s < last:
+                    errors.append(f"p{p}: reordering: {s} after {last}")
+                    break
+                last = s
+            dupes += len(seen) - len(seen_set)
+    finally:
+        await c.close()
+    if errors:
+        for e in errors:
+            print(f"VIOLATION: {e}", file=sys.stderr)
+        return 1
+    total = sum(len(v) for v in state["acked"].values())
+    print(f"verified {total} acked seqs: OK ({dupes} duplicate deliveries)")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name in ("produce", "verify"):
+        sp = sub.add_parser(name)
+        sp.add_argument("--brokers", required=True)
+        sp.add_argument("--topic", required=True)
+        sp.add_argument("--state", required=True)
+        if name == "produce":
+            sp.add_argument("--partitions", type=int, default=1)
+            sp.add_argument("--count", type=int, default=500)
+    args = p.parse_args(argv)
+    return asyncio.run({"produce": cmd_produce, "verify": cmd_verify}[args.cmd](args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
