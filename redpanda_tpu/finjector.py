@@ -97,6 +97,13 @@ class HoneyBadger:
             raise ProbeTriggered(f"{module}.{probe}")
         if effect == TERMINATE:
             raise SystemExit(f"honey badger terminate: {module}.{probe}")
+        if effect == DELAY:
+            # deliberate BLOCKING sleep: a delay fault at a sync site must
+            # actually delay (stalling the loop is the injected fault —
+            # this only ever runs with the badger explicitly enabled)
+            import time
+
+            time.sleep(self.delay_ms / 1000)
 
 
 honey_badger = HoneyBadger()

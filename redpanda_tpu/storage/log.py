@@ -19,10 +19,16 @@ import os
 import time
 from dataclasses import dataclass, field
 
+from redpanda_tpu.finjector import honey_badger
 from redpanda_tpu.models.fundamental import NTP
 from redpanda_tpu.models.record import RecordBatch
 from redpanda_tpu.storage.segment import Segment
 from redpanda_tpu.storage.recovery import recover_segment
+
+# storage failure probes (reference storage/failure_probes.h:24
+# log_failure_probes {append, roll, truncate}, driven over the admin
+# honey-badger API like tests/rptest services/honey_badger.py)
+honey_badger.register_probe("storage", "log_append", "log_roll", "log_truncate")
 
 
 @dataclass
@@ -154,6 +160,7 @@ class DiskLog:
             off = self.offsets()
             return AppendResult(off.dirty_offset + 1, off.dirty_offset, 0)
         async with self._lock:
+            honey_badger.inject_sync("storage", "log_append")
             if term is not None and term > self._term:
                 self._term = term
             seg = self._active_segment_for_append()
@@ -226,6 +233,7 @@ class DiskLog:
             and (time.monotonic() - self._active_created_at) >= self.config.segment_age_s
         )
         if too_big or too_old:
+            honey_badger.inject_sync("storage", "log_roll")
             seg.release_appender()
             new = Segment(self.dir, seg.dirty_offset + 1, self._term).create()
             self.segments.append(new)
@@ -339,6 +347,7 @@ class DiskLog:
     async def truncate(self, offset: int):
         """Drop everything at and after `offset` (suffix truncation)."""
         async with self._lock:
+            honey_badger.inject_sync("storage", "log_truncate")
             self._cache_invalidate(from_offset=offset)
             keep: list[Segment] = []
             for seg in self.segments:

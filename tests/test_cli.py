@@ -222,3 +222,24 @@ def test_iotune_unwritable_directory_fails_cleanly():
     assert r.returncode == 1
     assert "cannot characterize" in r.stderr
     assert "Traceback" not in r.stderr
+
+
+def test_microbench_runs_and_reports(tmp_path):
+    """tools/microbench.py (seastar perf-test analogue) emits one JSON
+    object of positive rates for every bench."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "microbench.py"),
+         "--secs", "0.05"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    expected = {
+        "crc32c_mb_s", "xxhash64_mb_s", "zstd_compress_mb_s",
+        "zstd_uncompress_mb_s", "batch_encode_per_s", "batch_decode_per_s",
+        "compaction_keyindex_keys_per_s", "allocator_assignments_per_s",
+        "rpc_echo_rtt_per_s",
+    }
+    assert expected <= set(out), out
+    assert all(v > 0 for v in out.values()), out

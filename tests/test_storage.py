@@ -637,3 +637,57 @@ def test_compaction_keeps_non_data_batches(ntp, cfg):
         await log.close()
 
     _run(main())
+
+
+def test_storage_failure_probes(tmp_path):
+    """storage/failure_probes.h analogue: armed honey-badger probes make
+    append/truncate fail at the probe site; disarming restores service;
+    the probes are listed under the 'storage' module for the admin API."""
+    from redpanda_tpu.finjector import ProbeTriggered, honey_badger
+
+    async def body():
+        assert {"log_append", "log_roll", "log_truncate"} <= set(
+            honey_badger.modules().get("storage", [])
+        )
+        log = await DiskLog.open(NTP.kafka("probe", 0), LogConfig(base_dir=str(tmp_path)))
+        honey_badger.enable()
+        try:
+            honey_badger.set_exception("storage", "log_append")
+            with pytest.raises(ProbeTriggered):
+                await log.append([_batch(1)])
+            honey_badger.unset("storage", "log_append")
+            await log.append([_batch(1)])  # service restored
+
+            honey_badger.set_exception("storage", "log_truncate")
+            with pytest.raises(ProbeTriggered):
+                await log.truncate(0)
+            honey_badger.unset("storage", "log_truncate")
+            await log.truncate(0)
+        finally:
+            honey_badger.disable()
+            await log.close()
+
+    _run(body())
+
+
+def test_storage_delay_probe_actually_delays(tmp_path):
+    """A DELAY effect armed on a sync storage probe must stall the op."""
+    import time as _time
+
+    from redpanda_tpu.finjector import honey_badger
+
+    async def body():
+        log = await DiskLog.open(NTP.kafka("dly", 0), LogConfig(base_dir=str(tmp_path)))
+        honey_badger.enable()
+        try:
+            honey_badger.delay_ms = 120
+            honey_badger.set_delay("storage", "log_append")
+            t0 = _time.perf_counter()
+            await log.append([_batch(1)])
+            assert _time.perf_counter() - t0 >= 0.1, "delay probe did not delay"
+        finally:
+            honey_badger.disable()
+            honey_badger.delay_ms = 50
+            await log.close()
+
+    _run(body())

@@ -1,0 +1,170 @@
+"""Component microbenchmarks.
+
+Parity with the reference's seastar perf tests (SURVEY §4.1: hashing
+hash_bench, compression zstd_stream_bench, storage compaction_idx_bench,
+rpc rpc_bench, cluster allocation_bench): each bench exercises one hot
+component in isolation and reports ops/s or MB/s as one JSON object on
+stdout. Run-it-yourself, like the reference's: `python tools/microbench.py
+[--secs 0.5] [--only crc32c,rpc_echo,...]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _rate(fn, secs: float, unit_per_call: float) -> float:
+    """Calls fn in a timed loop; returns units/sec."""
+    # warmup
+    fn()
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < secs:
+        fn()
+        n += 1
+    dt = time.perf_counter() - t0
+    return n * unit_per_call / dt
+
+
+def bench_crc32c(secs: float) -> dict:
+    from redpanda_tpu.hashing.crc32c import crc32c
+
+    blob = os.urandom(1 << 20)
+    mb_s = _rate(lambda: crc32c(blob), secs, 1.0)  # 1 MB per call
+    return {"crc32c_mb_s": round(mb_s, 1)}
+
+
+def bench_xxhash(secs: float) -> dict:
+    from redpanda_tpu.hashing.xx import xxhash64
+
+    blob = os.urandom(1 << 20)
+    return {"xxhash64_mb_s": round(_rate(lambda: xxhash64(blob), secs, 1.0), 1)}
+
+
+def bench_zstd_stream(secs: float) -> dict:
+    from redpanda_tpu.compression import compress, uncompress
+    from redpanda_tpu.models.record import Compression
+
+    rng = np.random.default_rng(7)
+    # compressible-ish payload (zstd_stream_bench uses realistic frames)
+    blob = bytes(rng.integers(0, 16, 1 << 20, dtype=np.uint8))
+    packed = compress(blob, Compression.zstd)
+    c = _rate(lambda: compress(blob, Compression.zstd), secs, 1.0)
+    d = _rate(lambda: uncompress(packed, Compression.zstd), secs, 1.0)
+    return {"zstd_compress_mb_s": round(c, 1), "zstd_uncompress_mb_s": round(d, 1)}
+
+
+def bench_batch_codec(secs: float) -> dict:
+    from redpanda_tpu.models.record import Record, RecordBatch
+
+    recs = [Record(offset_delta=i, value=b"x" * 256) for i in range(32)]
+    batch = RecordBatch.build(recs, base_offset=0)
+    wire = batch.encode_internal()
+    enc = _rate(lambda: RecordBatch.build(recs, base_offset=0).encode_internal(), secs, 1.0)
+    dec = _rate(lambda: RecordBatch.decode_internal(wire), secs, 1.0)
+    return {
+        "batch_encode_per_s": round(enc, 1),
+        "batch_decode_per_s": round(dec, 1),
+    }
+
+
+def bench_compaction_index(secs: float) -> dict:
+    """Key-index build rate (compaction_idx_bench shape)."""
+    from redpanda_tpu.storage.compaction import KeyLatestIndex
+
+    keys = [b"key-%06d" % (i % 4096) for i in range(10_000)]
+
+    def build():
+        idx = KeyLatestIndex(max_keys_in_memory=1 << 20)
+        for off, k in enumerate(keys):
+            idx.put(k, off)
+
+    return {"compaction_keyindex_keys_per_s": round(_rate(build, secs, len(keys)), 1)}
+
+
+def bench_allocation(secs: float) -> dict:
+    """Partition allocator throughput (allocation_bench shape)."""
+    from redpanda_tpu.cluster.allocator import PartitionAllocator
+
+    def alloc():
+        pa = PartitionAllocator()
+        for nid in range(5):
+            pa.register_node(nid)
+        for _ in range(16):
+            pa.allocate(6, 3)
+
+    return {"allocator_assignments_per_s": round(_rate(alloc, secs, 16 * 6), 1)}
+
+
+def bench_rpc_echo(secs: float) -> dict:
+    """Loopback RPC round trips (rpc_bench shape) over the real stack."""
+    from redpanda_tpu import rpc
+    from redpanda_tpu.rpc.transport import Transport
+
+    async def run() -> float:
+        from redpanda_tpu.rpc import serde
+
+        msg = serde.S(("text", serde.STRING))
+        svc = rpc.ServiceDef("bench", "echo", [rpc.MethodDef("echo", msg, msg)])
+
+        class Impl:
+            async def echo(self, req):
+                return {"text": req["text"]}
+
+        server = rpc.Server()
+        proto = rpc.SimpleProtocol()
+        proto.register_service(rpc.ServiceHandler(svc, Impl()))
+        server.set_protocol(proto)
+        await server.start()
+        t = Transport("127.0.0.1", server.port)
+        await t.connect()
+        client = rpc.Client(svc, t)
+        await client.echo({"text": "warm"})
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < secs:
+            await client.echo({"text": "ping"})
+            n += 1
+        dt = time.perf_counter() - t0
+        await t.close()
+        await server.stop()
+        return n / dt
+
+    return {"rpc_echo_rtt_per_s": round(asyncio.run(run()), 1)}
+
+
+BENCHES = {
+    "crc32c": bench_crc32c,
+    "xxhash": bench_xxhash,
+    "zstd_stream": bench_zstd_stream,
+    "batch_codec": bench_batch_codec,
+    "compaction_index": bench_compaction_index,
+    "allocation": bench_allocation,
+    "rpc_echo": bench_rpc_echo,
+}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--secs", type=float, default=0.5, help="time budget per bench")
+    p.add_argument("--only", help="comma-separated bench names")
+    args = p.parse_args(argv)
+    names = args.only.split(",") if args.only else list(BENCHES)
+    out: dict[str, float] = {}
+    for name in names:
+        out.update(BENCHES[name](args.secs))
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
