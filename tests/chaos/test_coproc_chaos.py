@@ -83,3 +83,78 @@ def test_transform_runs_cluster_wide(proc_cluster):
         await c.close()
 
     asyncio.run(asyncio.wait_for(body(), 240))
+
+
+def test_transform_survives_broker_kill(proc_cluster):
+    """wasm_redpanda_failure_recovery_test shape at process level: the
+    broker running a transform is SIGKILLed mid-stream and restarted; the
+    pacemaker resumes from its offset snapshot and every produced input
+    eventually appears transformed (at-least-once: dedup by payload)."""
+
+    async def body():
+        from .test_chaos import connect_live, kill_and_find_leader
+
+        cluster = proc_cluster
+        c = await KafkaClient(cluster.bootstrap()).connect()
+        await c.create_topic("fr", partitions=1, replication=3)
+
+        from redpanda_tpu.coproc import wasm_event
+        from redpanda_tpu.models.fundamental import COPROC_INTERNAL_TOPIC
+        from redpanda_tpu.ops.exprs import field
+        from redpanda_tpu.ops.transforms import Int, map_project, where
+
+        spec = where(field("level") == "error") | map_project(Int("code"))
+        rec = wasm_event.make_deploy_record("fr1", spec.to_json(), ["fr"])
+        await c.produce_batches(
+            COPROC_INTERNAL_TOPIC, 0, [wasm_event.deploy_batch([rec])]
+        )
+
+        def doc(code):
+            return json.dumps({"level": "error", "code": code}).encode()
+
+        async def materialized_codes(client) -> set[int]:
+            import struct
+
+            out: set[int] = set()
+            try:
+                batches, _ = await client.fetch("fr.$fr1$", 0, 0, max_wait_ms=100)
+            except Exception:
+                return out
+            for b in batches:
+                for r in b.records():
+                    if r.value and len(r.value) >= 4:
+                        out.add(struct.unpack_from("<i", r.value)[0])
+            return out
+
+        # phase A flows through the transform before the kill
+        await c.produce("fr", 0, [doc(i) for i in range(10)], acks=-1)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if set(range(10)) <= await materialized_codes(c):
+                break
+            await asyncio.sleep(0.5)
+        else:
+            raise AssertionError("phase A never materialized")
+
+        # SIGKILL the source partition's leader (it runs the pacemaker for
+        # p0), restart it, then produce phase B
+        killed = await kill_and_find_leader(cluster, c, "fr")
+        await asyncio.sleep(1.0)
+        await cluster.restart(killed)
+        c2 = await connect_live(cluster, "fr")
+        await c2.produce("fr", 0, [doc(100 + i) for i in range(10)], acks=-1)
+
+        want = set(range(10)) | {100 + i for i in range(10)}
+        deadline = time.monotonic() + 90
+        got: set[int] = set()
+        while time.monotonic() < deadline:
+            probe = await connect_live(cluster, "fr")
+            got = await materialized_codes(probe)
+            await probe.close()
+            if want <= got:
+                break
+            await asyncio.sleep(1.0)
+        await c2.close()
+        assert want <= got, f"missing transformed codes: {sorted(want - got)[:5]}"
+
+    asyncio.run(asyncio.wait_for(body(), 300))

@@ -679,6 +679,7 @@ def test_storage_delay_probe_actually_delays(tmp_path):
     async def body():
         log = await DiskLog.open(NTP.kafka("dly", 0), LogConfig(base_dir=str(tmp_path)))
         honey_badger.enable()
+        prev_delay = honey_badger.delay_ms
         try:
             honey_badger.delay_ms = 120
             honey_badger.set_delay("storage", "log_append")
@@ -687,7 +688,7 @@ def test_storage_delay_probe_actually_delays(tmp_path):
             assert _time.perf_counter() - t0 >= 0.1, "delay probe did not delay"
         finally:
             honey_badger.disable()
-            honey_badger.delay_ms = 50
+            honey_badger.delay_ms = prev_delay
             await log.close()
 
     _run(body())

@@ -158,7 +158,10 @@ def main(argv=None) -> int:
     p.add_argument("--secs", type=float, default=0.5, help="time budget per bench")
     p.add_argument("--only", help="comma-separated bench names")
     args = p.parse_args(argv)
-    names = args.only.split(",") if args.only else list(BENCHES)
+    names = [n.strip() for n in args.only.split(",")] if args.only else list(BENCHES)
+    unknown = [n for n in names if n not in BENCHES]
+    if unknown:
+        p.error(f"unknown bench(es) {unknown}; choose from {sorted(BENCHES)}")
     out: dict[str, float] = {}
     for name in names:
         out.update(BENCHES[name](args.secs))
