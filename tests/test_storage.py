@@ -692,3 +692,40 @@ def test_storage_delay_probe_actually_delays(tmp_path):
             await log.close()
 
     _run(body())
+
+
+def test_kvstore_opfuzz_vs_model(tmp_path):
+    """Randomized put/delete/snapshot/reopen interleaving against a dict
+    model (the storage/opfuzz posture applied to the kvstore's WAL +
+    snapshot machinery): after every reopen the store must equal the
+    model exactly."""
+    rng = np.random.default_rng(31337)
+    path = str(tmp_path / "kvf")
+    kv = KvStore(path).start()
+    model: dict[bytes, bytes] = {}
+    keys = [b"k%03d" % i for i in range(40)]
+    try:
+        for step in range(300):
+            op = rng.choice(["put", "delete", "snapshot", "reopen"], p=[0.6, 0.2, 0.1, 0.1])
+            if op == "put":
+                k = keys[int(rng.integers(len(keys)))]
+                v = rng.bytes(int(rng.integers(1, 64)))
+                kv.put(KeySpace.storage, k, v)
+                model[k] = v
+            elif op == "delete" and model:
+                k = list(model)[int(rng.integers(len(model)))]
+                kv.remove(KeySpace.storage, k)
+                del model[k]
+            elif op == "snapshot":
+                kv._do_snapshot()
+            elif op == "reopen":
+                kv.stop()
+                kv = KvStore(path).start()
+                for k in keys:
+                    assert kv.get(KeySpace.storage, k) == model.get(k), (step, k)
+        kv.stop()
+        kv = KvStore(path).start()
+        for k in keys:
+            assert kv.get(KeySpace.storage, k) == model.get(k)
+    finally:
+        kv.stop()
