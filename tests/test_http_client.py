@@ -377,3 +377,51 @@ def test_request_timeout():
         server.close()
 
     asyncio.run(go())
+
+
+def test_tls_round_trip_and_verification(tmp_path):
+    """HTTPS through the owned client: a CA-issued server cert verifies
+    against a context trusting that CA; default verification REJECTS the
+    untrusted CA; verify_tls=False permits it (debug posture)."""
+    import ssl
+
+    from test_tls import _issue, _make_ca
+
+    async def go():
+        ca_key, ca_cert, ca_path = _make_ca(tmp_path)
+        cert, key, _ = _issue(tmp_path, ca_key, ca_cert, "localhost", "srv")
+        server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        server_ctx.load_cert_chain(cert, key)
+
+        async def serve(reader, writer):
+            head = b""
+            while not head.endswith(b"\r\n\r\n"):
+                line = await reader.readline()
+                if not line:
+                    return
+                head += line
+            writer.write(b"HTTP/1.1 200 OK\r\ncontent-length: 6\r\n\r\nsecure")
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(serve, "localhost", 0, ssl=server_ctx)
+        port = server.sockets[0].getsockname()[1]
+
+        # trusted CA: verification succeeds
+        trust = ssl.create_default_context(cafile=ca_path)
+        async with HttpClient(f"https://localhost:{port}", ssl_context=trust) as c:
+            r = await c.request("GET", "/")
+            assert r.status == 200 and r.body == b"secure"
+
+        # default trust store: the test CA is unknown -> rejected
+        async with HttpClient(f"https://localhost:{port}") as c:
+            with pytest.raises(HttpError):
+                await c.request("GET", "/")
+
+        # explicit opt-out skips verification
+        async with HttpClient(f"https://localhost:{port}", verify_tls=False) as c:
+            r = await c.request("GET", "/")
+            assert r.body == b"secure"
+        server.close()
+
+    asyncio.run(go())

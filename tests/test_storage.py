@@ -719,7 +719,13 @@ def test_kvstore_opfuzz_vs_model(tmp_path):
             elif op == "snapshot":
                 kv._do_snapshot()
             elif op == "reopen":
-                kv.stop()
+                if rng.random() < 0.5:
+                    # CRASH reopen: drop the WAL handle without stop()'s
+                    # snapshot+truncate, so recovery must REPLAY the WAL
+                    kv._wal.close()
+                    kv._wal = None
+                else:
+                    kv.stop()  # clean reopen: snapshot-only recovery
                 kv = KvStore(path).start()
                 for k in keys:
                     assert kv.get(KeySpace.storage, k) == model.get(k), (step, k)
