@@ -94,14 +94,11 @@ class Application:
         from redpanda_tpu.syschecks import check_environment
 
         check_environment(c)
-        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-            # Operator asked for the CPU backend: ALSO drop the axon TPU
-            # backend factory. The plugin registers regardless of
-            # JAX_PLATFORMS, and an unhealthy tunnel would hang the coproc
-            # engine's first dispatch inside an otherwise CPU-only broker.
-            from redpanda_tpu.utils.platform import force_cpu_platform
+        # operator-pinned CPU backend also drops the axon factory, so an
+        # unhealthy device tunnel cannot hang this broker's engine
+        from redpanda_tpu.utils.platform import pin_cpu_if_requested
 
-            force_cpu_platform()
+        pin_cpu_if_requested()
         # rpk iotune's characterization, when present (io-config.json in the
         # data dir): published below as metrics for operators/dashboards
         from redpanda_tpu.config.io_config import load_io_config

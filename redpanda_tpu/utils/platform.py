@@ -12,6 +12,16 @@ from __future__ import annotations
 import os
 
 
+def pin_cpu_if_requested() -> None:
+    """When the operator set JAX_PLATFORMS=cpu, ALSO drop the axon TPU
+    backend factory: the plugin registers regardless of the env var, and
+    with an unhealthy device tunnel even cpu-backend jit can hang at
+    plugin discovery. One shared gate for every cpu-pinnable entry point
+    (broker startup, graft entries)."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        force_cpu_platform()
+
+
 def force_cpu_platform(n_virtual_devices: int | None = None) -> None:
     """Pin jax to the CPU backend; optionally request N virtual devices.
 
