@@ -277,14 +277,14 @@ class AdminServer:
         counts: dict[int, int] = {
             b.node_id: 0 for b in self.controller.members.all_brokers()
         }
+        # a decommissioning node is absent from all_brokers() but may still
+        # lead groups it should shed; it must count itself without KeyError
+        counts.setdefault(me, 0)
         led_here = []  # (ntp, consensus, replicas)
         for md in self.broker.topic_table.topics().values():
             for pa in md.assignments.values():
                 if pa.group < 0:
                     continue
-                leader = mdc.get_leader(pa.ntp) if mdc else pa.leader
-                if leader in counts:
-                    counts[leader] += 1
                 p = self.broker.partition_manager.get(pa.ntp)
                 consensus = getattr(p, "consensus", None)
                 if (
@@ -292,9 +292,21 @@ class AdminServer:
                     and p.is_leader()
                     and hasattr(consensus, "do_transfer_leadership")
                 ):
+                    # this node's own count comes from live raft state, NOT
+                    # the gossip cache: under load dissemination lags by
+                    # seconds, and a stale self-count makes the node believe
+                    # it is already at fair and refuse to shed
+                    counts[me] += 1
                     led_here.append((pa.ntp, consensus, list(pa.replicas)))
-        if not counts:
-            return web.json_response({"transferred": []})
+                else:
+                    leader = mdc.get_leader(pa.ntp) if mdc else pa.leader
+                    if leader == me:
+                        # gossip says we lead it but raft says we don't:
+                        # stale entry — we cannot know the real leader, so
+                        # leave it uncounted rather than inflate our count
+                        continue
+                    if leader in counts:
+                        counts[leader] += 1
         fair = max(1, round(sum(counts.values()) / len(counts)))
         transferred = []
         for ntp, consensus, replicas in led_here:

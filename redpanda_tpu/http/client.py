@@ -143,14 +143,23 @@ class HttpClient:
             raise HttpError("client closed")
         while self._idle:
             conn = self._idle.pop()
-            if conn.stale(self.max_idle_s) or conn.writer.is_closing():
+            # at_eof catches a peer half-close (server idle timeout shorter
+            # than ours) that writer.is_closing() cannot see
+            if (
+                conn.stale(self.max_idle_s)
+                or conn.writer.is_closing()
+                or conn.reader.at_eof()
+            ):
                 await conn.close()
                 continue
             return conn
+        return await self._dial()
+
+    async def _dial(self, timeout: float | None = None) -> _Conn:
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(self.host, self.port, ssl=self._ssl),
-                timeout=self.connect_timeout,
+                timeout=self.connect_timeout if timeout is None else timeout,
             )
         except (OSError, asyncio.TimeoutError) as e:
             self.probe.transport_errors += 1
@@ -194,12 +203,27 @@ class HttpClient:
             # reset before the response) is retried ONCE on a fresh dial —
             # but only for idempotent methods: a POST may have executed
             # server-side even though the response never arrived.
+            # request_timeout is one budget for the whole logical request:
+            # the retry attempt gets only what the first attempt left.
+            deadline = time.monotonic() + self.request_timeout
             for attempt in (0, 1):
-                conn = await self._checkout()
+                if attempt == 0:
+                    conn = await self._checkout()
+                else:
+                    budget = deadline - time.monotonic()
+                    if budget <= 0:
+                        self.probe.transport_errors += 1
+                        raise HttpError(
+                            f"request timeout ({self.request_timeout}s)"
+                        )
+                    # dial fresh for the retry — the pool may hold more
+                    # half-closed sockets from the same server idle-timeout
+                    # sweep (checkout's at_eof guard drops those lazily)
+                    conn = await self._dial(timeout=min(self.connect_timeout, budget))
                 try:
                     resp = await asyncio.wait_for(
                         self._round_trip(conn, method, path_qs, headers, body, chunked),
-                        timeout=self.request_timeout,
+                        timeout=max(0.001, deadline - time.monotonic()),
                     )
                 except (
                     HttpError,
@@ -276,34 +300,44 @@ class HttpClient:
     async def _read_response(
         self, reader: asyncio.StreamReader, method: str
     ) -> HttpResponse:
-        status_line = await reader.readline()
-        if not status_line:
-            raise asyncio.IncompleteReadError(b"", None)
-        parts = status_line.decode("latin-1").rstrip("\r\n").split(" ", 2)
-        if len(parts) < 2 or not parts[0].startswith("HTTP/1."):
-            raise HttpError(f"bad status line: {status_line!r}")
-        try:
-            status = int(parts[1])
-        except ValueError as e:
-            raise HttpError(f"bad status line: {status_line!r}") from e
-        reason = parts[2] if len(parts) > 2 else ""
-
-        headers: dict[str, str] = {}
-        total = len(status_line)
+        # RFC 9110 §15.2: interim 1xx responses may precede the final one;
+        # each is a bare status line + headers with no body. Loop until a
+        # final (>=200) status arrives — returning a 1xx would leave the
+        # real response unread and desync the keep-alive framing. `total`
+        # accumulates across interim messages so MAX_HEADER_BYTES bounds
+        # the whole exchange (a server streaming 100s forever fails fast).
+        total = 0
         while True:
-            line = await reader.readline()
-            total += len(line)
-            if total > MAX_HEADER_BYTES:
-                raise HttpError("header section too large")
-            if line in (b"\r\n", b"\n", b""):
+            status_line = await reader.readline()
+            if not status_line:
+                raise asyncio.IncompleteReadError(b"", None)
+            parts = status_line.decode("latin-1").rstrip("\r\n").split(" ", 2)
+            if len(parts) < 2 or not parts[0].startswith("HTTP/1."):
+                raise HttpError(f"bad status line: {status_line!r}")
+            try:
+                status = int(parts[1])
+            except ValueError as e:
+                raise HttpError(f"bad status line: {status_line!r}") from e
+            reason = parts[2] if len(parts) > 2 else ""
+
+            headers: dict[str, str] = {}
+            total += len(status_line)
+            while True:
+                line = await reader.readline()
+                total += len(line)
+                if total > MAX_HEADER_BYTES:
+                    raise HttpError("header section too large")
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode("latin-1").partition(":")
+                k = k.strip().lower()
+                v = v.strip()
+                headers[k] = f"{headers[k]}, {v}" if k in headers else v
+            if status >= 200:
                 break
-            k, _, v = line.decode("latin-1").partition(":")
-            k = k.strip().lower()
-            v = v.strip()
-            headers[k] = f"{headers[k]}, {v}" if k in headers else v
 
         body = b""
-        if method != "HEAD" and not (100 <= status < 200 or status in (204, 304)):
+        if method != "HEAD" and status not in (204, 304):
             if "chunked" in headers.get("transfer-encoding", "").lower():
                 body = await self._read_chunked(reader)
             elif "content-length" in headers:

@@ -379,6 +379,96 @@ def test_request_timeout():
     asyncio.run(go())
 
 
+def test_interim_1xx_responses_are_skipped():
+    """RFC 9110 §15.2: unsolicited 100/102 interim responses precede the
+    final one; the client must keep reading and the connection must stay
+    usable for the next request (framing not desynced)."""
+    async def go():
+        async with RawServer() as srv:
+            srv.responses.append(
+                b"HTTP/1.1 100 Continue\r\n\r\n"
+                b"HTTP/1.1 102 Processing\r\nx-hint: still-going\r\n\r\n"
+                b"HTTP/1.1 200 OK\r\ncontent-length: 5\r\n\r\nfinal"
+            )
+            srv.responses.append(b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok")
+            async with HttpClient(f"http://127.0.0.1:{srv.port}") as c:
+                r = await c.request("PUT", "/obj", body=b"x")
+                assert r.status == 200 and r.body == b"final"
+                # interim headers must not leak into the final response
+                assert r.header("x-hint") == ""
+                r2 = await c.request("GET", "/next")
+                assert r2.body == b"ok"
+            assert srv.connections == 1  # keep-alive framing survived
+
+    asyncio.run(go())
+
+
+def test_half_closed_pooled_socket_discarded_at_checkout():
+    """A server that closes an idle keep-alive socket (its idle timeout
+    shorter than ours) leaves writer.is_closing() False; checkout must see
+    reader.at_eof() and dial fresh instead of failing the request."""
+    async def go():
+        connections = 0
+
+        async def serve_then_idle_close(reader, writer):
+            nonlocal connections
+            connections += 1
+            head = b""
+            while not head.endswith(b"\r\n\r\n"):
+                line = await reader.readline()
+                if not line:
+                    return
+                head += line
+            writer.write(b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok")
+            await writer.drain()
+            writer.close()  # server-side idle sweep: half-close after reply
+
+        server = await asyncio.start_server(
+            serve_then_idle_close, "127.0.0.1", 0
+        )
+        port = server.sockets[0].getsockname()[1]
+        async with HttpClient(f"http://127.0.0.1:{port}") as c:
+            assert (await c.request("GET", "/a")).body == b"ok"
+            await asyncio.sleep(0.05)  # let the FIN arrive -> at_eof
+            assert (await c.request("GET", "/b")).body == b"ok"
+        assert connections == 2
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_retry_budget_is_shared_across_attempts():
+    """request_timeout bounds the LOGICAL request: a connection failure on
+    attempt 0 must not grant the retry a second full timeout."""
+    async def go():
+        calls = 0
+
+        async def reset_then_stall(reader, writer):
+            nonlocal calls
+            calls += 1
+            if calls == 1:
+                writer.close()  # connection-level failure -> retriable
+                return
+            try:
+                await asyncio.sleep(30)  # stall the retry
+            finally:
+                writer.close()
+
+        server = await asyncio.start_server(reset_then_stall, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        loop = asyncio.get_running_loop()
+        async with HttpClient(f"http://127.0.0.1:{port}", request_timeout=0.4) as c:
+            t0 = loop.time()
+            with pytest.raises(HttpError, match="timeout"):
+                await c.request("GET", "/x")
+            wall = loop.time() - t0
+        assert wall < 0.75, f"retry got a fresh timeout: {wall:.2f}s"
+        server.close()
+
+    asyncio.run(go())
+
+
 def test_tls_round_trip_and_verification(tmp_path):
     """HTTPS through the owned client: a CA-issued server cert verifies
     against a context trusting that CA; default verification REJECTS the
