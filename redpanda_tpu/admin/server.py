@@ -11,7 +11,7 @@ Parity with redpanda/admin_server.cc:
 - GET  /v1/failure-probes, PUT /v1/failure-probes/{m}/{p}/{type} (:948)
 - GET  /metrics                        (:148-151 prometheus)
 - GET  /v1/status/ready
-Served on aiohttp (the reference uses seastar httpd with swagger routes).
+Served on the owned HTTP server (the reference uses seastar httpd with swagger routes).
 """
 
 from __future__ import annotations
@@ -20,7 +20,7 @@ import asyncio
 import json
 import logging
 
-from aiohttp import web
+from redpanda_tpu.http import web
 
 from redpanda_tpu.finjector import honey_badger
 from redpanda_tpu.metrics import registry

@@ -1,10 +1,20 @@
-"""Owned async HTTP/1.1 client layer (reference src/v/http)."""
+"""Owned async HTTP/1.1 layer — client AND server (reference src/v/http
+for the client, pandaproxy/server.h + seastar httpd for the server)."""
 
+from redpanda_tpu.http import web
 from redpanda_tpu.http.client import (
     HttpClient,
     HttpError,
     HttpProbe,
     HttpResponse,
 )
+from redpanda_tpu.http.server import HttpServer
 
-__all__ = ["HttpClient", "HttpError", "HttpProbe", "HttpResponse"]
+__all__ = [
+    "HttpClient",
+    "HttpError",
+    "HttpProbe",
+    "HttpResponse",
+    "HttpServer",
+    "web",
+]

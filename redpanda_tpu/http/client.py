@@ -21,8 +21,14 @@ import time
 import urllib.parse
 from dataclasses import dataclass, field
 
+from redpanda_tpu.http.framing import (
+    MAX_HEADER_BYTES,
+    FramingError,
+    read_chunked,
+    read_header_block,
+)
+
 DEFAULT_CONNECT_TIMEOUT = 5.0  # http/client.h:63 default_connect_timeout = 5s
-MAX_HEADER_BYTES = 64 * 1024
 MAX_BODY_BYTES = 1 << 30
 
 
@@ -320,26 +326,21 @@ class HttpClient:
                 raise HttpError(f"bad status line: {status_line!r}") from e
             reason = parts[2] if len(parts) > 2 else ""
 
-            headers: dict[str, str] = {}
             total += len(status_line)
-            while True:
-                line = await reader.readline()
-                total += len(line)
-                if total > MAX_HEADER_BYTES:
-                    raise HttpError("header section too large")
-                if line in (b"\r\n", b"\n", b""):
-                    break
-                k, _, v = line.decode("latin-1").partition(":")
-                k = k.strip().lower()
-                v = v.strip()
-                headers[k] = f"{headers[k]}, {v}" if k in headers else v
+            try:
+                headers, total = await read_header_block(reader, total, eof_ends=True)
+            except FramingError as e:
+                raise HttpError(str(e)) from e
             if status >= 200:
                 break
 
         body = b""
         if method != "HEAD" and status not in (204, 304):
             if "chunked" in headers.get("transfer-encoding", "").lower():
-                body = await self._read_chunked(reader)
+                try:
+                    body = await read_chunked(reader, MAX_BODY_BYTES)
+                except FramingError as e:
+                    raise HttpError(str(e)) from e
             elif "content-length" in headers:
                 try:
                     n = int(headers["content-length"])
@@ -368,27 +369,3 @@ class HttpClient:
                 headers["connection"] = "close"
         self.probe.bytes_received += len(body)
         return HttpResponse(status, reason, headers, body)
-
-    async def _read_chunked(self, reader: asyncio.StreamReader) -> bytes:
-        """Chunked transfer decoding (http/chunk_encoding.h inverse)."""
-        out = bytearray()
-        while True:
-            size_line = await reader.readline()
-            if not size_line:
-                raise asyncio.IncompleteReadError(b"", None)
-            try:
-                size = int(size_line.split(b";", 1)[0].strip() or b"0", 16)
-            except ValueError as e:
-                raise HttpError(f"bad chunk size: {size_line!r}") from e
-            if size == 0:
-                # trailers until blank line
-                while True:
-                    t = await reader.readline()
-                    if t in (b"\r\n", b"\n", b""):
-                        return bytes(out)
-            if len(out) + size > MAX_BODY_BYTES:
-                raise HttpError("chunked body too large")
-            out += await reader.readexactly(size)
-            crlf = await reader.readexactly(2)
-            if crlf != b"\r\n":
-                raise HttpError(f"bad chunk terminator: {crlf!r}")

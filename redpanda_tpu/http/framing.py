@@ -1,0 +1,97 @@
+"""Shared HTTP/1.1 wire framing used by BOTH the owned client and the
+owned server (http/client.py, http/server.py).
+
+One implementation of header-block parsing and chunked transfer decoding
+so a framing fix can never land on one side only. The reference keeps the
+same split: http/chunk_encoding.h is shared by its client and the seastar
+httpd server path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+MAX_HEADER_BYTES = 64 * 1024
+
+
+class FramingError(Exception):
+    """Wire-level framing violation. The client surfaces it as HttpError,
+    the server as a 400 response."""
+
+
+class Headers(dict):
+    """Case-insensitive header mapping (stored lower-cased; callers may
+    look up 'Authorization' or 'authorization' interchangeably)."""
+
+    def __getitem__(self, key: str) -> str:
+        return super().__getitem__(key.lower())
+
+    def get(self, key: str, default=None):
+        return super().get(key.lower(), default)
+
+    def __contains__(self, key) -> bool:
+        return super().__contains__(str(key).lower())
+
+
+async def read_header_block(
+    reader: asyncio.StreamReader,
+    total: int,
+    *,
+    eof_ends: bool,
+) -> tuple[Headers, int]:
+    """Parse `k: v` lines up to the blank line. `total` counts bytes already
+    consumed of this message's head (request/status line) — and, on the
+    client, of preceding interim 1xx messages, so MAX_HEADER_BYTES bounds
+    the whole exchange; returns the updated count. `eof_ends=True` treats
+    EOF as end-of-headers (client posture for torn responses); False raises
+    (server posture — a request without its blank line is malformed)."""
+    headers = Headers()
+    while True:
+        line = await reader.readline()
+        total += len(line)
+        if total > MAX_HEADER_BYTES:
+            raise FramingError("header section too large")
+        if line in (b"\r\n", b"\n"):
+            break
+        if line == b"":
+            if eof_ends:
+                break
+            raise FramingError("eof in headers")
+        k, sep, v = line.decode("latin-1").partition(":")
+        if not sep:
+            raise FramingError("malformed header line")
+        k = k.strip().lower()
+        v = v.strip()
+        headers[k] = f"{headers[k]}, {v}" if k in headers else v
+    return headers, total
+
+
+async def read_chunked(reader: asyncio.StreamReader, max_bytes: int) -> bytes:
+    """Chunked transfer decoding (http/chunk_encoding.h inverse), strict:
+    a blank line where a chunk-size line belongs is a framing error, not a
+    terminal chunk — treating it as '0' would silently accept a truncated
+    body and desync keep-alive framing."""
+    out = bytearray()
+    while True:
+        size_line = await reader.readline()
+        if not size_line:
+            raise asyncio.IncompleteReadError(b"", None)
+        stripped = size_line.split(b";", 1)[0].strip()
+        if not stripped:
+            raise FramingError("blank chunk size line")
+        try:
+            size = int(stripped, 16)
+        except ValueError as e:
+            raise FramingError(f"bad chunk size: {size_line!r}") from e
+        if size == 0:
+            # trailers until blank line (EOF also terminates: the message
+            # is complete at the 0-chunk; trailers are optional metadata)
+            while True:
+                t = await reader.readline()
+                if t in (b"\r\n", b"\n", b""):
+                    return bytes(out)
+        if len(out) + size > max_bytes:
+            raise FramingError("chunked body too large")
+        out += await reader.readexactly(size)
+        if await reader.readexactly(2) != b"\r\n":
+            raise FramingError("bad chunk terminator")

@@ -1,0 +1,425 @@
+"""Owned async HTTP/1.1 server.
+
+Server twin of `http/client.py` — the reference owns both sides of its
+HTTP stack (seastar httpd under pandaproxy/server.h:40 `server`
+ctx/routes, admin_server.cc swagger routes); this is the tpu-native
+equivalent: an asyncio server that owns request-line/header parsing,
+Content-Length and chunked request bodies, 100-continue, keep-alive with
+an idle deadline, TLS, routing with `{param}` path templates, a
+middleware chain, and graceful shutdown. Admin API, REST proxy, and
+schema registry all serve on this (no third-party HTTP library).
+
+Handlers are `async def h(request) -> Response`. The `web` facade in
+`http/web.py` exposes the familiar route-table surface
+(`web.get(path, h)`, `web.json_response`, ...) on top of this module.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import re
+import ssl as ssl_mod
+import urllib.parse
+from dataclasses import dataclass
+from http import HTTPStatus
+
+from redpanda_tpu.http.framing import (
+    FramingError,
+    Headers,
+    read_chunked,
+    read_header_block,
+)
+
+MAX_BODY_BYTES = 256 * 1024 * 1024  # REST proxy produce payloads can be large
+IDLE_KEEPALIVE_S = 75.0
+# headers+body must arrive within this once the request line lands —
+# bounds slowloris-style dribble on admin/proxy ports
+REQUEST_READ_TIMEOUT_S = 120.0
+
+
+class BadRequest(Exception):
+    """Malformed wire input; connection answers 400 and closes."""
+
+
+# ----------------------------------------------------------------- request
+class Query:
+    """Read-only view of the query string (parse once, first value wins —
+    matches how the admin/proxy handlers consume repeated keys)."""
+
+    def __init__(self, raw: str) -> None:
+        self._raw = raw
+        self._d = {k: v[0] for k, v in urllib.parse.parse_qs(raw, keep_blank_values=True).items()}
+
+    def get(self, key: str, default: str | None = None) -> str | None:
+        return self._d.get(key, default)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._d
+
+    def __getitem__(self, key: str) -> str:
+        return self._d[key]
+
+    def items(self):
+        return self._d.items()
+
+
+class Request:
+    def __init__(
+        self,
+        method: str,
+        target: str,
+        version: str,
+        headers: "Headers",
+        body: bytes,
+        peername: tuple | None = None,
+    ) -> None:
+        self.method = method
+        self.version = version
+        self.raw_path = target  # path?query exactly as sent
+        path, _, qs = target.partition("?")
+        # routing matches on the RAW path (an encoded %2F must not split a
+        # {param} segment); params and .path are percent-decoded after
+        self.path_raw = path
+        self.path = urllib.parse.unquote(path)
+        self.query_string = qs
+        self.query = Query(qs)
+        self.headers = headers  # keys lower-cased, duplicates comma-joined
+        self.match_info: dict[str, str] = {}
+        self.peername = peername
+        self._body = body
+
+    # -- body accessors (async for handler-code symmetry with the client
+    # and so a future streaming-body server keeps the same handler API)
+    async def read(self) -> bytes:
+        return self._body
+
+    async def text(self) -> str:
+        return self._body.decode("utf-8")
+
+    async def json(self):
+        if not self._body:
+            return None
+        try:
+            return json.loads(self._body)
+        except ValueError as e:
+            raise BadRequest(f"invalid json body: {e}") from e
+
+    @property
+    def content_type(self) -> str:
+        return self.headers.get("content-type", "").partition(";")[0].strip()
+
+    @property
+    def can_read_body(self) -> bool:
+        return bool(self._body)
+
+
+# ---------------------------------------------------------------- response
+class Response:
+    def __init__(
+        self,
+        *,
+        status: int = 200,
+        body: bytes | None = None,
+        text: str | None = None,
+        headers: dict[str, str] | None = None,
+        content_type: str | None = None,
+        charset: str | None = None,
+    ) -> None:
+        self.status = status
+        if text is not None:
+            self.body = text.encode(charset or "utf-8")
+            if content_type is None:
+                content_type = "text/plain"
+        else:
+            self.body = body or b""
+        self.headers = dict(headers or {})
+        self.content_type = content_type
+        self.charset = charset
+
+
+def json_response(
+    data,
+    *,
+    status: int = 200,
+    headers: dict[str, str] | None = None,
+    content_type: str | None = None,  # e.g. application/vnd.kafka.v2+json
+) -> Response:
+    return Response(
+        status=status,
+        body=json.dumps(data).encode(),
+        headers=headers,
+        content_type=content_type or "application/json",
+        charset="utf-8",
+    )
+
+
+# ----------------------------------------------------------------- routing
+_PARAM_RE = re.compile(r"\{([a-zA-Z_][a-zA-Z0-9_]*)\}")
+
+
+@dataclass(frozen=True)
+class Route:
+    method: str
+    pattern: re.Pattern
+    handler: object
+    raw_path: str
+
+
+def compile_route(method: str, path: str, handler) -> Route:
+    """`/v1/partitions/kafka/{topic}/{partition}/x` -> anchored regex with
+    named groups; a param matches one path segment (no '/')."""
+    rx = "^" + _PARAM_RE.sub(lambda m: f"(?P<{m.group(1)}>[^/]+)", re.escape(path).replace(r"\{", "{").replace(r"\}", "}")) + "$"
+    return Route(method.upper(), re.compile(rx), handler, path)
+
+
+class Router:
+    def __init__(self) -> None:
+        self._routes: list[Route] = []
+
+    def add(self, route: Route) -> None:
+        self._routes.append(route)
+
+    def resolve(self, method: str, path: str) -> tuple[object | None, dict[str, str], bool]:
+        """-> (handler, params, path_known). path_known distinguishes
+        404 (no route at all) from 405 (path exists, method doesn't)."""
+        path_known = False
+        for r in self._routes:
+            m = r.pattern.match(path)
+            if m is None:
+                continue
+            path_known = True
+            if r.method == method or (method == "HEAD" and r.method == "GET"):
+                return r.handler, {k: urllib.parse.unquote(v) for k, v in m.groupdict().items()}, True
+        return None, {}, path_known
+
+
+# ------------------------------------------------------------------ server
+class HttpServer:
+    """One listener + routing + middleware chain + connection loop."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        middlewares: list | None = None,
+        logger: logging.Logger | None = None,
+        idle_timeout: float = IDLE_KEEPALIVE_S,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.router = Router()
+        self.middlewares = list(middlewares or [])
+        self.log = logger or logging.getLogger("rptpu.http.server")
+        self.idle_timeout = idle_timeout
+        self._server: asyncio.AbstractServer | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+
+    # -- route registration
+    def add_route(self, method: str, path: str, handler) -> None:
+        self.router.add(compile_route(method, path, handler))
+
+    # -- lifecycle
+    async def start(self, ssl_context: ssl_mod.SSLContext | None = None) -> "HttpServer":
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port, ssl=ssl_context
+        )
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # in-flight handlers get cancelled BEFORE wait_closed: on 3.12+
+        # Server.wait_closed blocks until every connection handler returns,
+        # and idle keep-alive loops would hold it for idle_timeout (the
+        # reference's httpd likewise aborts sockets on shutdown rather
+        # than draining indefinitely)
+        for t in list(self._conn_tasks):
+            t.cancel()
+        if self._conn_tasks:
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+        self._conn_tasks.clear()
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- connection loop
+    async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        try:
+            await self._serve_connection(reader, writer)
+        except (
+            asyncio.IncompleteReadError,
+            asyncio.TimeoutError,
+            ConnectionError,
+            ssl_mod.SSLError,
+        ):
+            pass  # peer went away / idle close: normal
+        except asyncio.CancelledError:
+            pass  # server stopping
+        except Exception:
+            self.log.exception("connection loop failed")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (OSError, asyncio.CancelledError):
+                pass
+
+    async def _serve_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername")
+        while True:
+            try:
+                req, keep_alive = await self._read_request(reader, writer, peer)
+            except ValueError as e:
+                # StreamReader line-limit overrun: a header/chunk line longer
+                # than the read buffer — same answer as an oversized section
+                await self._write_response(
+                    writer, False,
+                    json_response({"error": "header line too long"}, status=400),
+                    head_only=False,
+                )
+                return
+            except BadRequest as e:
+                await self._write_response(
+                    writer, False,
+                    json_response({"error": str(e)}, status=400), head_only=False,
+                )
+                return
+            if req is None:
+                return  # clean EOF between requests
+            resp = await self._dispatch(req)
+            try:
+                await self._write_response(
+                    writer, keep_alive, resp, head_only=req.method == "HEAD"
+                )
+            except (ConnectionError, OSError):
+                return
+            if not keep_alive:
+                return
+
+    async def _read_request(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter, peer
+    ) -> tuple[Request | None, bool]:
+        # idle deadline applies to waiting for the NEXT request line
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), timeout=self.idle_timeout)
+        except asyncio.TimeoutError:
+            return None, False
+        if not request_line:
+            return None, False
+        # once the request line lands, the rest of the message must arrive
+        # within the read deadline — a peer dribbling headers or never
+        # finishing its body (slowloris) must not pin the task forever
+        try:
+            return await asyncio.wait_for(
+                self._read_rest(reader, writer, peer, request_line),
+                timeout=REQUEST_READ_TIMEOUT_S,
+            )
+        except asyncio.TimeoutError as e:
+            raise BadRequest("request read timed out") from e
+        except FramingError as e:
+            raise BadRequest(str(e)) from e
+
+    async def _read_rest(
+        self, reader, writer, peer, request_line: bytes
+    ) -> tuple[Request, bool]:
+        try:
+            method, target, version = request_line.decode("latin-1").rstrip("\r\n").split(" ", 2)
+        except ValueError as e:
+            raise BadRequest("malformed request line") from e
+        method = method.upper()
+        if not version.startswith("HTTP/1."):
+            raise BadRequest(f"unsupported version {version!r}")
+
+        headers, _ = await read_header_block(reader, len(request_line), eof_ends=False)
+
+        # RFC 9110 §10.1.1: reply 100 Continue before the client commits
+        # the body (our own client doesn't send Expect; curl does on big PUTs)
+        if headers.get("expect", "").lower() == "100-continue":
+            writer.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+            await writer.drain()
+
+        body = b""
+        te = headers.get("transfer-encoding", "").lower()
+        if "chunked" in te:
+            body = await read_chunked(reader, MAX_BODY_BYTES)
+        elif te and te != "identity":
+            raise BadRequest(f"unsupported transfer-encoding {te!r}")
+        elif "content-length" in headers:
+            try:
+                n = int(headers["content-length"])
+            except ValueError as e:
+                raise BadRequest("bad content-length") from e
+            if n < 0 or n > MAX_BODY_BYTES:
+                raise BadRequest(f"content-length out of range: {n}")
+            if n:
+                body = await reader.readexactly(n)
+
+        keep_alive = (
+            headers.get("connection", "").lower() != "close"
+            if version == "HTTP/1.1"
+            else headers.get("connection", "").lower() == "keep-alive"
+        )
+        return Request(method, target, version, headers, body, peer), keep_alive
+
+    # -- dispatch
+    async def _dispatch(self, req: Request) -> Response:
+        handler, params, path_known = self.router.resolve(req.method, req.path_raw)
+        if handler is None:
+            if path_known:
+                return json_response({"error": "method not allowed"}, status=405)
+            return json_response({"error": f"unknown path {req.path}"}, status=404)
+        req.match_info = params
+
+        call = handler
+        # middleware chain, outermost first (signature:
+        # mw(request, handler) -> response)
+        for mw in reversed(self.middlewares):
+            call = _bind_middleware(mw, call)
+        try:
+            return await call(req)
+        except BadRequest as e:
+            return json_response({"error": str(e)}, status=400)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.log.exception("%s %s handler failed", req.method, req.path)
+            return json_response({"error": "internal server error"}, status=500)
+
+    async def _write_response(
+        self,
+        writer: asyncio.StreamWriter,
+        keep_alive: bool,
+        resp: Response,
+        *,
+        head_only: bool,
+    ) -> None:
+        reason = HTTPStatus(resp.status).phrase if resp.status in HTTPStatus._value2member_map_ else ""
+        hdrs = {k.lower(): v for k, v in resp.headers.items()}
+        if resp.content_type is not None and "content-type" not in hdrs:
+            ct = resp.content_type
+            if resp.charset:
+                ct += f"; charset={resp.charset}"
+            hdrs["content-type"] = ct
+        hdrs["content-length"] = str(len(resp.body))
+        hdrs["connection"] = "keep-alive" if keep_alive else "close"
+        head = f"HTTP/1.1 {resp.status} {reason}\r\n"
+        head += "".join(f"{k}: {v}\r\n" for k, v in hdrs.items())
+        head += "\r\n"
+        writer.write(head.encode("latin-1") + (b"" if head_only else resp.body))
+        await writer.drain()
+
+
+def _bind_middleware(mw, nxt):
+    async def bound(request: Request) -> Response:
+        return await mw(request, nxt)
+
+    return bound

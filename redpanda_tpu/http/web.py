@@ -1,0 +1,98 @@
+"""Route-table facade over the owned HTTP server (`http/server.py`).
+
+The admin API, REST proxy, and schema registry declare their surfaces as
+route tables (`web.get(path, handler)`, handlers returning
+`web.json_response(...)`) — the same shape the reference declares in its
+api-doc JSON + seastar httpd route registrations (pandaproxy/server.h:40,
+admin_server.cc). This module maps that declaration style onto the owned
+`HttpServer`; no third-party HTTP library is involved.
+"""
+
+from __future__ import annotations
+
+import ssl as ssl_mod
+from dataclasses import dataclass
+
+from redpanda_tpu.http.server import (  # noqa: F401  (re-exported surface)
+    BadRequest,
+    HttpServer,
+    Request,
+    Response,
+    json_response,
+)
+
+
+@dataclass(frozen=True)
+class RouteDef:
+    method: str
+    path: str
+    handler: object
+
+
+def get(path: str, handler) -> RouteDef:
+    return RouteDef("GET", path, handler)
+
+
+def post(path: str, handler) -> RouteDef:
+    return RouteDef("POST", path, handler)
+
+
+def put(path: str, handler) -> RouteDef:
+    return RouteDef("PUT", path, handler)
+
+
+def delete(path: str, handler) -> RouteDef:
+    return RouteDef("DELETE", path, handler)
+
+
+def middleware(fn):
+    """Marker for middleware callables `mw(request, handler) -> response`
+    (kept for declaration-site readability; the chain binds by position)."""
+    return fn
+
+
+class Application:
+    """A route table + middleware list, served by `AppRunner`."""
+
+    def __init__(self, middlewares: list | None = None) -> None:
+        self.middlewares = list(middlewares or [])
+        self.routes: list[RouteDef] = []
+
+    def add_routes(self, routes: list[RouteDef]) -> None:
+        self.routes.extend(routes)
+
+
+class AppRunner:
+    """Owns the listening `HttpServer` for one Application."""
+
+    def __init__(self, app: Application, access_log=None) -> None:
+        self.app = app
+        self._server: HttpServer | None = None
+
+    async def setup(self) -> None:  # split start kept for lifecycle parity
+        pass
+
+    async def listen(
+        self,
+        host: str,
+        port: int,
+        ssl_context: ssl_mod.SSLContext | None = None,
+        logger=None,
+    ) -> int:
+        srv = HttpServer(host, port, middlewares=self.app.middlewares, logger=logger)
+        for r in self.app.routes:
+            srv.add_route(r.method, r.path, r.handler)
+        await srv.start(ssl_context=ssl_context)
+        self._server = srv
+        return srv.port
+
+    @property
+    def addresses(self) -> list[tuple[str, int]]:
+        if self._server is None:
+            return []
+        return [(self._server.host, self._server.port)]
+
+    async def cleanup(self) -> None:
+        if self._server is not None:
+            await self._server.stop()
+            self._server = None

@@ -2,7 +2,7 @@
 
 Both are pure Kafka clients of the local broker (the reference's proxy is
 an in-proc kafka::client user — pandaproxy/rest, schema_registry share
-``pandaproxy::server``); here each is an aiohttp app over the embedded
+``pandaproxy::server``); here each is an owned-HTTP-server app over the embedded
 ``KafkaClient``.
 """
 

@@ -20,7 +20,7 @@ import base64
 import logging
 import uuid
 
-from aiohttp import web
+from redpanda_tpu.http import web
 
 from redpanda_tpu.kafka.client.client import KafkaClient
 from redpanda_tpu.kafka.client.consumer import GroupConsumer

@@ -19,7 +19,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from aiohttp import web
+from redpanda_tpu.http import web
 
 from redpanda_tpu.kafka.client.client import KafkaClient
 from redpanda_tpu.pandaproxy.schema_registry import avro_compat

@@ -117,7 +117,7 @@ class S3Client:
         access_key: str = "",
         secret_key: str = "",
         request_timeout: float = 300.0,  # whole-round-trip bound; sized for
-        # full segment uploads on slow links (aiohttp's old default total)
+        # full segment uploads on slow links
     ) -> None:
         self.bucket = bucket
         self.region = region

@@ -1,14 +1,16 @@
-"""Shared aiohttp server lifecycle (admin, REST proxy, schema registry).
+"""Shared HTTP server lifecycle (admin, REST proxy, schema registry).
 
-One place for runner setup, ephemeral-port resolution, and the listen log —
-the reference's analogous shared piece is ``pandaproxy::server``.
+One place for listener setup, ephemeral-port resolution, and the listen
+log — the reference's analogous shared piece is ``pandaproxy::server``.
+Serves on the OWNED HTTP/1.1 server (redpanda_tpu/http/server.py); no
+third-party HTTP library.
 """
 
 from __future__ import annotations
 
 import logging
 
-from aiohttp import web
+from redpanda_tpu.http import web
 
 
 async def start_site(
@@ -21,9 +23,6 @@ async def start_site(
 ) -> tuple[web.AppRunner, int]:
     runner = web.AppRunner(app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, host, port, ssl_context=ssl_context)
-    await site.start()
-    if port == 0:
-        port = runner.addresses[0][1]
+    port = await runner.listen(host, port, ssl_context=ssl_context, logger=logger)
     logger.info("%s listening on %s:%d", name, host, port)
     return runner, port

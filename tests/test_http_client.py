@@ -113,6 +113,22 @@ def test_chunked_response_with_trailers():
     asyncio.run(go())
 
 
+def test_blank_chunk_size_line_rejected():
+    """Strict chunked decoding: a blank size line is a framing error, not
+    an implicit terminal chunk (would silently truncate the body)."""
+    async def go():
+        async with RawServer() as srv:
+            srv.responses.append(
+                b"HTTP/1.1 200 OK\r\ntransfer-encoding: chunked\r\n\r\n"
+                b"4\r\nwiki\r\n\r\n"
+            )
+            async with HttpClient(f"http://127.0.0.1:{srv.port}") as c:
+                with pytest.raises(HttpError, match="blank chunk"):
+                    await c.request("GET", "/trunc")
+
+    asyncio.run(go())
+
+
 def test_keepalive_reuses_connection():
     async def go():
         async with RawServer() as srv:
