@@ -61,7 +61,7 @@ def _register(api: Api) -> Api:
 
 # ------------------------------------------------------------------ produce
 produce = _register(_api(
-    PRODUCE, "produce", 0, 7,
+    PRODUCE, "produce", 0, 8,
     request=[
         F("transactional_id", T.NULLABLE_STRING, min_v=3),
         F("acks", T.INT16),
@@ -83,6 +83,11 @@ produce = _register(_api(
                 F("base_offset", T.INT64),
                 F("log_append_time_ms", T.INT64, min_v=2, default=-1),
                 F("log_start_offset", T.INT64, min_v=5),
+                F("record_errors", Array((
+                    F("batch_index", T.INT32),
+                    F("batch_index_error_message", T.NULLABLE_STRING),
+                )), min_v=8),
+                F("error_message", T.NULLABLE_STRING, min_v=8),
             ))),
         ))),
         F("throttle_time_ms", T.INT32, min_v=1),
@@ -141,7 +146,7 @@ fetch = _register(_api(
 
 # ------------------------------------------------------------------ list_offsets
 list_offsets = _register(_api(
-    LIST_OFFSETS, "list_offsets", 0, 4,
+    LIST_OFFSETS, "list_offsets", 0, 5,
     request=[
         F("replica_id", T.INT32, default=-1),
         F("isolation_level", T.INT8, min_v=2),
@@ -173,12 +178,14 @@ list_offsets = _register(_api(
 
 # ------------------------------------------------------------------ metadata
 metadata = _register(_api(
-    METADATA, "metadata", 0, 7,
+    METADATA, "metadata", 0, 9, flexible_since=9,
     request=[
         F("topics", Array((
             F("name", T.STRING),
         ), nullable=True)),
         F("allow_auto_topic_creation", T.BOOL, min_v=4, default=True),
+        F("include_cluster_authorized_operations", T.BOOL, min_v=8),
+        F("include_topic_authorized_operations", T.BOOL, min_v=8),
     ],
     response=[
         F("throttle_time_ms", T.INT32, min_v=3),
@@ -203,13 +210,15 @@ metadata = _register(_api(
                 F("isr_nodes", Array(T.INT32)),
                 F("offline_replicas", Array(T.INT32), min_v=5),
             ))),
+            F("topic_authorized_operations", T.INT32, min_v=8, default=-2147483648),
         ))),
+        F("cluster_authorized_operations", T.INT32, min_v=8, default=-2147483648),
     ],
 ))
 
 # ------------------------------------------------------------------ offset_commit
 offset_commit = _register(_api(
-    OFFSET_COMMIT, "offset_commit", 0, 7,
+    OFFSET_COMMIT, "offset_commit", 0, 8, flexible_since=8,
     request=[
         F("group_id", T.STRING),
         F("generation_id", T.INT32, min_v=1, default=-1),
@@ -241,7 +250,7 @@ offset_commit = _register(_api(
 
 # ------------------------------------------------------------------ offset_fetch
 offset_fetch = _register(_api(
-    OFFSET_FETCH, "offset_fetch", 0, 5,
+    OFFSET_FETCH, "offset_fetch", 0, 6, flexible_since=6,
     request=[
         F("group_id", T.STRING),
         F("topics", Array((
@@ -267,7 +276,7 @@ offset_fetch = _register(_api(
 
 # ------------------------------------------------------------------ find_coordinator
 find_coordinator = _register(_api(
-    FIND_COORDINATOR, "find_coordinator", 0, 2,
+    FIND_COORDINATOR, "find_coordinator", 0, 3, flexible_since=3,
     request=[
         F("key", T.STRING),
         F("key_type", T.INT8, min_v=1),
@@ -284,7 +293,7 @@ find_coordinator = _register(_api(
 
 # ------------------------------------------------------------------ group membership
 join_group = _register(_api(
-    JOIN_GROUP, "join_group", 0, 5,
+    JOIN_GROUP, "join_group", 0, 6, flexible_since=6,
     request=[
         F("group_id", T.STRING),
         F("session_timeout_ms", T.INT32),
@@ -313,7 +322,7 @@ join_group = _register(_api(
 ))
 
 heartbeat = _register(_api(
-    HEARTBEAT, "heartbeat", 0, 3,
+    HEARTBEAT, "heartbeat", 0, 4, flexible_since=4,
     request=[
         F("group_id", T.STRING),
         F("generation_id", T.INT32),
@@ -327,7 +336,7 @@ heartbeat = _register(_api(
 ))
 
 leave_group = _register(_api(
-    LEAVE_GROUP, "leave_group", 0, 3,
+    LEAVE_GROUP, "leave_group", 0, 4, flexible_since=4,
     request=[
         F("group_id", T.STRING),
         F("member_id", T.STRING, max_v=2),
@@ -348,7 +357,7 @@ leave_group = _register(_api(
 ))
 
 sync_group = _register(_api(
-    SYNC_GROUP, "sync_group", 0, 3,
+    SYNC_GROUP, "sync_group", 0, 4, flexible_since=4,
     request=[
         F("group_id", T.STRING),
         F("generation_id", T.INT32),
@@ -367,9 +376,10 @@ sync_group = _register(_api(
 ))
 
 describe_groups = _register(_api(
-    DESCRIBE_GROUPS, "describe_groups", 0, 2,
+    DESCRIBE_GROUPS, "describe_groups", 0, 5, flexible_since=5,
     request=[
         F("groups", Array(T.STRING)),
+        F("include_authorized_operations", T.BOOL, min_v=3),
     ],
     response=[
         F("throttle_time_ms", T.INT32, min_v=1),
@@ -381,17 +391,19 @@ describe_groups = _register(_api(
             F("protocol_data", T.STRING),
             F("members", Array((
                 F("member_id", T.STRING),
+                F("group_instance_id", T.NULLABLE_STRING, min_v=4),
                 F("client_id", T.STRING),
                 F("client_host", T.STRING),
                 F("member_metadata", T.BYTES),
                 F("member_assignment", T.BYTES),
             ))),
+            F("authorized_operations", T.INT32, min_v=3, default=-2147483648),
         ))),
     ],
 ))
 
 list_groups = _register(_api(
-    LIST_GROUPS, "list_groups", 0, 2,
+    LIST_GROUPS, "list_groups", 0, 3, flexible_since=3,
     request=[],
     response=[
         F("throttle_time_ms", T.INT32, min_v=1),
@@ -404,7 +416,7 @@ list_groups = _register(_api(
 ))
 
 delete_groups = _register(_api(
-    DELETE_GROUPS, "delete_groups", 0, 1,
+    DELETE_GROUPS, "delete_groups", 0, 2, flexible_since=2,
     request=[
         F("groups_names", Array(T.STRING)),
     ],
@@ -440,8 +452,11 @@ sasl_authenticate = _register(_api(
 
 # ------------------------------------------------------------------ api_versions
 api_versions = _register(_api(
-    API_VERSIONS, "api_versions", 0, 2,
-    request=[],
+    API_VERSIONS, "api_versions", 0, 3, flexible_since=3,
+    request=[
+        F("client_software_name", T.STRING, min_v=3),
+        F("client_software_version", T.STRING, min_v=3),
+    ],
     response=[
         F("error_code", T.INT16),
         F("api_keys", Array((
@@ -455,7 +470,7 @@ api_versions = _register(_api(
 
 # ------------------------------------------------------------------ topic admin
 create_topics = _register(_api(
-    CREATE_TOPICS, "create_topics", 0, 4,
+    CREATE_TOPICS, "create_topics", 0, 5, flexible_since=5,
     request=[
         F("topics", Array((
             F("name", T.STRING),
@@ -479,12 +494,22 @@ create_topics = _register(_api(
             F("name", T.STRING),
             F("error_code", T.INT16),
             F("error_message", T.NULLABLE_STRING, min_v=1),
+            F("topic_config_error_code", T.INT16, min_v=5, tag=0),
+            F("num_partitions", T.INT32, min_v=5, default=-1),
+            F("replication_factor", T.INT16, min_v=5, default=-1),
+            F("configs", Array((
+                F("name", T.STRING),
+                F("value", T.NULLABLE_STRING),
+                F("read_only", T.BOOL),
+                F("config_source", T.INT8, default=-1),
+                F("is_sensitive", T.BOOL),
+            ), nullable=True), min_v=5),
         ))),
     ],
 ))
 
 delete_topics = _register(_api(
-    DELETE_TOPICS, "delete_topics", 0, 3,
+    DELETE_TOPICS, "delete_topics", 0, 4, flexible_since=4,
     request=[
         F("topic_names", Array(T.STRING)),
         F("timeout_ms", T.INT32),
@@ -524,7 +549,7 @@ delete_records = _register(_api(
 ))
 
 create_partitions = _register(_api(
-    CREATE_PARTITIONS, "create_partitions", 0, 1,
+    CREATE_PARTITIONS, "create_partitions", 0, 3, flexible_since=2,
     request=[
         F("topics", Array((
             F("name", T.STRING),
@@ -606,7 +631,7 @@ alter_configs = _register(_api(
 ))
 
 incremental_alter_configs = _register(_api(
-    INCREMENTAL_ALTER_CONFIGS, "incremental_alter_configs", 0, 0,
+    INCREMENTAL_ALTER_CONFIGS, "incremental_alter_configs", 0, 1, flexible_since=1,
     request=[
         F("resources", Array((
             F("resource_type", T.INT8),
@@ -745,7 +770,7 @@ delete_acls = _register(_api(
 
 # ------------------------------------------------------------------ transactions
 init_producer_id = _register(_api(
-    INIT_PRODUCER_ID, "init_producer_id", 0, 1,
+    INIT_PRODUCER_ID, "init_producer_id", 0, 2, flexible_since=2,
     request=[
         F("transactional_id", T.NULLABLE_STRING),
         F("transaction_timeout_ms", T.INT32),
@@ -759,7 +784,7 @@ init_producer_id = _register(_api(
 ))
 
 add_partitions_to_txn = _register(_api(
-    ADD_PARTITIONS_TO_TXN, "add_partitions_to_txn", 0, 1,
+    ADD_PARTITIONS_TO_TXN, "add_partitions_to_txn", 0, 3, flexible_since=3,
     request=[
         F("transactional_id", T.STRING),
         F("producer_id", T.INT64),
@@ -796,7 +821,7 @@ add_offsets_to_txn = _register(_api(
 ))
 
 end_txn = _register(_api(
-    END_TXN, "end_txn", 0, 1,
+    END_TXN, "end_txn", 0, 3, flexible_since=3,
     request=[
         F("transactional_id", T.STRING),
         F("producer_id", T.INT64),

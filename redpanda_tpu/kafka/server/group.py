@@ -419,6 +419,9 @@ class Group:
             "members": [
                 {
                     "member_id": m.member_id,
+                    # v4+ exposes static membership; the encoder drops the
+                    # key below that version
+                    "group_instance_id": m.group_instance_id,
                     "client_id": m.client_id,
                     "client_host": m.client_host,
                     "member_metadata": m.metadata_for(self.protocol or ""),

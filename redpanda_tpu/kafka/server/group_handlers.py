@@ -292,13 +292,21 @@ async def handle_describe_groups(ctx) -> dict:
             continue
         g = gm.get(gid)
         if g is None:
-            groups.append({
+            entry = {
                 "error_code": 0,
                 "group_id": gid, "group_state": GroupState.dead.value,
                 "protocol_type": "", "protocol_data": "", "members": [],
-            })
+            }
         else:
-            groups.append(g.describe())
+            entry = g.describe()
+        if ctx.api_version >= 3 and ctx.request.get("include_authorized_operations"):
+            # KIP-430 bitfield; only for groups the caller may describe
+            from redpanda_tpu.kafka.server.handlers import authorized_operations
+
+            entry["authorized_operations"] = authorized_operations(
+                ctx, ResourceType.group, gid
+            )
+        groups.append(entry)
     return {"throttle_time_ms": 0, "groups": groups}
 
 

@@ -30,6 +30,38 @@ def _authorized(ctx, op: AclOperation, topic: str) -> bool:
     return authorize(ctx, ResourceType.topic, topic, op)
 
 
+# KIP-430: ops enumerable per resource type in authorized_operations
+# bitfields (bit index = the AclOperation wire code).
+_TOPIC_OPS = (
+    AclOperation.read, AclOperation.write, AclOperation.create,
+    AclOperation.delete, AclOperation.alter, AclOperation.describe,
+    AclOperation.describe_configs, AclOperation.alter_configs,
+)
+_CLUSTER_OPS = (
+    AclOperation.create, AclOperation.cluster_action, AclOperation.alter,
+    AclOperation.describe, AclOperation.describe_configs,
+    AclOperation.alter_configs, AclOperation.idempotent_write,
+)
+_GROUP_OPS = (AclOperation.read, AclOperation.delete, AclOperation.describe)
+
+
+def authorized_operations(ctx, resource_type: ResourceType, name: str) -> int:
+    """Bitfield of operations the connection's principal may perform on
+    the resource (KIP-430; metadata v8+, describe_groups v3+)."""
+    from redpanda_tpu.kafka.server.security_handlers import authorize
+
+    ops = {
+        ResourceType.topic: _TOPIC_OPS,
+        ResourceType.cluster: _CLUSTER_OPS,
+        ResourceType.group: _GROUP_OPS,
+    }[resource_type]
+    bits = 0
+    for op in ops:
+        if authorize(ctx, resource_type, name, op):
+            bits |= 1 << int(op)
+    return bits
+
+
 def build_dispatch_table() -> dict:
     return {
         m.API_VERSIONS: handle_api_versions,
@@ -131,14 +163,17 @@ async def handle_metadata(ctx) -> dict:
                     "offline_replicas": [],
                 }
             )
-        topics.append(
-            {
-                "error_code": 0,
-                "name": name,
-                "is_internal": broker.is_internal_topic(name),
-                "partitions": partitions,
-            }
-        )
+        entry = {
+            "error_code": 0,
+            "name": name,
+            "is_internal": broker.is_internal_topic(name),
+            "partitions": partitions,
+        }
+        if ctx.api_version >= 8 and ctx.request.get("include_topic_authorized_operations"):
+            entry["topic_authorized_operations"] = authorized_operations(
+                ctx, ResourceType.topic, name
+            )
+        topics.append(entry)
     if getattr(broker, "metadata_cache", None) is not None and broker.metadata_cache.all_brokers():
         brokers = [
             {
@@ -165,12 +200,19 @@ async def handle_metadata(ctx) -> dict:
     if fn is not None:
         leader = fn()
         controller_id = leader if leader is not None else -1
-    return {
+    resp = {
         "brokers": brokers,
         "cluster_id": cfg.cluster_id,
         "controller_id": controller_id,
         "topics": topics,
     }
+    if ctx.api_version >= 8 and ctx.request.get("include_cluster_authorized_operations"):
+        from redpanda_tpu.kafka.server.security_handlers import DEFAULT_CLUSTER_NAME
+
+        resp["cluster_authorized_operations"] = authorized_operations(
+            ctx, ResourceType.cluster, DEFAULT_CLUSTER_NAME
+        )
+    return resp
 
 
 def _valid_topic_name(name: str) -> bool:
@@ -219,7 +261,7 @@ async def handle_produce(ctx) -> dict | None:
             continue
         parts = await asyncio.gather(
             *(
-                _produce_one(ctx.broker, t["name"], p, level)
+                _produce_one(ctx.broker, t["name"], p, level, ctx.api_version)
                 for p in t["partitions"]
             )
         )
@@ -245,7 +287,7 @@ def _produce_partition_error(index: int, code: ErrorCode) -> dict:
     }
 
 
-async def _produce_one(broker, topic: str, p: dict, level: int) -> dict:
+async def _produce_one(broker, topic: str, p: dict, level: int, api_version: int = 3) -> dict:
     index = p["partition_index"]
     partition = broker.get_partition(topic, index)
     if partition is None:
@@ -255,30 +297,47 @@ async def _produce_one(broker, topic: str, p: dict, level: int) -> dict:
     records = p.get("records")
     if not records:
         return _produce_partition_error(index, E.invalid_record)
-    try:
-        # CRC validation goes through the measured adapter boundary
-        # (ops/crc_backend.py): batched host SSE4.2 or device kernel,
-        # whichever the process-wide probe picked.
-        adapted = decode_wire_batches(records, verify_crc=False)
-    except EOFError:
-        return _produce_partition_error(index, E.corrupt_message)
-    from redpanda_tpu.ops.crc_backend import default_backend_async
+    if api_version < 3:
+        # produce v0-2 carries a legacy magic-0/1 MessageSet: up-convert to
+        # ONE v2 batch so the rest of the pipeline only sees modern batches
+        # (kafka_batch_adapter.cc adapt_with_version; crc32 verified inside)
+        from redpanda_tpu.kafka.protocol.legacy import (
+            LegacyBatchError,
+            LegacyUnsupportedError,
+            convert_message_set,
+        )
 
-    v2 = [a for a in adapted if a.v2_format]
-    ok = (await default_backend_async()).validate(
-        [a.batch.crc_region() for a in v2],
-        [a.batch.header.crc for a in v2],
-    )
-    ok_iter = iter(ok)
-    for a in adapted:
-        # kafka_batch_adapter.cc:93-121: per batch IN ORDER, reject legacy
-        # magic first, then a bad CRC — the first offending batch decides
-        # the error (validation itself is batched through the backend).
-        if not a.v2_format:
+        try:
+            batches = [convert_message_set(records)]
+        except LegacyUnsupportedError:
             return _produce_partition_error(index, E.unsupported_for_message_format)
-        if not next(ok_iter):
+        except LegacyBatchError:
             return _produce_partition_error(index, E.corrupt_message)
-    batches = [a.batch for a in adapted]
+    else:
+        try:
+            # CRC validation goes through the measured adapter boundary
+            # (ops/crc_backend.py): batched host SSE4.2 or device kernel,
+            # whichever the process-wide probe picked.
+            adapted = decode_wire_batches(records, verify_crc=False)
+        except EOFError:
+            return _produce_partition_error(index, E.corrupt_message)
+        from redpanda_tpu.ops.crc_backend import default_backend_async
+
+        v2 = [a for a in adapted if a.v2_format]
+        ok = (await default_backend_async()).validate(
+            [a.batch.crc_region() for a in v2],
+            [a.batch.header.crc for a in v2],
+        )
+        ok_iter = iter(ok)
+        for a in adapted:
+            # kafka_batch_adapter.cc:93-121: per batch IN ORDER, reject legacy
+            # magic first, then a bad CRC — the first offending batch decides
+            # the error (validation itself is batched through the backend).
+            if not a.v2_format:
+                return _produce_partition_error(index, E.unsupported_for_message_format)
+            if not next(ok_iter):
+                return _produce_partition_error(index, E.corrupt_message)
+        batches = [a.batch for a in adapted]
     if not batches:
         return _produce_partition_error(index, E.invalid_record)
     # idempotence / transaction gate (rm_stm on the produce path,

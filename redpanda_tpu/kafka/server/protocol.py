@@ -165,12 +165,16 @@ class Connection:
         r = Reader(frame)
         header = RequestHeader.decode(r, flexible=False)
         api = APIS.get(header.api_key)
-        if api is not None and api.is_flexible(header.api_version):
+        # Range-check BEFORE the flexible re-decode: an out-of-range version
+        # (e.g. a KIP-511 ApiVersions probe from the future) may not carry
+        # the tagged-field header byte our flexible table would expect, and
+        # the v0 error response only needs the fixed-offset correlation id.
+        if api is None or not (api.min_version <= header.api_version <= api.max_version):
+            return self._unsupported_version_response(header)
+        if api.is_flexible(header.api_version):
             # re-decode with the flexible header (v2: + tagged fields)
             r = Reader(frame)
             header = RequestHeader.decode(r, flexible=True)
-        if api is None or not (api.min_version <= header.api_version <= api.max_version):
-            return self._unsupported_version_response(header)
         if self.server.handlers.get(header.api_key) is None:
             return self._unsupported_version_response(header)
         try:

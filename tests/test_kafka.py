@@ -400,3 +400,73 @@ def test_latency_probes_record_produce_and_fetch(tmp_path):
         assert "kafka_fetch_latency_us_bucket" in text
 
     run(main())
+
+
+def test_kip430_authorized_operations(tmp_path):
+    """Metadata v9 / describe_groups v5 include_*_authorized_operations
+    (KIP-430): open broker returns the full per-resource bitfield; with an
+    authorizer the bits reflect actual ACLs; flag off keeps the MIN_INT
+    'not requested' sentinel."""
+
+    async def main():
+        from redpanda_tpu.security.acl import (
+            AclBinding,
+            AclEntry,
+            AclOperation,
+            AclPermission,
+            AclStore,
+            Authorizer,
+            PatternType,
+            ResourcePattern,
+            ResourceType,
+        )
+
+        broker, server = await _start_broker(tmp_path)
+        client = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        try:
+            await client.create_topic("ops-t", partitions=1)
+            conn = client._bootstrap_conn
+
+            # flag off -> sentinel defaults
+            md = await conn.request(m.METADATA, {
+                "topics": [{"name": "ops-t"}],
+                "allow_auto_topic_creation": False,
+            }, version=9)
+            assert md["topics"][0]["topic_authorized_operations"] == -2147483648
+            assert md["cluster_authorized_operations"] == -2147483648
+
+            # open broker (no authorizer): every enumerable op allowed
+            md = await conn.request(m.METADATA, {
+                "topics": [{"name": "ops-t"}],
+                "allow_auto_topic_creation": False,
+                "include_topic_authorized_operations": True,
+                "include_cluster_authorized_operations": True,
+            }, version=9)
+            topic_bits = md["topics"][0]["topic_authorized_operations"]
+            for op in (AclOperation.read, AclOperation.write, AclOperation.delete,
+                       AclOperation.describe, AclOperation.alter_configs):
+                assert topic_bits & (1 << int(op)), op
+            assert md["cluster_authorized_operations"] & (1 << int(AclOperation.cluster_action))
+
+            # restrict: alice may only read (describe implied); anonymous
+            # connections carry no principal -> ACLs for User:anonymous
+            store = AclStore()
+            store.add([AclBinding(
+                ResourcePattern(ResourceType.topic, "ops-t", PatternType.literal),
+                AclEntry("User:anonymous", "*", AclOperation.read, AclPermission.allow),
+            )])
+            broker.authorizer = Authorizer(store, allow_empty=False)
+            md = await conn.request(m.METADATA, {
+                "topics": [{"name": "ops-t"}],
+                "allow_auto_topic_creation": False,
+                "include_topic_authorized_operations": True,
+            }, version=9)
+            bits = md["topics"][0]["topic_authorized_operations"]
+            assert bits & (1 << int(AclOperation.read))
+            assert bits & (1 << int(AclOperation.describe))  # read implies describe
+            assert not bits & (1 << int(AclOperation.write))
+            assert not bits & (1 << int(AclOperation.delete))
+        finally:
+            await _stop(server, broker, client)
+
+    asyncio.run(main())
