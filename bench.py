@@ -138,10 +138,14 @@ def _run_engine_stream(engine, req, n_ticks, group, depth) -> float:
     return n_ticks * n_batches / elapsed
 
 
-def run_tpu_engine(req) -> tuple[float, dict]:
+def _run_engine_mode(req, force_mode: str | None) -> tuple[float, dict]:
+    """One measured engine run. force_mode None = the PRODUCT path (the
+    engine's own measured device-vs-host probe picks where the predicate
+    runs); "columnar_device"/"columnar_host" pin each half so every BENCH
+    carries the full ablation regardless of what the probe chose."""
     from redpanda_tpu.coproc import TpuEngine
 
-    engine = TpuEngine(row_stride=ROW_STRIDE)
+    engine = TpuEngine(row_stride=ROW_STRIDE, force_mode=force_mode)
     codes = engine.enable_coprocessors([(1, _spec().to_json(), ("bench",))])
     assert codes[0] == 0
     # warmup: compile the GROUP-sized shape and, when MEASURE_TICKS is not a
@@ -308,11 +312,15 @@ def main():
     if not tpu_ok:
         _pin_cpu()
     req = _build_workload()
-    value, stages = run_tpu_engine(req)
+    value, stages = _run_engine_mode(req, None)  # product path: probed pick
+    dev_rate, dev_stages = _run_engine_mode(req, "columnar_device")
+    host_col_rate, host_col_stages = _run_engine_mode(req, "columnar_host")
     baseline = run_cpu_baseline(req)
-    import jax
-
     from redpanda_tpu.coproc import TpuEngine
+
+    columnar_probe = TpuEngine._columnar_probe
+    columnar_backend = TpuEngine._columnar_backend
+    import jax
 
     extras = {}
     try:
@@ -355,7 +363,25 @@ def main():
                 "group_ticks_per_launch": GROUP,
                 "launch_depth": DEPTH,
                 "engine_mode": "columnar",
+                # where the predicate ran in the headline: the engine's own
+                # measured probe decides (device vs numpy over the SAME
+                # extracted columns) — probe timings on record
+                "columnar_backend": columnar_backend,
+                "columnar_probe": columnar_probe,
                 "stages": stages,
+                # both halves of the decision, every run: vs_host_columnar
+                # is what the DEVICE contributes over the identical plan
+                # with a numpy predicate; <=1.0 means the device does not
+                # pay for its link on this hardware for this workload.
+                "engine_device_columnar": {
+                    "record_batches_per_sec": round(dev_rate, 1),
+                    "stages": dev_stages,
+                },
+                "engine_host_columnar": {
+                    "record_batches_per_sec": round(host_col_rate, 1),
+                    "stages": host_col_stages,
+                },
+                "vs_host_columnar": round(dev_rate / host_col_rate, 2),
                 **extras,
             }
         )

@@ -59,6 +59,11 @@ class DevCol:
     w: int = 0  # str byte width (merged across uses)
 
 
+# input arrays contributed per DevCol kind: str -> (bytes, vlen),
+# num -> (f32, i32, flags), exists -> (present,)
+_COL_ARITY = {"str": 2, "num": 3, "exists": 1}
+
+
 class FindCache:
     """Span tables from ONE native JSON walk per record for every
     single-segment path a plan references (rp_find_multi) — the extractors
@@ -124,6 +129,21 @@ class ColumnarPlan:
             return None
         return FindCache(lib, joined, offsets, sizes, paths)
 
+    def _bind_slots(self, arrays) -> dict:
+        """Ordered input arrays -> {(kind, path): arrays} slot map — the ONE
+        place that knows the per-kind arity (str=2, num=3, exists=1); the
+        device predicate, the host ablation, and extract_device_inputs all
+        stay aligned through it."""
+        slots = {}
+        k = 0
+        for c in self.dev_cols:
+            arity = _COL_ARITY[c.kind]
+            slots[(c.kind, c.path)] = (
+                arrays[k] if arity == 1 else tuple(arrays[k : k + arity])
+            )
+            k += arity
+        return slots
+
     # ------------------------------------------------------------ device
     def compile_device(self, mesh=None):
         """jit fn(*cols) -> packed keep bits (uint8 [n/8]).
@@ -139,26 +159,9 @@ class ColumnarPlan:
         import jax.numpy as jnp
 
         expr = self.spec.where
-        cols = self.dev_cols
 
         def predicate(*arrays):
-            slots = {}
-            k = 0
-            for c in cols:
-                if c.kind == "str":
-                    slots[(c.kind, c.path)] = (arrays[k], arrays[k + 1])
-                    k += 2
-                elif c.kind == "num":
-                    slots[(c.kind, c.path)] = (
-                        arrays[k],
-                        arrays[k + 1],
-                        arrays[k + 2],
-                    )
-                    k += 3
-                else:
-                    slots[(c.kind, c.path)] = arrays[k]
-                    k += 1
-            keep = _build_expr(jnp, expr, slots)
+            keep = _build_expr(jnp, expr, self._bind_slots(arrays))
             return _packbits(jnp, keep)
 
         if mesh is None:
@@ -168,13 +171,8 @@ class ColumnarPlan:
 
             row_sharded = NamedSharding(mesh, PartitionSpec("p"))
             shardings = []
-            for c in cols:
-                if c.kind == "str":
-                    shardings += [row_sharded, row_sharded]
-                elif c.kind == "num":
-                    shardings += [row_sharded, row_sharded, row_sharded]
-                else:
-                    shardings.append(row_sharded)
+            for c in self.dev_cols:
+                shardings += [row_sharded] * _COL_ARITY[c.kind]
             fn = jax.jit(
                 predicate,
                 in_shardings=tuple(shardings),
@@ -182,6 +180,16 @@ class ColumnarPlan:
             )
         self._fn_cache[key] = fn
         return fn
+
+    def eval_host_mask(self, cols) -> np.ndarray:
+        """ABLATION twin of compile_device: the SAME predicate tree over the
+        SAME extracted columns, evaluated in numpy on the host — packed keep
+        bits (uint8 [n/8]). _build_expr is namespace-generic and the slot
+        binding is shared (_bind_slots), so device and host evaluation
+        cannot drift; the bench runs both to measure what the device link
+        actually buys."""
+        keep = _build_expr(np, self.spec.where, self._bind_slots(cols))
+        return _packbits(np, np.asarray(keep, dtype=bool))
 
     # ------------------------------------------------------------ host side
     def extract_device_inputs(self, joined, offsets, sizes, n_pad: int, cache=None):

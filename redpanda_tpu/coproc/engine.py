@@ -183,6 +183,10 @@ class _Launch:
             )
         if self._mask_dev is None and self._mask_np is None:
             keep = np.ones(n, dtype=bool)  # no predicate: keep all present
+        elif self._mask_dev is None:
+            # host-evaluated mask (columnar_host ablation): already on host
+            keep = np.unpackbits(self._mask_np)[:n].astype(bool)
+            self._mask_np = None
         else:
             t0 = time.perf_counter()
             if self._mask_event is not None:
@@ -311,6 +315,12 @@ def _pack_values(ex, stride: int):
 # Per-slot dispositions inside a Ticket.
 _UNKNOWN, _EMPTY, _DEREGISTERED, _LAUNCHED = range(4)
 
+# Columnar backend probe: don't pin the process-wide device-vs-host choice
+# on a batch too small to represent steady state, and bound the device leg
+# (first TPU compile is ~20-40s; a wedged tunnel hangs forever).
+_PROBE_MIN_ROWS = 1024
+_PROBE_DEVICE_TIMEOUT_S = 120.0
+
 
 class Ticket:
     """Handle for an in-flight engine request; ``result()`` materializes it."""
@@ -383,9 +393,22 @@ class TpuEngine:
     then run SPMD with record rows sharded over the mesh (the per-shard
     pacemaker-fiber analogue of coproc/pacemaker.h:41-145 — one engine, all
     chips). ``force_mode`` pins every script to one execution mode
-    ("payload" forces the full-row staging path; used by the bench to
-    measure raw bridge overhead).
+    ("payload" forces the full-row staging path, "columnar_host" pins the
+    numpy predicate, "columnar_device" pins the device predicate; used by
+    the bench to measure each half).
+
+    Where the columnar predicate runs is a MEASURED decision (same policy
+    as ops/crc_backend.pick and the LZ4 keep-or-kill): the first columnar
+    launch probes device vs numpy over the same extracted columns and the
+    process keeps the winner. On locally-attached TPU the device wins; on
+    a high-RTT tunneled link numpy does — the probe, not an assumption,
+    decides (see BENCH vs_host_columnar for both halves on record).
     """
+
+    # process-wide probed decision: the link physics don't change per
+    # engine instance ("device" | "host" | None = not yet probed)
+    _columnar_backend: str | None = None
+    _columnar_probe: dict | None = None
 
     def __init__(
         self,
@@ -649,17 +672,36 @@ class TpuEngine:
                 exploded.joined, exploded.offsets, exploded.sizes, n_pad, cache
             )
             self._stat_add("t_extract_pred", time.perf_counter() - t0)
+            use_host = self._force_mode == "columnar_host"
+            if self._force_mode is None and self._mesh is None:
+                if TpuEngine._columnar_backend is None:
+                    if n_pad >= _PROBE_MIN_ROWS:
+                        self._probe_columnar_backend(plan, cols)
+                        use_host = TpuEngine._columnar_backend == "host"
+                    else:
+                        # too small to be representative of steady state:
+                        # don't pin the process-wide choice on a trickle
+                        # batch — numpy is the cheap safe pick at this size
+                        use_host = True
+                else:
+                    use_host = TpuEngine._columnar_backend == "host"
             t0 = time.perf_counter()
-            fn = plan.compile_device(self._mesh)
-            mask = fn(*cols)
-            mask.copy_to_host_async()
-            self._stat_add("t_dispatch", time.perf_counter() - t0)
-            self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
-            self._stat_add("bytes_d2h", n_pad // 8)
-            launch._mask_dev = mask
-            launch._mask_event = threading.Event()
-            self._ensure_harvester()
-            self._harvest_q.put(launch)
+            if use_host:
+                # measured-host predicate: SAME extracted columns, numpy —
+                # what the probe (or the bench ablation) picked on this link
+                launch._mask_np = plan.eval_host_mask(cols)
+                self._stat_add("t_dispatch", time.perf_counter() - t0)
+            else:
+                fn = plan.compile_device(self._mesh)
+                mask = fn(*cols)
+                mask.copy_to_host_async()
+                self._stat_add("t_dispatch", time.perf_counter() - t0)
+                self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
+                self._stat_add("bytes_d2h", n_pad // 8)
+                launch._mask_dev = mask
+                launch._mask_event = threading.Event()
+                self._ensure_harvester()
+                self._harvest_q.put(launch)
         # Projection extraction overlaps the device launch.
         t0 = time.perf_counter()
         if plan.passthrough:
@@ -672,6 +714,45 @@ class TpuEngine:
             launch._proj_data = data
             launch._proj_ok = ok
         self._stat_add("t_extract_proj", time.perf_counter() - t0)
+
+    def _probe_columnar_backend(self, plan, cols) -> None:
+        """One-time process-wide probe: run the SAME predicate over the SAME
+        columns on the device (compile + fetch warmup, then a timed
+        launch+fetch) and in numpy; keep the faster. The device leg runs in
+        a daemon thread with a deadline because a wedged device link HANGS
+        inside the fetch rather than raising — on timeout (or no device /
+        compile error) the probe falls back to host and the stuck thread is
+        abandoned (one thread per process worst case)."""
+        import concurrent.futures
+        import time as _t
+
+        t0 = _t.perf_counter()
+        plan.eval_host_mask(cols)
+        t_host = _t.perf_counter() - t0
+
+        def _device_leg() -> float:
+            fn = plan.compile_device(None)
+            np.asarray(fn(*cols))  # compile + first-launch warmup
+            t1 = _t.perf_counter()
+            np.asarray(fn(*cols))  # steady-state launch + fetch
+            return _t.perf_counter() - t1
+
+        t_dev = float("inf")
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="rptpu-columnar-probe"
+        )
+        try:
+            t_dev = pool.submit(_device_leg).result(timeout=_PROBE_DEVICE_TIMEOUT_S)
+        except Exception:  # timeout, no device, compile error
+            pass
+        finally:
+            pool.shutdown(wait=False)
+        TpuEngine._columnar_backend = "device" if t_dev < t_host else "host"
+        TpuEngine._columnar_probe = {
+            "t_host_s": round(t_host, 6),
+            "t_device_s": round(t_dev, 6) if t_dev != float("inf") else None,
+            "chosen": TpuEngine._columnar_backend,
+        }
 
     def _pack_staged(self, exploded, n_pad: int) -> np.ndarray:
         """[n_pad, row_stride + IN_META] uint8: record bytes then LE32 length.

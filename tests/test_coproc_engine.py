@@ -263,3 +263,41 @@ def test_frame_ranges_matches_per_batch_framing():
             seq += 1
         py.append((bytes(out), seq))
     assert got == py
+
+
+def test_columnar_host_ablation_matches_device_mode():
+    """force_mode='columnar_host' (the bench ablation: same columnar plan,
+    predicate evaluated in numpy) must produce byte-identical replies to
+    the device-mode engine on every expression kind."""
+    from redpanda_tpu.ops.exprs import field
+    from redpanda_tpu.ops.transforms import where
+
+    specs = [
+        filter_field_eq("level", "error") | map_project(Int("code"), Str("msg", 16)),
+        where((field("code") > 3) & ~(field("level") == "info")),
+        where(field("msg").contains("m1", window=16)),
+        where(field("missing").exists() | (field("code") <= 2)),
+    ]
+    for spec in specs:
+        dev = TpuEngine(
+            row_stride=256, compress_threshold=10**9, force_mode="columnar_device"
+        )
+        host = TpuEngine(
+            row_stride=256, compress_threshold=10**9, force_mode="columnar_host"
+        )
+        for e in (dev, host):
+            codes = e.enable_coprocessors([(1, spec.to_json(), ("orders",))])
+            assert codes == [EnableResponseCode.success]
+        req = ProcessBatchRequest([
+            ProcessBatchItem(1, NTP.kafka("orders", p), [_json_batch(8, base_offset=p)])
+            for p in range(3)
+        ])
+        r_dev = [t.result() for t in dev.submit_group([req, req])]
+        r_host = [t.result() for t in host.submit_group([req, req])]
+        for a, b in zip(r_dev, r_host):
+            assert len(a.items) == len(b.items)
+            for ia, ib in zip(a.items, b.items):
+                assert ia.source == ib.source
+                va = [bytes(v) for bt in ia.batches for v in bt.record_values()]
+                vb = [bytes(v) for bt in ib.batches for v in bt.record_values()]
+                assert va == vb, (spec.to_json(), va, vb)

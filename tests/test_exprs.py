@@ -390,7 +390,9 @@ class TestEngineColumnar:
         vals = [json.dumps(d, separators=(",", ":")).encode() for d in DOCS]
         recs = [Record(offset_delta=i, value=v) for i, v in enumerate(vals)]
         batch = RecordBatch.build(recs, base_offset=0)
-        eng = TpuEngine()
+        # pinned to the device path: this test asserts device-only stats
+        # keys (t_fetch, bytes_h2d) that the probed default may not take
+        eng = TpuEngine(force_mode="columnar_device")
         eng.enable_coprocessors([(1, spec.to_json(), ("t",))])
         req = ProcessBatchRequest([ProcessBatchItem(1, NTP.kafka("t", 0), [batch])])
         eng.process_batch(req)
