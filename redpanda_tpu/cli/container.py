@@ -188,7 +188,11 @@ class LocalCluster:
 def cmd_container(args) -> int:
     cluster = LocalCluster(args.dir or DEFAULT_DIR)
     if args.container_cmd == "start":
-        state = cluster.start(args.nodes)
+        extra = {}
+        for kv in getattr(args, "set", None) or []:
+            k, _, v = kv.partition("=")
+            extra[k] = v
+        state = cluster.start(args.nodes, extra_sets=extra)
         print(f"started {len(state['nodes'])} broker(s) in {cluster.base_dir}")
         print(f"brokers: {cluster.brokers()}")
         for nd in state["nodes"]:

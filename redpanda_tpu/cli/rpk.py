@@ -222,9 +222,17 @@ async def cmd_wasm(args) -> int:
         if args.wasm_cmd == "deploy":
             with open(args.file) as f:
                 doc = json.load(f)
-            rec = wasm_event.make_deploy_record(
-                doc["name"], json.dumps(doc["spec"]), doc["input_topics"]
-            )
+            if "py_source" in doc:
+                # sandboxed python transform (validated client-side here
+                # and again on every broker at enable time)
+                rec = wasm_event.make_py_deploy_record(
+                    doc["name"], doc["py_source"], doc["input_topics"],
+                    policy=doc.get("policy", "skip"),
+                )
+            else:
+                rec = wasm_event.make_deploy_record(
+                    doc["name"], json.dumps(doc["spec"]), doc["input_topics"]
+                )
         else:  # remove
             rec = wasm_event.make_remove_record(args.name)
         from redpanda_tpu.models.record import RecordBatch
@@ -512,6 +520,10 @@ def build_parser() -> argparse.ArgumentParser:
     cns = cnsub.add_parser("start")
     cns.add_argument("-n", "--nodes", type=int, default=1)
     cns.add_argument("--dir", help="cluster state directory")
+    cns.add_argument(
+        "--set", action="append", metavar="K=V",
+        help="extra broker config overrides (repeatable), e.g. coproc_enable=1",
+    )
     for name in ("status", "stop", "purge"):
         cnsub.add_parser(name).add_argument("--dir", help="cluster state directory")
 

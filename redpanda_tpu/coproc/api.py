@@ -159,13 +159,23 @@ class CoprocApi:
                     else:
                         logger.warning("ignoring malformed coproc event")
                 next_offset = b.last_offset + 1
-        # dispatch BEFORE advancing the cursor: a failure here must retry
-        # the chunk on the next poll, not silently drop the deploys
+        # dispatch BEFORE advancing the cursor, but isolate per event: a
+        # TRANSIENT infrastructure failure retries the chunk on the next
+        # poll (re-raise), while a poison event (enable itself blowing up
+        # on pathological input) is logged and skipped — otherwise one bad
+        # deploy would wedge every later deploy/remove on every broker
+        # forever. enable/disable report expected failures via codes; an
+        # exception from them is the poison case.
         for name, ev in wasm_event.reconcile(events).items():
-            if ev.action == wasm_event.DEPLOY:
-                await self._enable(ev)
-            else:
-                await self._disable(name)
+            try:
+                if ev.action == wasm_event.DEPLOY:
+                    await self._enable(ev)
+                else:
+                    await self._disable(name)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("poison coproc event %r skipped", name)
         self._listen_offset = next_offset
 
     async def _enable(self, ev: wasm_event.WasmEvent) -> None:
@@ -175,9 +185,20 @@ class CoprocApi:
             return  # unchanged redeploy
         if ev.name in self._active:
             await self._disable(ev.name)
-        codes = self.engine.enable_coprocessors(
-            [(ev.script_id, ev.spec_json, ev.input_topics)]
-        )
+        if ev.py_source:
+            # sandboxed python transform: restricted-AST validation runs
+            # inside enable_py_sandboxed on THIS broker before registration
+            from redpanda_tpu.coproc.engine import ErrorPolicy
+
+            codes = [self.engine.enable_py_sandboxed(
+                ev.script_id, ev.py_source, ev.input_topics,
+                ErrorPolicy.deregister if ev.policy == "deregister"
+                else ErrorPolicy.skip_on_failure,
+            )]
+        else:
+            codes = self.engine.enable_coprocessors(
+                [(ev.script_id, ev.spec_json, ev.input_topics)]
+            )
         if codes[0] != EnableResponseCode.success:
             logger.error("enable %s failed: %s", ev.name, codes[0].name)
             return

@@ -40,6 +40,7 @@ removes the script on first failure.
 from __future__ import annotations
 
 import enum
+import logging
 import queue
 import threading
 import time
@@ -52,6 +53,8 @@ from redpanda_tpu.hashing.xx import xxhash64
 from redpanda_tpu.models.fundamental import NTP
 from redpanda_tpu.models.record import Compression, RecordBatch
 from redpanda_tpu.ops.pipeline import IN_META, make_packed_pipeline, unpack_result
+
+logger = logging.getLogger("rptpu.coproc.engine")
 from redpanda_tpu.ops.transforms import TransformSpec
 from redpanda_tpu.coproc import batch_codec
 from redpanda_tpu.coproc.column_plan import ColumnarPlan, HostPlan, PayloadPlan, plan_spec
@@ -240,6 +243,10 @@ class _Launch:
                 try:
                     outs.append(plan.fn(val))
                 except Exception:
+                    if self.policy == ErrorPolicy.deregister:
+                        # propagate: Ticket._rebuild applies the policy and
+                        # unloads the script (wasm_event.h Deregister)
+                        raise
                     outs.append(None)
             keep = np.array([o is not None for o in outs], dtype=bool)
             stride = max((len(o) for o in outs if o is not None), default=1)
@@ -500,12 +507,18 @@ class TpuEngine:
         return out
 
     def enable_py_transform(
-        self, script_id: int, fn, topics: tuple[str, ...]
+        self,
+        script_id: int,
+        fn,
+        topics: tuple[str, ...],
+        policy: ErrorPolicy = ErrorPolicy.skip_on_failure,
     ) -> EnableResponseCode:
         """Escape hatch: an arbitrary python callable(value) -> value | None
         run in the engine's host stage with the standard engine interface —
         for transforms the declarative DSL cannot express (the analogue of
         the reference's arbitrary Coprocessor.apply(), SimpleTransform.ts:18).
+        In-process trust only; the WIRE-deployable form is
+        enable_py_sandboxed.
         """
         if script_id in self._handles:
             return EnableResponseCode.script_id_already_exists
@@ -513,8 +526,38 @@ class TpuEngine:
             return EnableResponseCode.script_contains_no_topics
         spec = TransformSpec(name=f"py:{getattr(fn, '__name__', 'fn')}")
         self._plans[script_id] = plan_spec(spec, py_fn=fn)
-        self._handles[script_id] = ScriptHandle(script_id, spec, tuple(topics))
+        self._handles[script_id] = ScriptHandle(
+            script_id, spec, tuple(topics), policy=policy
+        )
         return EnableResponseCode.success
+
+    def enable_py_sandboxed(
+        self,
+        script_id: int,
+        source: str,
+        topics: tuple[str, ...],
+        policy: ErrorPolicy = ErrorPolicy.skip_on_failure,
+    ) -> EnableResponseCode:
+        """Wire-deployable arbitrary transform: restricted-AST python
+        validated HERE (on every consuming broker) before registration —
+        a malicious blob never reaches execution (coproc/sandbox.py; the
+        reference's analogue is the V8 supervisor boundary)."""
+        from redpanda_tpu.coproc.sandbox import SandboxViolation, compile_transform
+
+        if script_id in self._handles:
+            return EnableResponseCode.script_id_already_exists
+        if not topics:
+            return EnableResponseCode.script_contains_no_topics
+        try:
+            fn = compile_transform(source)
+        except SandboxViolation:
+            return EnableResponseCode.internal_error
+        except Exception:
+            # any other compile-time blowup is a bad script, not a broker
+            # fault — refuse registration rather than poison the caller
+            logger.exception("sandboxed script %d failed to compile", script_id)
+            return EnableResponseCode.internal_error
+        return self.enable_py_transform(script_id, fn, topics, policy)
 
     def disable_coprocessors(self, script_ids: list[int]) -> list[DisableResponseCode]:
         out = []
@@ -723,30 +766,34 @@ class TpuEngine:
         inside the fetch rather than raising — on timeout (or no device /
         compile error) the probe falls back to host and the stuck thread is
         abandoned (one thread per process worst case)."""
-        import concurrent.futures
         import time as _t
 
         t0 = _t.perf_counter()
         plan.eval_host_mask(cols)
         t_host = _t.perf_counter() - t0
 
-        def _device_leg() -> float:
-            fn = plan.compile_device(None)
-            np.asarray(fn(*cols))  # compile + first-launch warmup
-            t1 = _t.perf_counter()
-            np.asarray(fn(*cols))  # steady-state launch + fetch
-            return _t.perf_counter() - t1
+        result_q: "queue.Queue[float]" = queue.Queue()
 
-        t_dev = float("inf")
-        pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="rptpu-columnar-probe"
-        )
+        def _device_leg() -> None:
+            try:
+                fn = plan.compile_device(None)
+                np.asarray(fn(*cols))  # compile + first-launch warmup
+                t1 = _t.perf_counter()
+                np.asarray(fn(*cols))  # steady-state launch + fetch
+                result_q.put(_t.perf_counter() - t1)
+            except Exception:
+                result_q.put(float("inf"))
+
+        # a plain DAEMON thread, not an executor: concurrent.futures joins
+        # its workers at interpreter exit, so a wedged device fetch would
+        # hang process shutdown — a daemon thread is truly abandonable
+        threading.Thread(
+            target=_device_leg, name="rptpu-columnar-probe", daemon=True
+        ).start()
         try:
-            t_dev = pool.submit(_device_leg).result(timeout=_PROBE_DEVICE_TIMEOUT_S)
-        except Exception:  # timeout, no device, compile error
-            pass
-        finally:
-            pool.shutdown(wait=False)
+            t_dev = result_q.get(timeout=_PROBE_DEVICE_TIMEOUT_S)
+        except queue.Empty:  # wedged link: the thread is abandoned
+            t_dev = float("inf")
         TpuEngine._columnar_backend = "device" if t_dev < t_host else "host"
         TpuEngine._columnar_probe = {
             "t_host_s": round(t_host, 6),

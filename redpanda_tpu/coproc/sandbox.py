@@ -1,0 +1,242 @@
+"""Wire-deployable sandboxed Python transforms.
+
+The declarative DSL (ops/exprs.py) covers predicates/projections; this is
+the escape hatch for arbitrary per-record logic, deployable over the SAME
+internal-topic event path as DSL specs — the tpu-native analogue of the
+reference's JS blobs run under its supervisor (src/js/modules/supervisors/,
+SimpleTransform.ts:18 `apply(record)`), with the isolation the reference
+gets from a separate V8 process done here as a restricted-AST interpreter
+boundary plus a hard per-record execution budget.
+
+Containment model (validated at DEPLOY time on every broker, before the
+script is registered):
+- the source must define exactly `def transform(value): ...`
+  (bytes in -> bytes | str | None out; None drops the record);
+- AST whitelist: literals, arithmetic/bool/compare, locals, if/for/while,
+  comprehensions, subscripts, calls to whitelisted builtins only;
+- NO import, NO attribute access except a whitelisted set of safe
+  str/bytes/dict/list methods (never underscore names — the
+  `().__class__.__mro__` escape runs through dunder attributes);
+- NO global/nonlocal, no lambda/def nesting, no decorators, no yield;
+- executed with empty __builtins__ and a curated safe-globals table;
+- bounded runtime: a line-trace budget aborts a record that executes more
+  than EXEC_LINE_BUDGET traced lines (while-loop containment).
+
+Runtime failures surface through the engine's ErrorPolicy exactly like any
+script failure: skip_on_failure drops the record, deregister unloads the
+script (wasm_event.h policy semantics).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import sys
+
+EXEC_LINE_BUDGET = 100_000  # traced line events per record
+MAX_SOURCE_BYTES = 64 * 1024
+
+
+class SandboxViolation(Exception):
+    """Source failed deploy-time validation."""
+
+
+class SandboxBudgetExceeded(BaseException):
+    """A record's execution exceeded the line budget.
+
+    BaseException on purpose: user code may catch Exception (json error
+    handling is legitimate), and the budget kill must NOT be swallowable —
+    CPython also unsets the trace function when the tracer raises, so a
+    caught budget exception would leave the rest of the transform running
+    untraced and unbounded. Validation separately forbids bare except /
+    except BaseException and `finally` (which would run untraced too).
+    The run() wrapper converts it to SandboxRuntimeError (a plain
+    Exception) once it has escaped every user frame, so the engine's
+    ErrorPolicy machinery handles it like any script failure."""
+
+
+class SandboxRuntimeError(Exception):
+    """A record's execution was killed (budget overrun), reported at the
+    sandbox boundary for the engine's ErrorPolicy to handle."""
+
+
+_ALLOWED_NODES = (
+    ast.Module, ast.FunctionDef, ast.arguments, ast.arg, ast.Return,
+    ast.Expr, ast.Assign, ast.AugAssign, ast.AnnAssign,
+    ast.Name, ast.Load, ast.Store, ast.Del, ast.Delete, ast.Constant,
+    ast.Tuple, ast.List, ast.Dict, ast.Set,
+    ast.BinOp, ast.UnaryOp, ast.BoolOp, ast.Compare, ast.IfExp,
+    ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod, ast.Pow,
+    ast.LShift, ast.RShift, ast.BitOr, ast.BitXor, ast.BitAnd,
+    ast.UAdd, ast.USub, ast.Not, ast.Invert,
+    ast.And, ast.Or,
+    ast.Eq, ast.NotEq, ast.Lt, ast.LtE, ast.Gt, ast.GtE,
+    ast.Is, ast.IsNot, ast.In, ast.NotIn,
+    ast.If, ast.For, ast.While, ast.Break, ast.Continue, ast.Pass,
+    ast.Call, ast.keyword, ast.Starred,
+    ast.Subscript, ast.Slice,
+    ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp,
+    ast.comprehension,
+    ast.Attribute,  # gated further by _SAFE_METHODS below
+    ast.JoinedStr, ast.FormattedValue,  # f-strings (no .format with its
+    # attribute-walking format-spec machinery — only plain interpolation)
+    ast.Try, ast.ExceptHandler, ast.Raise,
+)
+
+# methods callable on values the sandbox can construct; NEVER underscore
+# names, NEVER `format` (its format-spec minilanguage walks attributes)
+_SAFE_METHODS = frozenset({
+    # str/bytes
+    "upper", "lower", "strip", "lstrip", "rstrip", "split", "rsplit",
+    "splitlines", "join", "replace", "startswith", "endswith", "find",
+    "rfind", "index", "count", "encode", "decode", "title", "capitalize",
+    "casefold", "zfill", "ljust", "rjust", "isdigit", "isalpha",
+    "isalnum", "isspace", "islower", "isupper", "hex", "removeprefix",
+    "removesuffix", "partition", "rpartition",
+    # dict
+    "get", "keys", "values", "items", "setdefault", "pop", "update",
+    # list/set
+    "append", "extend", "insert", "remove", "clear", "sort", "reverse",
+    "copy", "add", "discard", "union", "intersection", "difference",
+})
+
+_SAFE_BUILTINS = {
+    "len": len, "int": int, "float": float, "str": str, "bytes": bytes,
+    "bool": bool, "dict": dict, "list": list, "tuple": tuple, "set": set,
+    "min": min, "max": max, "sum": sum, "abs": abs, "round": round,
+    "sorted": sorted, "reversed": reversed, "range": range,
+    "enumerate": enumerate, "zip": zip, "map": map, "filter": filter,
+    "any": any, "all": all, "ord": ord, "chr": chr, "repr": repr,
+    "isinstance": isinstance, "divmod": divmod, "hash": hash,
+    "ValueError": ValueError, "TypeError": TypeError, "KeyError": KeyError,
+    "Exception": Exception, "StopIteration": StopIteration,
+    # json travels as plain names (no attribute access on modules)
+    "json_loads": json.loads,
+    "json_dumps": lambda obj: json.dumps(obj, separators=(",", ":")),
+}
+
+
+# names refused at validation even though empty __builtins__ would already
+# NameError them at runtime — deploy-time rejection with a clear reason is
+# the contract (and defense in depth if the globals table ever grows)
+_DENIED_NAMES = frozenset({
+    "getattr", "setattr", "delattr", "hasattr", "eval", "exec", "compile",
+    "open", "input", "breakpoint", "globals", "locals", "vars", "dir",
+    "type", "object", "super", "memoryview", "classmethod", "staticmethod",
+    "property", "callable", "id", "help", "exit", "quit", "license",
+    "copyright", "credits", "import",
+})
+
+
+def validate_source(source: str) -> ast.Module:
+    """Parse + whitelist-check; raises SandboxViolation with a reason."""
+    if len(source.encode()) > MAX_SOURCE_BYTES:
+        raise SandboxViolation(f"source exceeds {MAX_SOURCE_BYTES} bytes")
+    try:
+        tree = ast.parse(source)
+    except (SyntaxError, ValueError, MemoryError, RecursionError) as e:
+        # pathological sources under the byte cap can blow the parser
+        # itself (MemoryError on long operator chains) — every parse
+        # failure is a validation failure, never a broker fault
+        raise SandboxViolation(f"unparseable source: {type(e).__name__}: {e}") from e
+    if (
+        len(tree.body) != 1
+        or not isinstance(tree.body[0], ast.FunctionDef)
+        or tree.body[0].name != "transform"
+    ):
+        raise SandboxViolation("source must define exactly one function: def transform(value)")
+    fn = tree.body[0]
+    if fn.decorator_list:
+        raise SandboxViolation("decorators are not allowed")
+    a = fn.args
+    if (
+        len(a.args) != 1 or a.vararg or a.kwarg or a.kwonlyargs
+        or a.posonlyargs or a.defaults
+    ):
+        raise SandboxViolation("transform must take exactly one positional argument")
+    for node in ast.walk(tree):
+        if not isinstance(node, _ALLOWED_NODES):
+            raise SandboxViolation(f"disallowed syntax: {type(node).__name__}")
+        if isinstance(node, ast.FunctionDef) and node is not fn:
+            raise SandboxViolation("nested function definitions are not allowed")
+        if isinstance(node, ast.Attribute):
+            if node.attr.startswith("_"):
+                raise SandboxViolation(f"underscore attribute access: {node.attr}")
+            if node.attr not in _SAFE_METHODS:
+                raise SandboxViolation(f"attribute not in safe set: {node.attr}")
+            if not isinstance(node.ctx, ast.Load):
+                raise SandboxViolation("attribute assignment is not allowed")
+        if isinstance(node, ast.Name):
+            if node.id.startswith("__"):
+                raise SandboxViolation(f"dunder name: {node.id}")
+            if node.id in _DENIED_NAMES:
+                raise SandboxViolation(f"denied name: {node.id}")
+        if isinstance(node, ast.Try):
+            if node.finalbody:
+                # a finally block runs AFTER a budget kill with tracing
+                # already unset — an unbounded escape hatch
+                raise SandboxViolation("finally blocks are not allowed")
+        if isinstance(node, ast.ExceptHandler):
+            # the budget kill is a BaseException; handlers must not be able
+            # to catch it
+            names = []
+            if node.type is None:
+                raise SandboxViolation("bare except is not allowed")
+            for t in ast.walk(node.type):
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+            if "BaseException" in names:
+                raise SandboxViolation("except BaseException is not allowed")
+        if isinstance(node, ast.FormattedValue) and node.format_spec is not None:
+            # format specs run the attribute-walking format machinery
+            for sub in ast.walk(node.format_spec):
+                if isinstance(sub, ast.FormattedValue):
+                    raise SandboxViolation("nested format specs are not allowed")
+    return tree
+
+
+def compile_transform(source: str):
+    """validate + compile -> callable(value: bytes) -> bytes | None.
+
+    Each call runs under a line-budget trace; the returned callable raises
+    SandboxBudgetExceeded when a record overruns EXEC_LINE_BUDGET."""
+    tree = validate_source(source)
+    code = compile(tree, "<coproc-sandbox>", "exec")
+    glb: dict = {"__builtins__": {}}
+    glb.update(_SAFE_BUILTINS)
+    exec(code, glb)  # defines transform in glb; body is whitelisted
+    fn = glb["transform"]
+
+    def run(value: bytes):
+        budget = EXEC_LINE_BUDGET
+
+        def tracer(frame, event, arg):
+            nonlocal budget
+            if event == "line":
+                budget -= 1
+                if budget <= 0:
+                    raise SandboxBudgetExceeded(
+                        f"transform exceeded {EXEC_LINE_BUDGET} lines"
+                    )
+            return tracer
+
+        old = sys.gettrace()
+        sys.settrace(tracer)
+        try:
+            out = fn(value)
+        except SandboxBudgetExceeded as e:
+            # escaped every user frame (validation forbids catching it);
+            # convert to a plain Exception for the ErrorPolicy machinery
+            raise SandboxRuntimeError(str(e)) from None
+        finally:
+            sys.settrace(old)
+        if out is None:
+            return None
+        if isinstance(out, str):
+            return out.encode()
+        if isinstance(out, (bytes, bytearray)):
+            return bytes(out)
+        raise TypeError(f"transform must return bytes|str|None, got {type(out).__name__}")
+
+    run.__name__ = "sandboxed_transform"
+    return run

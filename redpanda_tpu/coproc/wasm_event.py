@@ -23,6 +23,7 @@ from redpanda_tpu.models.record import Record, RecordBatch, RecordHeader
 DEPLOY = b"deploy"
 REMOVE = b"remove"
 EVENT_TYPE = b"transform-spec"
+EVENT_TYPE_PY = b"py-sandbox"  # sandboxed python transform (coproc/sandbox.py)
 
 
 @dataclass
@@ -32,6 +33,8 @@ class WasmEvent:
     spec_json: str = ""
     input_topics: tuple[str, ...] = ()
     checksum: int = 0
+    py_source: str = ""  # non-empty for EVENT_TYPE_PY deploys
+    policy: str = "skip"  # "skip" | "deregister" (wasm_event.h policy)
 
     @property
     def script_id(self) -> int:
@@ -52,6 +55,40 @@ def make_deploy_record(name: str, spec_json: str, input_topics: list[str]) -> Re
             RecordHeader(b"action", DEPLOY),
             RecordHeader(b"checksum", struct.pack("<Q", xxhash64(value))),
             RecordHeader(b"type", EVENT_TYPE),
+        ),
+    )
+
+
+def make_py_deploy_record(
+    name: str,
+    py_source: str,
+    input_topics: list[str],
+    policy: str = "skip",
+) -> Record:
+    """Deploy a sandboxed python transform over the SAME event path as DSL
+    specs (the reference ships JS blobs the same way, wasm_event.h:28-41).
+    Validation happens again on every consuming broker at enable time; this
+    client-side check fails fast at the deploy call site."""
+    from redpanda_tpu.coproc.sandbox import validate_source
+
+    validate_source(py_source)
+    if policy not in ("skip", "deregister"):
+        raise ValueError(f"unknown policy {policy!r}")
+    value = json.dumps(
+        {
+            "py_source": py_source,
+            "input_topics": list(input_topics),
+            "policy": policy,
+        },
+        separators=(",", ":"),
+    ).encode()
+    return Record(
+        key=name.encode(),
+        value=value,
+        headers=(
+            RecordHeader(b"action", DEPLOY),
+            RecordHeader(b"checksum", struct.pack("<Q", xxhash64(value))),
+            RecordHeader(b"type", EVENT_TYPE_PY),
         ),
     )
 
@@ -86,13 +123,23 @@ def parse_event(rec: Record) -> WasmEvent | None:
         return None
     try:
         body = json.loads(rec.value.decode())
-        spec_json = json.dumps(body["spec"])
         topics = tuple(body["input_topics"])
+        if headers.get(b"type") == EVENT_TYPE_PY:
+            py_source = body["py_source"]
+            policy = body.get("policy", "skip")
+            if policy not in ("skip", "deregister") or not isinstance(py_source, str):
+                return None
+            ev = WasmEvent(
+                name, DEPLOY, "", topics, csum,
+                py_source=py_source, policy=policy,
+            )
+        else:
+            ev = WasmEvent(name, DEPLOY, json.dumps(body["spec"]), topics, csum)
     except (ValueError, KeyError):
         return None
     if not topics:
         return None
-    return WasmEvent(name, DEPLOY, spec_json, topics, csum)
+    return ev
 
 
 def reconcile(events: list[WasmEvent]) -> dict[str, WasmEvent]:

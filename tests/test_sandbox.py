@@ -1,0 +1,216 @@
+"""Sandboxed wire-deployable transforms (coproc/sandbox.py).
+
+Containment tests: every classic python-sandbox escape route must be
+rejected at VALIDATION time (the deploy path), runaway execution must be
+cut by the line budget, and the happy path must transform records through
+the real engine with both error policies. The reference gets this
+isolation from its out-of-process V8 supervisor
+(src/js/modules/supervisors/); here the boundary is the restricted AST +
+execution budget, so these tests are the security contract.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from redpanda_tpu.coproc.sandbox import (
+    SandboxRuntimeError,
+    SandboxViolation,
+    compile_transform,
+    validate_source,
+)
+
+GOOD = """
+def transform(value):
+    doc = json_loads(value.decode())
+    if doc.get("level") != "error":
+        return None
+    out = {"code": int(doc["code"]) * 2, "msg": doc["msg"].upper()}
+    return json_dumps(out)
+"""
+
+
+def test_happy_path_transform():
+    fn = compile_transform(GOOD)
+    rec = json.dumps({"level": "error", "code": 21, "msg": "boom"}).encode()
+    assert json.loads(fn(rec)) == {"code": 42, "msg": "BOOM"}
+    assert fn(json.dumps({"level": "info"}).encode()) is None
+
+
+MALICIOUS = [
+    # imports
+    "import os\ndef transform(value):\n    return value\n",
+    "def transform(value):\n    import os\n    return value\n",
+    "def transform(value):\n    __import__('os')\n    return value\n",
+    # dunder / attribute escapes (the __class__.__mro__ ladder)
+    "def transform(value):\n    return ().__class__.__mro__\n",
+    "def transform(value):\n    return value.__class__\n",
+    "def transform(value):\n    x = getattr(value, 'decode')\n    return x()\n",
+    "def transform(value):\n    return open('/etc/passwd').read()\n",
+    "def transform(value):\n    exec('x=1')\n    return value\n",
+    "def transform(value):\n    eval('1')\n    return value\n",
+    # attribute not in safe set / assignment
+    "def transform(value):\n    return value.format()\n",
+    "def transform(value):\n    value.x = 1\n    return value\n",
+    # state/scoping escapes
+    "x = 1\ndef transform(value):\n    return value\n",
+    "def transform(value):\n    global leak\n    leak = value\n    return value\n",
+    "def transform(value):\n    def inner():\n        return 1\n    return value\n",
+    "def transform(value):\n    f = lambda: 1\n    return value\n",
+    # wrong shape
+    "def other(value):\n    return value\n",
+    "def transform(a, b):\n    return a\n",
+    "def transform(value, *rest):\n    return value\n",
+    # generators-as-coroutines
+    "def transform(value):\n    yield value\n",
+    # await/async
+    "async def transform(value):\n    return value\n",
+    # walrus into comprehension leak is fine to refuse outright
+    "def transform(value):\n    return [y := 1 for _ in range(1)]\n",
+]
+
+
+@pytest.mark.parametrize("src", MALICIOUS, ids=range(len(MALICIOUS)))
+def test_malicious_sources_rejected(src):
+    with pytest.raises(SandboxViolation):
+        validate_source(src)
+
+
+def test_runaway_loop_hits_budget():
+    fn = compile_transform(
+        "def transform(value):\n"
+        "    n = 0\n"
+        "    while True:\n"
+        "        n = n + 1\n"
+        "    return value\n"
+    )
+    with pytest.raises(SandboxRuntimeError):
+        fn(b"x")
+
+
+def test_runaway_recursion_contained():
+    fn = compile_transform(
+        "def transform(value):\n    return transform(value)\n"
+    )
+    with pytest.raises((SandboxRuntimeError, RecursionError)):
+        fn(b"x")
+
+
+def test_budget_kill_not_swallowable_by_user_except():
+    """The documented escape: catch the budget exception with
+    `except Exception` (legal syntax), then keep looping with tracing
+    unset. The BaseException design + finally/bare-except bans must make
+    this terminate with the budget error instead of hanging."""
+    fn = compile_transform(
+        "def transform(value):\n"
+        "    hits = 0\n"
+        "    while hits < 3:\n"
+        "        try:\n"
+        "            n = 0\n"
+        "            while True:\n"
+        "                n = n + 1\n"
+        "        except Exception:\n"
+        "            hits = hits + 1\n"
+        "    return value\n"
+    )
+    with pytest.raises(SandboxRuntimeError):
+        fn(b"x")
+
+
+def test_finally_and_broad_except_rejected():
+    with pytest.raises(SandboxViolation, match="finally"):
+        validate_source(
+            "def transform(value):\n"
+            "    try:\n        x = 1\n    finally:\n        x = 2\n"
+            "    return value\n"
+        )
+    with pytest.raises(SandboxViolation, match="bare except"):
+        validate_source(
+            "def transform(value):\n"
+            "    try:\n        x = 1\n    except:\n        x = 2\n"
+            "    return value\n"
+        )
+    with pytest.raises(SandboxViolation, match="BaseException"):
+        validate_source(
+            "def transform(value):\n"
+            "    try:\n        x = 1\n    except BaseException:\n        x = 2\n"
+            "    return value\n"
+        )
+
+
+def test_pathological_source_is_violation_not_crash():
+    # a sub-cap source that blows up the PARSER itself (MemoryError on
+    # long operator chains in CPython 3.12) must be a validation failure
+    src = "def transform(value):\n    return " + "-" * 60000 + "1\n"
+    with pytest.raises(SandboxViolation):
+        validate_source(src)
+
+
+def test_builtins_are_empty_in_sandbox():
+    # the compiled function's globals must not expose real builtins
+    fn = compile_transform(GOOD)
+    glb = fn.__closure__[0].cell_contents.__globals__ if fn.__closure__ else None
+    # reach the inner transform through the wrapper's closure
+    inner = [c.cell_contents for c in fn.__closure__ if callable(c.cell_contents)][0]
+    assert inner.__globals__["__builtins__"] == {}
+    assert "open" not in inner.__globals__
+    assert "getattr" not in inner.__globals__
+
+
+def test_wrong_return_type_is_an_error():
+    fn = compile_transform("def transform(value):\n    return 42\n")
+    with pytest.raises(TypeError):
+        fn(b"x")
+
+
+# ------------------------------------------------------------- engine wiring
+def test_engine_enable_sandboxed_and_policies():
+    from redpanda_tpu.coproc import (
+        EnableResponseCode,
+        ProcessBatchRequest,
+        TpuEngine,
+    )
+    from redpanda_tpu.coproc.engine import ErrorPolicy, ProcessBatchItem
+    from redpanda_tpu.models import NTP, Record, RecordBatch
+
+    def batch(vals):
+        return RecordBatch.build(
+            [Record(offset_delta=i, value=v) for i, v in enumerate(vals)]
+        )
+
+    # malicious source refused at enable (never registered)
+    engine = TpuEngine()
+    code = engine.enable_py_sandboxed(1, MALICIOUS[0], ("t",))
+    assert code == EnableResponseCode.internal_error
+    assert engine.heartbeat() == 0
+
+    # skip_on_failure: the crashing record is dropped, others transform
+    crashy = (
+        "def transform(value):\n"
+        "    if value == b'bad':\n"
+        "        raise ValueError('nope')\n"
+        "    return value.upper()\n"
+    )
+    assert engine.enable_py_sandboxed(2, crashy, ("t",)) == EnableResponseCode.success
+    req = ProcessBatchRequest(
+        [ProcessBatchItem(2, NTP.kafka("t", 0), [batch([b"aa", b"bad", b"bb"])])]
+    )
+    reply = engine.process_batch(req)
+    vals = [bytes(v) for b in reply.items[0].batches for v in b.record_values()]
+    assert vals == [b"AA", b"BB"]
+    assert engine.heartbeat() == 1
+
+    # deregister: one crash unloads the script
+    engine2 = TpuEngine()
+    assert (
+        engine2.enable_py_sandboxed(3, crashy, ("t",), ErrorPolicy.deregister)
+        == EnableResponseCode.success
+    )
+    req2 = ProcessBatchRequest(
+        [ProcessBatchItem(3, NTP.kafka("t", 0), [batch([b"aa", b"bad"])])]
+    )
+    reply2 = engine2.process_batch(req2)
+    assert reply2.deregistered == [3]
+    assert engine2.heartbeat() == 0
