@@ -369,16 +369,30 @@ def cmd_generate(args) -> int:
 
 
 def cmd_tune(args) -> int:
-    """The reference's tuners mutate kernel/device state (aio, irq, cpu
-    governor, hugepages — pkg/tuners). This runtime targets TPU hosts where
-    those knobs are managed by the platform; report what WOULD be tuned."""
-    tuners = [
-        "aio_events", "clocksource", "cpu_governor", "disk_irq",
-        "disk_scheduler", "net_irq", "hugepages", "ballast_file",
-    ]
-    for t in tuners:
-        print(f"{t:<16} skipped (platform-managed on TPU hosts)")
-    return 0
+    """Checker/tunable autotune (tuners/check.go + checked_tunable.go):
+    each tuner reads real kernel state, reports ok/would-tune/unsupported,
+    and mutates when permitted; --dry-run stops after the check."""
+    from redpanda_tpu.cli.tuners import all_tuners, format_outcomes, run_tuners
+
+    known = [t.name for t in all_tuners()]
+    if args.tuner == "list":
+        print("\n".join(known))
+        return 0
+    names = None if args.tuner == "all" else [args.tuner]
+    if names and names[0] not in known:
+        print(f"unknown tuner {names[0]!r}; `rpk tune list` shows them", file=sys.stderr)
+        return 1
+    outcomes = run_tuners(
+        names,
+        root=args.root,
+        dry_run=args.dry_run,
+        ballast_path=args.ballast_path,
+        ballast_size=args.ballast_size,
+    )
+    print(format_outcomes(outcomes, args.dry_run))
+    # exit 1 when anything errored or an apply failed verification
+    bad = any(o.error or (o.applied and o.post_ok is False) for o in outcomes)
+    return 1 if bad else 0
 
 
 def cmd_iotune(args) -> int:
@@ -505,7 +519,21 @@ def build_parser() -> argparse.ArgumentParser:
     gk.add_argument("--image", default="redpanda-tpu:latest")
     gk.add_argument("--storage", default="20Gi")
 
-    sub.add_parser("tune", help="report platform tuners")
+    tns = sub.add_parser("tune", help="check and apply kernel tuners (autotune)")
+    tns.add_argument(
+        "tuner", nargs="?", default="all",
+        help="'all', 'list', or one tuner name",
+    )
+    tns.add_argument(
+        "--dry-run", action="store_true",
+        help="report required changes without mutating anything",
+    )
+    tns.add_argument(
+        "--root", default="/",
+        help="filesystem root for /proc and /sys (tests/containers)",
+    )
+    tns.add_argument("--ballast-path", default=None)
+    tns.add_argument("--ballast-size", type=int, default=None)
     iop = sub.add_parser("iotune", help="benchmark the data dir, write io-config.json")
     # default must match the broker's data_directory default so a stock
     # `rpk iotune` + `redpanda start` pair actually connects

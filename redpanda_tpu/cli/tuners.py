@@ -1,0 +1,318 @@
+"""rpk tune — the checker/tunable autotune framework.
+
+Parity with the reference's tuner suite (src/go/rpk/pkg/tuners/check.go
+Check(), checked_tunable.go checkedTunable.Tune(), aio.go, clocksource.go,
+hugepages, ballast; the autotune story of docs/www/autotune.md): each
+tuner couples a CHECKER that reads real system state with a TUNE action
+that mutates it, run as check -> (ok? skip) -> supported? -> apply ->
+post-check. `--dry-run` stops after the check and reports the delta that
+WOULD be applied.
+
+All file access goes through SysFs, a root-prefixed view of /proc and
+/sys — production uses root="/", tests point it at a faked tree (the
+reference injects afero.Fs the same way).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from dataclasses import dataclass
+
+
+class Severity(enum.Enum):
+    fatal = "fatal"
+    warning = "warning"
+
+
+@dataclass
+class CheckResult:
+    ok: bool
+    current: str
+    required: str
+    err: str = ""
+
+
+@dataclass
+class TuneOutcome:
+    """One tuner's full story for the report table."""
+
+    name: str
+    supported: bool
+    reason: str = ""  # why unsupported
+    checked: CheckResult | None = None
+    applied: bool = False
+    post_ok: bool | None = None
+    error: str = ""
+
+
+class SysFs:
+    """Root-prefixed /proc//sys accessor (afero-style injection point)."""
+
+    def __init__(self, root: str = "/") -> None:
+        self.root = root
+
+    def _p(self, path: str) -> str:
+        return os.path.join(self.root, path.lstrip("/"))
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._p(path))
+
+    def read(self, path: str) -> str:
+        with open(self._p(path)) as f:
+            return f.read().strip()
+
+    def write(self, path: str, value: str) -> None:
+        with open(self._p(path), "w") as f:
+            f.write(value)
+
+
+class Tuner:
+    """check() reads state; apply() mutates it. Subclasses define both
+    plus supported() (e.g. the knob's file exists on this kernel)."""
+
+    name = ""
+    severity = Severity.warning
+
+    def supported(self, fs: SysFs) -> tuple[bool, str]:
+        raise NotImplementedError
+
+    def check(self, fs: SysFs) -> CheckResult:
+        raise NotImplementedError
+
+    def apply(self, fs: SysFs) -> None:
+        raise NotImplementedError
+
+    # ------------------------------------------------- checked-tunable flow
+    def run(self, fs: SysFs, dry_run: bool = False) -> TuneOutcome:
+        out = TuneOutcome(self.name, supported=True)
+        sup, reason = self.supported(fs)
+        if not sup:
+            out.supported = False
+            out.reason = reason
+            return out
+        try:
+            out.checked = self.check(fs)
+        except OSError as e:
+            out.error = f"check failed: {e}"
+            return out
+        if out.checked.ok or dry_run:
+            return out
+        try:
+            self.apply(fs)
+            out.applied = True
+        except OSError as e:
+            out.error = f"apply failed: {e}"
+            return out
+        try:
+            out.post_ok = self.check(fs).ok  # checked_tunable post-check
+        except OSError as e:
+            out.error = f"post-check failed: {e}"
+        return out
+
+
+# ---------------------------------------------------------------- tuners
+class AioMaxNr(Tuner):
+    """fs.aio-max-nr >= 1048576 (tuners/aio.go: seastar needs AIO slots
+    proportional to shard count; the reference requires >= 1048576)."""
+
+    name = "aio_events"
+    severity = Severity.fatal
+    PATH = "/proc/sys/fs/aio-max-nr"
+    REQUIRED = 1048576
+
+    def supported(self, fs: SysFs) -> tuple[bool, str]:
+        if not fs.exists(self.PATH):
+            return False, f"{self.PATH} missing (kernel without AIO?)"
+        return True, ""
+
+    def check(self, fs: SysFs) -> CheckResult:
+        cur = int(fs.read(self.PATH))
+        return CheckResult(cur >= self.REQUIRED, str(cur), f">= {self.REQUIRED}")
+
+    def apply(self, fs: SysFs) -> None:
+        fs.write(self.PATH, str(self.REQUIRED))
+
+
+class Swappiness(Tuner):
+    """vm.swappiness <= 1 (tuners/sys memory posture: the broker's page
+    cache must not be swapped out under it)."""
+
+    name = "swappiness"
+    PATH = "/proc/sys/vm/swappiness"
+    REQUIRED = 1
+
+    def supported(self, fs: SysFs) -> tuple[bool, str]:
+        if not fs.exists(self.PATH):
+            return False, f"{self.PATH} missing"
+        return True, ""
+
+    def check(self, fs: SysFs) -> CheckResult:
+        cur = int(fs.read(self.PATH))
+        return CheckResult(cur <= self.REQUIRED, str(cur), f"<= {self.REQUIRED}")
+
+    def apply(self, fs: SysFs) -> None:
+        fs.write(self.PATH, str(self.REQUIRED))
+
+
+class Clocksource(Tuner):
+    """current_clocksource == tsc (tuners/clocksource.go: non-tsc sources
+    cost a vsyscall per timestamp on the hot path)."""
+
+    name = "clocksource"
+    CUR = "/sys/devices/system/clocksource/clocksource0/current_clocksource"
+    AVAIL = "/sys/devices/system/clocksource/clocksource0/available_clocksource"
+    REQUIRED = "tsc"
+
+    def supported(self, fs: SysFs) -> tuple[bool, str]:
+        if not fs.exists(self.CUR):
+            return False, f"{self.CUR} missing"
+        if self.REQUIRED not in fs.read(self.AVAIL).split():
+            return False, "tsc not in available_clocksource"
+        return True, ""
+
+    def check(self, fs: SysFs) -> CheckResult:
+        cur = fs.read(self.CUR)
+        return CheckResult(cur == self.REQUIRED, cur, self.REQUIRED)
+
+    def apply(self, fs: SysFs) -> None:
+        fs.write(self.CUR, self.REQUIRED)
+
+
+class TransparentHugepages(Tuner):
+    """THP enabled 'always' (hugepage-backed allocators drop TLB pressure;
+    the reference's hugepages posture, tuners/hugepages)."""
+
+    name = "transparent_hugepages"
+    PATH = "/sys/kernel/mm/transparent_hugepage/enabled"
+    REQUIRED = "always"
+
+    def supported(self, fs: SysFs) -> tuple[bool, str]:
+        if not fs.exists(self.PATH):
+            return False, f"{self.PATH} missing (THP not built in)"
+        return True, ""
+
+    def check(self, fs: SysFs) -> CheckResult:
+        raw = fs.read(self.PATH)  # e.g. "always [madvise] never"
+        cur = raw[raw.find("[") + 1 : raw.find("]")] if "[" in raw else raw
+        return CheckResult(cur == self.REQUIRED, cur, self.REQUIRED)
+
+    def apply(self, fs: SysFs) -> None:
+        fs.write(self.PATH, self.REQUIRED)
+
+
+class Nofile(Tuner):
+    """RLIMIT_NOFILE soft limit >= 102400 (file_limit checkers: a broker
+    holds an fd per segment + per connection). Applies to THIS process
+    tree via setrlimit — the one tuner whose state is not a /proc file."""
+
+    name = "nofile"
+    REQUIRED = 102400
+
+    def supported(self, fs: SysFs) -> tuple[bool, str]:
+        return True, ""
+
+    def _limits(self):
+        import resource
+
+        return resource.getrlimit(resource.RLIMIT_NOFILE)
+
+    def check(self, fs: SysFs) -> CheckResult:
+        import resource
+
+        soft, _hard = self._limits()
+        if soft == resource.RLIM_INFINITY:
+            return CheckResult(True, "unlimited", f">= {self.REQUIRED}")
+        return CheckResult(soft >= self.REQUIRED, str(soft), f">= {self.REQUIRED}")
+
+    def apply(self, fs: SysFs) -> None:
+        import resource
+
+        soft, hard = self._limits()
+        # NEVER touch the hard limit: lowering it (e.g. from unlimited)
+        # is irreversible without CAP_SYS_RESOURCE (syschecks.py posture)
+        if hard == resource.RLIM_INFINITY:
+            target = max(self.REQUIRED, 0 if soft == resource.RLIM_INFINITY else soft)
+        else:
+            target = min(max(self.REQUIRED, soft), hard)
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (target, hard))
+        except ValueError as e:
+            raise OSError(str(e)) from e
+
+
+class BallastFile(Tuner):
+    """Preallocated ballast so a disk-full incident has a deletable escape
+    hatch (tuners/ballast). Size is deliberately modest by default."""
+
+    name = "ballast_file"
+
+    def __init__(self, path: str = "/var/lib/redpanda/ballast", size: int = 1 << 30):
+        self.path = path
+        self.size = size
+
+    def supported(self, fs: SysFs) -> tuple[bool, str]:
+        parent = os.path.dirname(fs._p(self.path))
+        if not os.path.isdir(parent):
+            return False, f"parent directory missing: {os.path.dirname(self.path)}"
+        return True, ""
+
+    def check(self, fs: SysFs) -> CheckResult:
+        p = fs._p(self.path)
+        cur = os.path.getsize(p) if os.path.exists(p) else 0
+        return CheckResult(cur >= self.size, str(cur), f">= {self.size} bytes")
+
+    def apply(self, fs: SysFs) -> None:
+        p = fs._p(self.path)
+        with open(p, "wb") as f:
+            f.truncate(self.size)
+
+
+def all_tuners(ballast_path: str | None = None, ballast_size: int | None = None) -> list[Tuner]:
+    ballast = BallastFile(
+        ballast_path or "/var/lib/redpanda/ballast",
+        ballast_size if ballast_size is not None else 1 << 30,
+    )
+    return [
+        AioMaxNr(), Swappiness(), Clocksource(), TransparentHugepages(),
+        Nofile(), ballast,
+    ]
+
+
+def run_tuners(
+    names: list[str] | None = None,
+    *,
+    root: str = "/",
+    dry_run: bool = False,
+    ballast_path: str | None = None,
+    ballast_size: int | None = None,
+) -> list[TuneOutcome]:
+    fs = SysFs(root)
+    tuners = all_tuners(ballast_path, ballast_size)
+    if names:
+        tuners = [t for t in tuners if t.name in set(names)]
+    return [t.run(fs, dry_run=dry_run) for t in tuners]
+
+
+def format_outcomes(outcomes: list[TuneOutcome], dry_run: bool) -> str:
+    lines = []
+    for o in outcomes:
+        if not o.supported:
+            lines.append(f"{o.name:<24} unsupported  ({o.reason})")
+        elif o.error:
+            lines.append(f"{o.name:<24} ERROR        ({o.error})")
+        elif o.checked and o.checked.ok:
+            lines.append(f"{o.name:<24} ok           (current: {o.checked.current})")
+        elif dry_run:
+            lines.append(
+                f"{o.name:<24} would-tune   (current: {o.checked.current}, "
+                f"required: {o.checked.required})"
+            )
+        elif o.applied and o.post_ok:
+            lines.append(f"{o.name:<24} tuned        (was: {o.checked.current})")
+        else:
+            lines.append(
+                f"{o.name:<24} tuned-UNVERIFIED (post-check failed; was: "
+                f"{o.checked.current}, required: {o.checked.required})"
+            )
+    return "\n".join(lines)

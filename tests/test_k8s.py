@@ -128,3 +128,178 @@ class TestAdminDecommission:
                 await storage.stop()
 
         asyncio.run(body())
+
+
+class TestReconcilingOperator:
+    """Watch/reconcile controller (cli/k8s.py Operator) against faked kube
+    and admin APIs — the three transitions the reference's CRD controller
+    handles (cluster_controller.go Reconcile): scale-up, drain-then-shrink
+    scale-down, and dead-pod replacement."""
+
+    def _fakes(self, replicas=3, partitions_per_node=4):
+        class FakeKube:
+            def __init__(self):
+                self.desired = replicas
+                self.sts = replicas
+                self.deleted: list[str] = []
+                self.pods = {
+                    i: {"name": f"rp-{i}", "ordinal": i, "ready": True}
+                    for i in range(replicas)
+                }
+
+            async def get_desired_replicas(self):
+                return self.desired
+
+            async def get_sts_replicas(self):
+                return self.sts
+
+            async def set_sts_replicas(self, n):
+                # fake statefulset: creates/destroys pods immediately
+                self.sts = n
+                for i in range(n):
+                    self.pods.setdefault(
+                        i, {"name": f"rp-{i}", "ordinal": i, "ready": True}
+                    )
+                for i in list(self.pods):
+                    if i >= n:
+                        del self.pods[i]
+
+            async def list_pods(self):
+                return list(self.pods.values())
+
+            async def delete_pod(self, name):
+                self.deleted.append(name)
+                ordinal = int(name.rsplit("-", 1)[1])
+                # statefulset recreates it ready; the broker rejoins
+                self.pods[ordinal] = {
+                    "name": name, "ordinal": ordinal, "ready": True
+                }
+                admin.brokers_state[ordinal] = {
+                    "node_id": ordinal, "membership_status": "active",
+                    "is_alive": True,
+                }
+
+        class FakeAdmin:
+            def __init__(self):
+                self.brokers_state = {
+                    i: {"node_id": i, "membership_status": "active",
+                        "is_alive": True}
+                    for i in range(replicas)
+                }
+                self.parts = {
+                    i: list(range(partitions_per_node)) for i in range(replicas)
+                }
+                self.decommissioned: list[int] = []
+
+            async def brokers(self):
+                return list(self.brokers_state.values())
+
+            async def decommission(self, n):
+                self.decommissioned.append(n)
+                self.brokers_state[n]["membership_status"] = "draining"
+
+            async def partitions(self, n):
+                return self.parts.get(n, [])
+
+        admin = FakeAdmin()
+        return FakeKube(), admin
+
+    def test_scale_up_adds_brokers(self):
+        from redpanda_tpu.cli.k8s import Operator
+
+        async def go():
+            kube, admin = self._fakes(replicas=3)
+            op = Operator(kube, admin)
+            kube.desired = 5
+            rep = await op.reconcile_once()
+            assert rep.actions == ["sts-scale 3->5"]
+            assert kube.sts == 5 and len(kube.pods) == 5
+            # new brokers join; next pass settles
+            for i in (3, 4):
+                admin.brokers_state[i] = {
+                    "node_id": i, "membership_status": "active",
+                    "is_alive": True,
+                }
+            rep2 = await op.reconcile_once()
+            assert rep2.settled and not rep2.actions
+
+        asyncio.run(go())
+
+    def test_scale_down_drains_before_shrinking(self):
+        from redpanda_tpu.cli.k8s import Operator
+
+        async def go():
+            kube, admin = self._fakes(replicas=4)
+            op = Operator(kube, admin)
+            kube.desired = 2
+            # pass 1: decommissions 2,3 but must NOT shrink the sts while
+            # they still host partitions
+            rep = await op.reconcile_once()
+            assert "decommission 2" in rep.actions
+            assert "decommission 3" in rep.actions
+            assert not rep.settled and kube.sts == 4
+            assert admin.decommissioned == [2, 3]
+            # pass 2: still draining -> still no shrink, no double-decomm
+            admin.parts[2] = []
+            rep2 = await op.reconcile_once()
+            assert not rep2.settled and kube.sts == 4
+            assert admin.decommissioned == [2, 3]
+            # pass 3: both drained -> sts shrinks, pods go
+            admin.parts[3] = []
+            rep3 = await op.reconcile_once()
+            assert "sts-scale 4->2" in rep3.actions
+            assert kube.sts == 2 and sorted(kube.pods) == [0, 1]
+
+        asyncio.run(go())
+
+    def test_dead_pod_replacement_rejoins(self):
+        from redpanda_tpu.cli.k8s import Operator
+
+        async def go():
+            kube, admin = self._fakes(replicas=3)
+            op = Operator(kube, admin)
+            # ordinal 1's pod wedges and its broker drops out
+            kube.pods[1]["ready"] = False
+            admin.brokers_state[1]["is_alive"] = False
+            rep = await op.reconcile_once()
+            assert rep.actions == ["replace-pod rp-1"]
+            assert kube.deleted == ["rp-1"]
+            # fake sts recreated it and the broker rejoined
+            rep2 = await op.reconcile_once()
+            assert rep2.settled and not rep2.actions
+
+        asyncio.run(go())
+
+    def test_not_ready_pod_with_live_broker_is_left_alone(self):
+        from redpanda_tpu.cli.k8s import Operator
+
+        async def go():
+            kube, admin = self._fakes(replicas=3)
+            op = Operator(kube, admin)
+            # transient: pod not ready but broker still in the cluster —
+            # deleting it would be an outage, not a repair
+            kube.pods[2]["ready"] = False
+            rep = await op.reconcile_once()
+            assert not rep.actions and kube.deleted == []
+
+        asyncio.run(go())
+
+    def test_watch_loop_converges_and_stops(self):
+        from redpanda_tpu.cli.k8s import Operator
+
+        async def go():
+            kube, admin = self._fakes(replicas=3)
+            op = Operator(kube, admin, poll_interval_s=0.01)
+            kube.desired = 4
+            stop = asyncio.Event()
+            task = asyncio.create_task(op.run(stop))
+            await asyncio.sleep(0.1)
+            admin.brokers_state[3] = {
+                "node_id": 3, "membership_status": "active", "is_alive": True,
+            }
+            await asyncio.sleep(0.1)
+            stop.set()
+            await asyncio.wait_for(task, 5)
+            assert kube.sts == 4
+
+        asyncio.run(go())

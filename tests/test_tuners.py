@@ -1,0 +1,162 @@
+"""rpk tune checker/tunable framework (cli/tuners.py) against a faked
+/proc //sys tree — check detection, mutation, dry-run immutability,
+unsupported paths, post-check verification, and the CLI surface.
+Reference shape: tuners/check.go + checked_tunable.go + aio.go."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+from redpanda_tpu.cli.tuners import (
+    AioMaxNr,
+    BallastFile,
+    Clocksource,
+    Swappiness,
+    SysFs,
+    TransparentHugepages,
+    run_tuners,
+)
+
+
+def fake_tree(tmp_path, *, aio="65536", swap="60", clock="hpet",
+              clock_avail="tsc hpet acpi_pm", thp="always [madvise] never"):
+    root = tmp_path / "sysroot"
+    for rel, content in {
+        "proc/sys/fs/aio-max-nr": aio,
+        "proc/sys/vm/swappiness": swap,
+        "sys/devices/system/clocksource/clocksource0/current_clocksource": clock,
+        "sys/devices/system/clocksource/clocksource0/available_clocksource": clock_avail,
+        "sys/kernel/mm/transparent_hugepage/enabled": thp,
+    }.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content + "\n")
+    (root / "var/lib/redpanda").mkdir(parents=True)
+    return str(root)
+
+
+def test_check_detects_needed_changes(tmp_path):
+    root = fake_tree(tmp_path)
+    fs = SysFs(root)
+    assert not AioMaxNr().check(fs).ok
+    assert not Swappiness().check(fs).ok
+    assert not Clocksource().check(fs).ok
+    thp = TransparentHugepages().check(fs)
+    assert not thp.ok and thp.current == "madvise"  # bracket parsing
+
+
+def test_apply_mutates_and_post_check_passes(tmp_path):
+    root = fake_tree(tmp_path)
+    outcomes = run_tuners(
+        ["aio_events", "swappiness", "clocksource", "transparent_hugepages"],
+        root=root,
+    )
+    for o in outcomes:
+        assert o.supported and o.applied and o.post_ok, o
+    fs = SysFs(root)
+    assert fs.read("/proc/sys/fs/aio-max-nr") == "1048576"
+    assert fs.read("/proc/sys/vm/swappiness") == "1"
+    assert fs.read(
+        "/sys/devices/system/clocksource/clocksource0/current_clocksource"
+    ) == "tsc"
+
+
+def test_already_ok_is_not_touched(tmp_path):
+    root = fake_tree(tmp_path, aio="2097152", swap="0", clock="tsc")
+    before = SysFs(root).read("/proc/sys/fs/aio-max-nr")
+    outcomes = run_tuners(["aio_events", "swappiness", "clocksource"], root=root)
+    for o in outcomes:
+        assert o.checked.ok and not o.applied, o
+    assert SysFs(root).read("/proc/sys/fs/aio-max-nr") == before
+
+
+def test_dry_run_reports_delta_without_mutating(tmp_path):
+    root = fake_tree(tmp_path)
+    outcomes = run_tuners(["aio_events", "swappiness"], root=root, dry_run=True)
+    for o in outcomes:
+        assert not o.checked.ok and not o.applied, o
+    # nothing changed on disk
+    assert SysFs(root).read("/proc/sys/fs/aio-max-nr") == "65536"
+    assert SysFs(root).read("/proc/sys/vm/swappiness") == "60"
+
+
+def test_unsupported_paths(tmp_path):
+    # empty root: every /proc //sys knob missing -> unsupported, never error
+    root = str(tmp_path / "empty")
+    os.makedirs(root)
+    outcomes = run_tuners(
+        ["aio_events", "swappiness", "clocksource", "transparent_hugepages"],
+        root=root,
+    )
+    for o in outcomes:
+        assert not o.supported and o.reason, o
+    # tsc missing from available_clocksource -> clocksource unsupported
+    root2 = fake_tree(tmp_path, clock_avail="hpet acpi_pm")
+    (o,) = run_tuners(["clocksource"], root=root2)
+    assert not o.supported and "tsc" in o.reason
+
+
+def test_ballast_file_created_and_sized(tmp_path):
+    root = fake_tree(tmp_path)
+    (o,) = run_tuners(
+        ["ballast_file"], root=root,
+        ballast_path="/var/lib/redpanda/ballast", ballast_size=4096,
+    )
+    assert o.applied and o.post_ok, o
+    assert os.path.getsize(os.path.join(root, "var/lib/redpanda/ballast")) == 4096
+    # second run: ok, untouched
+    (o2,) = run_tuners(
+        ["ballast_file"], root=root,
+        ballast_path="/var/lib/redpanda/ballast", ballast_size=4096,
+    )
+    assert o2.checked.ok and not o2.applied
+
+
+def test_nofile_check_and_apply_within_hard_limit():
+    import resource
+
+    from redpanda_tpu.cli.tuners import Nofile
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    try:
+        t = Nofile()
+        r = t.check(SysFs("/"))
+        assert r.current == str(soft)
+        # apply never lowers and never errors when within the hard cap
+        t.apply(SysFs("/"))
+        new_soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+        assert new_soft >= soft
+    finally:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (soft, hard))
+
+
+def test_cli_tune_dry_run_and_apply(tmp_path):
+    root = fake_tree(tmp_path)
+    env = {**os.environ, "PYTHONPATH": "/root/repo"}
+
+    out = subprocess.run(
+        [sys.executable, "-m", "redpanda_tpu", "tune", "all", "--dry-run",
+         "--root", root, "--ballast-path", "/var/lib/redpanda/ballast",
+         "--ballast-size", "4096"],
+        capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "would-tune" in out.stdout and "current: 65536" in out.stdout
+    assert SysFs(root).read("/proc/sys/fs/aio-max-nr") == "65536"  # untouched
+
+    out2 = subprocess.run(
+        [sys.executable, "-m", "redpanda_tpu", "tune", "aio_events",
+         "--root", root],
+        capture_output=True, text=True, env=env,
+    )
+    assert out2.returncode == 0, out2.stderr
+    assert "tuned" in out2.stdout
+    assert SysFs(root).read("/proc/sys/fs/aio-max-nr") == "1048576"
+
+    out3 = subprocess.run(
+        [sys.executable, "-m", "redpanda_tpu", "tune", "list"],
+        capture_output=True, text=True, env=env,
+    )
+    assert "aio_events" in out3.stdout and "clocksource" in out3.stdout

@@ -1,103 +1,167 @@
 #!/usr/bin/env python3
-"""Scale reconciler for k8s deployments (`rpk generate k8s-manifests`).
+"""Reconciling operator for k8s deployments (`rpk generate k8s-manifests`).
 
-The one operator behavior a StatefulSet controller cannot provide: scale-in
-must DRAIN the doomed ordinals through the cluster controller before their
-pods (and PVCs) disappear. Point this at the admin API and the desired
-replica count; it decommissions ordinals >= desired, waits for their
-partitions to drain off, then you `kubectl scale`. Scale-out needs no
-operator (new ordinals join via the seed list).
+Runs the watch/reconcile controller (redpanda_tpu/cli/k8s.py Operator —
+the reconciling twin of the reference's CRD controller,
+src/go/k8s/controllers/redpanda/cluster_controller.go) against a real
+cluster: kubectl for the StatefulSet/pods side, the admin API for the
+broker side. Every pass converges one step of scale-up, drain-then-shrink
+scale-down, or dead-pod replacement; `--once` runs a single pass (CI /
+cron), the default loops forever.
 
-    python tools/k8s_operator.py --admin http://rp-0.rp:9644 --replicas 3
+    python tools/k8s_operator.py \
+        --admin http://rp-0.rp:9644 \
+        --admin-template http://rp-{n}.rp:9644 \
+        --namespace default --statefulset rp --replicas 3
 
-Logic lives in redpanda_tpu/cli/k8s.py reconcile_scale (transport-
-parameterized; tested without k8s in tests/test_k8s.py).
+`--replicas` is the DESIRED size (the "cluster spec"); omit it to read
+the desired size from the StatefulSet's `rptpu.dev/desired-replicas`
+annotation so `kubectl annotate` is the scale control plane.
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from redpanda_tpu.cli.k8s import reconcile_scale  # noqa: E402
+from redpanda_tpu.cli.k8s import Operator  # noqa: E402
+
+ANNOTATION = "rptpu.dev/desired-replicas"
+
+
+class KubectlKube:
+    """Operator kube transport over kubectl (no client library needed)."""
+
+    def __init__(self, namespace: str, statefulset: str, desired: int | None):
+        self.ns = namespace
+        self.sts = statefulset
+        self._desired = desired
+
+    def _kubectl(self, *args: str) -> str:
+        out = subprocess.run(
+            ["kubectl", "-n", self.ns, *args],
+            capture_output=True, text=True, check=True,
+        )
+        return out.stdout
+
+    async def _json(self, *args: str):
+        raw = await asyncio.to_thread(self._kubectl, *args, "-o", "json")
+        return json.loads(raw)
+
+    async def _get_sts(self):
+        return await self._json("get", "statefulset", self.sts)
+
+    async def get_desired_replicas(self) -> int:
+        # one fetch serves this AND the get_sts_replicas call that the
+        # operator makes immediately after (same object, same pass)
+        self._sts_obj = await self._get_sts()
+        if self._desired is not None:
+            return self._desired
+        ann = self._sts_obj["metadata"].get("annotations", {})
+        return int(ann.get(ANNOTATION, self._sts_obj["spec"]["replicas"]))
+
+    async def get_sts_replicas(self) -> int:
+        sts = getattr(self, "_sts_obj", None) or await self._get_sts()
+        self._sts_obj = None
+        return int(sts["spec"]["replicas"])
+
+    async def set_sts_replicas(self, n: int) -> None:
+        await asyncio.to_thread(
+            self._kubectl, "scale", "statefulset", self.sts, f"--replicas={n}"
+        )
+
+    async def list_pods(self):
+        pods = await self._json("get", "pods", "-l", f"app={self.sts}")
+        out = []
+        for p in pods.get("items", []):
+            name = p["metadata"]["name"]
+            try:
+                ordinal = int(name.rsplit("-", 1)[1])
+            except ValueError:
+                continue
+            ready = any(
+                c["type"] == "Ready" and c["status"] == "True"
+                for c in p.get("status", {}).get("conditions", [])
+            )
+            out.append({"name": name, "ordinal": ordinal, "ready": ready})
+        return out
+
+    async def delete_pod(self, name: str) -> None:
+        await asyncio.to_thread(self._kubectl, "delete", "pod", name, "--wait=false")
 
 
 class AdminHttp:
-    def __init__(self, base: str):
+    """Operator admin transport over the owned HTTP client."""
+
+    def __init__(self, base: str, template: str | None):
         self.base = base.rstrip("/")
+        self.template = template
 
-    async def _req(self, method: str, path: str):
-        import json
-
+    async def _req(self, base: str, method: str, path: str):
         from redpanda_tpu.http import HttpClient
 
-        async with HttpClient(self.base, request_timeout=10.0) as c:
+        async with HttpClient(base, request_timeout=10.0) as c:
             r = await c.request(method, path)
             if r.status >= 400:
                 raise RuntimeError(f"{method} {path} -> {r.status}")
             return json.loads(r.body)
 
     async def brokers(self):
-        return await self._req("GET", "/v1/brokers")
+        return await self._req(self.base, "GET", "/v1/brokers")
 
     async def decommission(self, node_id: int):
-        return await self._req("PUT", f"/v1/brokers/{node_id}/decommission")
+        return await self._req(
+            self.base, "PUT", f"/v1/brokers/{node_id}/decommission"
+        )
 
-
-async def _wait_drained(template: str, node_ids: list[int], timeout_s: float) -> bool:
-    """Poll each drained node's OWN admin (`template.format(n=id)`) until it
-    hosts zero partition replicas. Returns True when all drained."""
-    import time
-
-    deadline = time.monotonic() + timeout_s
-    pending = set(node_ids)
-    while pending and time.monotonic() < deadline:
-        for n in sorted(pending):
-            try:
-                node_admin = AdminHttp(template.format(n=n))
-                parts = await node_admin._req("GET", "/v1/partitions")
-                if not parts:
-                    pending.discard(n)
-                    print(f"node {n} drained")
-            except Exception:
-                pass  # node busy moving replicas; keep polling
-        if pending:
-            await asyncio.sleep(2.0)
-    return not pending
+    async def partitions(self, node_id: int):
+        """The doomed node's OWN admin reports what it still hosts —
+        asking any other node would read the WRONG node's drain state."""
+        if not self.template:
+            raise RuntimeError("--admin-template required for drain checks")
+        return await self._req(
+            self.template.format(n=node_id), "GET", "/v1/partitions"
+        )
 
 
 async def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--admin", required=True, help="admin API base URL")
-    ap.add_argument("--replicas", type=int, required=True)
+    ap.add_argument("--admin", required=True, help="cluster admin API base URL")
     ap.add_argument(
-        "--admin-template",
-        help="per-node admin URL template, e.g. "
-        "'http://rp-{n}.rp.default.svc.cluster.local:9644' — when given, "
-        "block until the drained nodes host zero partitions",
+        "--admin-template", required=True,
+        help="per-node admin URL template, e.g. 'http://rp-{n}.rp:9644' "
+        "(drain checks poll each doomed node's own admin)",
     )
-    ap.add_argument("--wait-timeout", type=float, default=600.0)
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--statefulset", default="rp")
+    ap.add_argument(
+        "--replicas", type=int, default=None,
+        help=f"desired size; omitted -> read the {ANNOTATION} annotation",
+    )
+    ap.add_argument("--interval", type=float, default=10.0)
+    ap.add_argument("--once", action="store_true", help="single reconcile pass")
     args = ap.parse_args()
-    admin = AdminHttp(args.admin)
-    drained = await reconcile_scale(args.replicas, admin)
-    if not drained:
-        print("nothing to drain")
-        return 0
-    print(f"decommissioned node(s) {drained}")
-    if args.admin_template:
-        ok = await _wait_drained(args.admin_template, drained, args.wait_timeout)
-        if not ok:
-            print("ERROR: drain did not complete; do NOT scale down yet",
-                  file=sys.stderr)
-            return 1
-        print(f"drain complete: kubectl scale statefulset --replicas={args.replicas}")
-    else:
-        print("wait until each drained node's /v1/partitions is empty, then "
-              f"kubectl scale statefulset --replicas={args.replicas}")
+
+    op = Operator(
+        KubectlKube(args.namespace, args.statefulset, args.replicas),
+        AdminHttp(args.admin, args.admin_template),
+        poll_interval_s=args.interval,
+    )
+    if args.once:
+        rep = await op.reconcile_once()
+        print(
+            f"desired={rep.desired} sts={rep.sts_replicas} "
+            f"settled={rep.settled} actions={rep.actions}"
+        )
+        return 0 if rep.settled else 2  # 2 = converging, run me again
+    print(f"operator loop: statefulset {args.statefulset} every {args.interval}s")
+    await op.run()
     return 0
 
 
