@@ -20,6 +20,7 @@ memory units before parsing (connection_context.cc:32). Here:
 from __future__ import annotations
 
 import asyncio
+from collections import deque
 import time
 from dataclasses import dataclass
 
@@ -35,7 +36,11 @@ class MemoryBudget:
     def __init__(self, limit_bytes: int):
         self.limit = limit_bytes
         self._available = limit_bytes
-        self._cond = asyncio.Condition()
+        # FIFO of (n, future) waiters, granted synchronously by release():
+        # no tasks, no loop lookups — release is safe from ANY context,
+        # including loopless shutdown paths (a lost wakeup here would hang
+        # the produce-path backpressure gate forever)
+        self._waiters: deque[tuple[int, asyncio.Future]] = deque()
 
     @property
     def available(self) -> int:
@@ -48,23 +53,48 @@ class MemoryBudget:
     async def acquire(self, n: int) -> int:
         """Returns the amount actually reserved (clamped to the limit)."""
         n = min(n, self.limit)
-        async with self._cond:
-            await self._cond.wait_for(lambda: self._available >= n)
+        # FIFO fairness: even if n fits, queue behind existing waiters so a
+        # stream of small requests cannot starve a parked large one
+        if self._available >= n and not self._waiters:
             self._available -= n
+            return n
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append((n, fut))
+        try:
+            await fut  # resolved by _drain with the bytes already deducted
+        except asyncio.CancelledError:
+            if fut.done() and not fut.cancelled():
+                # grant landed before the cancellation: hand it back
+                self.release(n)
+            else:
+                try:
+                    self._waiters.remove((n, fut))
+                except ValueError:
+                    pass
+                self._drain()  # our slot may unblock the next waiter
+            raise
         return n
 
     def release(self, n: int) -> None:
         self._available = min(self._available + n, self.limit)
-        # wake waiters from sync contexts without requiring the lock
-        loop = asyncio.get_event_loop()
-        loop.call_soon(self._notify)
+        self._drain()
 
-    def _notify(self) -> None:
-        async def kick():
-            async with self._cond:
-                self._cond.notify_all()
-
-        asyncio.ensure_future(kick())
+    def _drain(self) -> None:
+        while self._waiters and self._waiters[0][0] <= self._available:
+            n, fut = self._waiters.popleft()
+            if fut.cancelled():
+                continue
+            try:
+                dead = fut.get_loop().is_closed()
+            except RuntimeError:
+                dead = True
+            if dead:
+                # a waiter whose loop is gone can never run: granting it
+                # would leak the bytes AND set_result would raise from the
+                # closed loop's call_soon — skip it like a cancelled one
+                continue
+            self._available -= n
+            fut.set_result(None)
 
 
 @dataclass

@@ -198,6 +198,66 @@ def test_memory_budget_blocks_and_releases():
     run(main())
 
 
+def test_memory_budget_release_without_loop_and_fifo():
+    """release() must be safe from loopless contexts (shutdown paths) —
+    the old Condition design lost the wakeup there — and waiters resolve
+    FIFO so small requests can't starve a parked large one."""
+    from redpanda_tpu.resource_mgmt import MemoryBudget
+
+    # no running loop at all: release must not raise and must restore
+    mb = MemoryBudget(100)
+
+    async def grab():
+        return await mb.acquire(80)
+
+    run(grab())
+    mb.release(80)  # called OUTSIDE any event loop
+    assert mb.available == 100
+
+    # the hazardous shutdown shape: a waiter parked when its loop CLOSED,
+    # then a loopless release — must neither raise nor leak the bytes to
+    # the dead waiter
+    mb4 = MemoryBudget(100)
+    loop = asyncio.new_event_loop()
+
+    async def park():
+        await mb4.acquire(100)  # takes the whole budget
+        asyncio.ensure_future(mb4.acquire(50))  # parks forever
+        await asyncio.sleep(0.01)
+
+    loop.run_until_complete(park())
+    loop.close()
+    mb4.release(100)  # loopless; dead waiter must be skipped, not granted
+    assert mb4.available == 100
+
+    async def fifo():
+        mb2 = MemoryBudget(100)
+        await mb2.acquire(90)
+        big = asyncio.create_task(mb2.acquire(50))
+        await asyncio.sleep(0)
+        small = asyncio.create_task(mb2.acquire(20))
+        await asyncio.sleep(0.01)
+        assert not big.done() and not small.done()
+        mb2.release(50)  # 60 free: big (queued first) takes 50, 10 left
+        await asyncio.wait_for(big, 1.0)
+        assert not small.done()  # 10 free < 20: still parked behind
+        mb2.release(80)
+        await asyncio.wait_for(small, 1.0)
+
+        # cancellation of a parked waiter unblocks the queue behind it
+        mb3 = MemoryBudget(100)
+        await mb3.acquire(100)
+        w1 = asyncio.create_task(mb3.acquire(100))
+        w2 = asyncio.create_task(mb3.acquire(10))
+        await asyncio.sleep(0.01)
+        w1.cancel()
+        mb3.release(10)
+        await asyncio.wait_for(w2, 1.0)
+        assert mb3.available == 0  # 100-100... released 10, w2 took 10
+
+    run(fifo())
+
+
 def test_kafka_server_gates_request_memory(tmp_path):
     """With a tiny memory budget, concurrent large produces are serialized
     by the gate (peak in-use never exceeds the budget) yet all succeed."""
