@@ -37,9 +37,12 @@ class MemoryBudget:
         self.limit = limit_bytes
         self._available = limit_bytes
         # FIFO of (n, future) waiters, granted synchronously by release():
-        # no tasks, no loop lookups — release is safe from ANY context,
-        # including loopless shutdown paths (a lost wakeup here would hang
-        # the produce-path backpressure gate forever)
+        # no tasks, no loop lookups — release is safe from any context ON
+        # THE LOOP'S THREAD, including loopless shutdown paths (a lost
+        # wakeup here would hang the produce-path backpressure gate
+        # forever). Cross-thread release is NOT supported: set_result
+        # wakes the waiter via its loop's call_soon, which is not
+        # thread-safe.
         self._waiters: deque[tuple[int, asyncio.Future]] = deque()
 
     @property
@@ -80,9 +83,14 @@ class MemoryBudget:
         self._drain()
 
     def _drain(self) -> None:
-        while self._waiters and self._waiters[0][0] <= self._available:
-            n, fut = self._waiters.popleft()
+        while self._waiters:
+            n, fut = self._waiters[0]
+            # liveness BEFORE the size gate: a dead head larger than the
+            # budget can never remove itself (its loop is closed, its
+            # CancelledError handler will never run) and would otherwise
+            # block every live waiter behind it forever
             if fut.cancelled():
+                self._waiters.popleft()
                 continue
             try:
                 dead = fut.get_loop().is_closed()
@@ -92,7 +100,11 @@ class MemoryBudget:
                 # a waiter whose loop is gone can never run: granting it
                 # would leak the bytes AND set_result would raise from the
                 # closed loop's call_soon — skip it like a cancelled one
+                self._waiters.popleft()
                 continue
+            if n > self._available:
+                break  # live head must wait; FIFO order preserved
+            self._waiters.popleft()
             self._available -= n
             fut.set_result(None)
 

@@ -230,6 +230,28 @@ def test_memory_budget_release_without_loop_and_fifo():
     mb4.release(100)  # loopless; dead waiter must be skipped, not granted
     assert mb4.available == 100
 
+    # a dead HEAD waiter bigger than the budget must not block live
+    # waiters queued behind it on a new loop
+    mb5 = MemoryBudget(100)
+    loop_a = asyncio.new_event_loop()
+
+    async def park_big():
+        await mb5.acquire(100)
+        asyncio.ensure_future(mb5.acquire(100))  # dead head after close
+        await asyncio.sleep(0.01)
+
+    loop_a.run_until_complete(park_big())
+    loop_a.close()
+
+    async def live_waiter():
+        w = asyncio.create_task(mb5.acquire(10))
+        await asyncio.sleep(0.01)
+        mb5.release(50)  # dead head (100 > 50) must be skipped
+        await asyncio.wait_for(w, 1.0)
+        assert mb5.available == 40
+
+    run(live_waiter())
+
     async def fifo():
         mb2 = MemoryBudget(100)
         await mb2.acquire(90)

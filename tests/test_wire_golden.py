@@ -670,3 +670,110 @@ def test_uvarint_multibyte_boundaries():
     req = uv(128) + key.encode() + i8(0) + TAG0
     assert uv(128) == b"\x80\x01"
     _rt(api, "request", req, 3, {"key": key, "key_type": 0})
+
+
+def test_offset_commit_v8_flexible_golden():
+    api = m.APIS[m.OFFSET_COMMIT]
+    req = (
+        cs("g1") + i32(5) + cs("m-1") + CNULL     # group, generation, member, instance
+        + carr(1) + cs("orders")
+        + carr(1)
+        + i32(0) + i64(42) + i32(-1)              # partition, offset, leader_epoch
+        + cs("meta") + TAG0                       # committed_metadata (nullable compact)
+        + TAG0                                    # topic struct tags
+        + TAG0
+    )
+    _rt(api, "request", req, 8, {
+        "group_id": "g1", "generation_id": 5, "member_id": "m-1",
+        "group_instance_id": None,
+        "topics": [{
+            "name": "orders",
+            "partitions": [{
+                "partition_index": 0, "committed_offset": 42,
+                "committed_leader_epoch": -1, "committed_metadata": "meta",
+            }],
+        }],
+    })
+
+    resp = (
+        i32(0)
+        + carr(1) + cs("orders")
+        + carr(1) + i32(0) + i16(0) + TAG0
+        + TAG0 + TAG0
+    )
+    _rt(api, "response", resp, 8, {
+        "throttle_time_ms": 0,
+        "topics": [{
+            "name": "orders",
+            "partitions": [{"partition_index": 0, "error_code": 0}],
+        }],
+    })
+
+
+def test_offset_fetch_v6_flexible_golden():
+    api = m.APIS[m.OFFSET_FETCH]
+    # null topics array -> "all committed topics" (compact null = 0x00)
+    req = cs("g1") + CNULL + TAG0
+    _rt(api, "request", req, 6, {"group_id": "g1", "topics": None})
+
+    resp = (
+        i32(0)
+        + carr(1) + cs("orders")
+        + carr(1)
+        + i32(0) + i64(7) + i32(-1) + cs("") + i16(0) + TAG0
+        + TAG0
+        + i16(0)                                  # top-level error_code
+        + TAG0
+    )
+    _rt(api, "response", resp, 6, {
+        "throttle_time_ms": 0,
+        "topics": [{
+            "name": "orders",
+            "partitions": [{
+                "partition_index": 0, "committed_offset": 7,
+                "committed_leader_epoch": -1, "metadata": "", "error_code": 0,
+            }],
+        }],
+        "error_code": 0,
+    })
+
+
+def test_init_producer_id_v2_flexible_golden():
+    api = m.APIS[m.INIT_PRODUCER_ID]
+    req = CNULL + i32(60000) + TAG0               # null transactional_id
+    _rt(api, "request", req, 2, {
+        "transactional_id": None, "transaction_timeout_ms": 60000,
+    })
+    resp = i32(0) + i16(0) + i64(4000) + i16(1) + TAG0
+    _rt(api, "response", resp, 2, {
+        "throttle_time_ms": 0, "error_code": 0,
+        "producer_id": 4000, "producer_epoch": 1,
+    })
+
+
+def test_delete_topics_v4_flexible_golden():
+    api = m.APIS[m.DELETE_TOPICS]
+    req = carr(2) + cs("a") + cs("b") + i32(30000) + TAG0
+    _rt(api, "request", req, 4, {
+        "topic_names": ["a", "b"], "timeout_ms": 30000,
+    })
+    resp = (
+        i32(0)
+        + carr(1) + cs("a") + i16(0) + TAG0
+        + TAG0
+    )
+    _rt(api, "response", resp, 4, {
+        "throttle_time_ms": 0,
+        "responses": [{"name": "a", "error_code": 0}],
+    })
+
+
+def test_heartbeat_v4_flexible_golden():
+    api = m.APIS[m.HEARTBEAT]
+    req = cs("g1") + i32(3) + cs("m-1") + CNULL + TAG0
+    _rt(api, "request", req, 4, {
+        "group_id": "g1", "generation_id": 3, "member_id": "m-1",
+        "group_instance_id": None,
+    })
+    resp = i32(0) + i16(27) + TAG0  # REBALANCE_IN_PROGRESS
+    _rt(api, "response", resp, 4, {"throttle_time_ms": 0, "error_code": 27})
