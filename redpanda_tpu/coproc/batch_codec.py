@@ -37,8 +37,9 @@ class ExplodedBatches:
     ranges: list[tuple[int, int]]  # per input batch: [start, end) in N
 
 
-def explode_batches(batches: list[RecordBatch]) -> ExplodedBatches:
-    lib = _native()
+def _gather_payloads(batches: list[RecordBatch]):
+    """Decompress + concatenate batch payloads; shared by the split and
+    fused explode paths."""
     payloads: list[bytes] = []
     counts = np.empty(len(batches), np.int32)
     p_off = np.empty(len(batches), np.int64)
@@ -58,7 +59,32 @@ def explode_batches(batches: list[RecordBatch]) -> ExplodedBatches:
         ranges.append((n, n + count))
         base += len(payload)
         n += count
-    joined = b"".join(payloads)
+    return payloads, counts, p_off, p_len, ranges, b"".join(payloads), n
+
+
+def explode_and_find(batches: list[RecordBatch], paths: list[str]):
+    """FUSED explode + find (rp_explode_find): framing parse and the
+    k-path JSON walk in one native crossing and one cache-hot traversal.
+    Returns (ExplodedBatches, types, vs, ve) or None when the native
+    symbol is unavailable (caller runs the split stages)."""
+    lib = _native()
+    if lib is None or not getattr(lib, "has_explode_find", False) or not paths:
+        return None
+    _, counts, p_off, p_len, ranges, joined, n = _gather_payloads(batches)
+    if n == 0:
+        ex = ExplodedBatches(
+            joined, np.zeros(0, np.int64), np.zeros(0, np.int32), ranges
+        )
+        k = len(paths)
+        return ex, np.zeros((0, k), np.int8), np.zeros((0, k), np.int64), np.zeros((0, k), np.int64)
+    off, ln, types, vs, ve = lib.explode_find(joined, p_off, p_len, counts, paths)
+    ex = ExplodedBatches(joined, off, np.maximum(ln, 0), ranges)
+    return ex, types, vs, ve
+
+
+def explode_batches(batches: list[RecordBatch]) -> ExplodedBatches:
+    lib = _native()
+    payloads, counts, p_off, p_len, ranges, joined, n = _gather_payloads(batches)
     if n == 0:
         return ExplodedBatches(
             joined, np.zeros(0, np.int64), np.zeros(0, np.int32), ranges

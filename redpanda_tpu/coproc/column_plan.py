@@ -76,6 +76,18 @@ class FindCache:
         self.col = {p: i for i, p in enumerate(paths)}
         self.types, self.vs, self.ve = lib.find_multi(joined, offsets, sizes, paths)
 
+    @classmethod
+    def from_tables(cls, lib, joined, offsets, paths, types, vs, ve) -> "FindCache":
+        """Wrap span tables the fused explode_find pass already produced
+        (same layout as find_multi's) without re-walking anything."""
+        self = cls.__new__(cls)
+        self._lib = lib
+        self._joined = joined
+        self._offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        self.col = {p: i for i, p in enumerate(paths)}
+        self.types, self.vs, self.ve = types, vs, ve
+        return self
+
     def gather_str(self, path: str, w: int):
         i = self.col[path]
         return self._lib.gather_str(
@@ -128,6 +140,15 @@ class ColumnarPlan:
         if not paths:
             return None
         return FindCache(lib, joined, offsets, sizes, paths)
+
+    def make_cache_from_tables(self, exploded, paths, types, vs, ve) -> FindCache:
+        """Adopt the span tables the fused explode_find pass produced.
+        `paths` MUST be the exact list the fused call used — the table
+        columns are ordered by it."""
+        return FindCache.from_tables(
+            _native(), exploded.joined, exploded.offsets, paths,
+            types, vs, ve,
+        )
 
     def _bind_slots(self, arrays) -> dict:
         """Ordered input arrays -> {(kind, path): arrays} slot map — the ONE

@@ -658,8 +658,23 @@ class TpuEngine:
         launch._plan = plan
         t0 = time.perf_counter()
         all_batches = [b for _, _, item in entries for b in item.batches]
-        exploded = batch_codec.explode_batches(all_batches)
-        self._stat_add("t_explode", time.perf_counter() - t0)
+        cache = None
+        if plan.mode == "columnar":
+            # FUSED fast path: framing parse + k-path JSON walk in one
+            # native crossing while each record is cache-hot — the two
+            # hottest host stages become one traversal (rp_explode_find)
+            paths = plan.flat_paths()
+            fused = batch_codec.explode_and_find(all_batches, paths)
+            if fused is not None:
+                exploded, types, vs, ve = fused
+                cache = plan.make_cache_from_tables(exploded, paths, types, vs, ve)
+                self._stat_add("t_explode_find", time.perf_counter() - t0)
+            else:
+                exploded = batch_codec.explode_batches(all_batches)
+                self._stat_add("t_explode", time.perf_counter() - t0)
+        else:
+            exploded = batch_codec.explode_batches(all_batches)
+            self._stat_add("t_explode", time.perf_counter() - t0)
         launch.ranges = exploded.ranges
         n = len(exploded.sizes)
         launch.n = n
@@ -668,7 +683,7 @@ class TpuEngine:
         if plan.mode == "payload":
             self._dispatch_payload(launch, exploded, n)
         elif plan.mode == "columnar":
-            self._dispatch_columnar(launch, plan, exploded, n)
+            self._dispatch_columnar(launch, plan, exploded, n, cache)
         else:  # host: materialized lazily at harvest
             launch._exploded = exploded
 
@@ -694,20 +709,21 @@ class TpuEngine:
         launch._packed_dev = packed
 
     def _dispatch_columnar(
-        self, launch: _Launch, plan: ColumnarPlan, exploded, n: int
+        self, launch: _Launch, plan: ColumnarPlan, exploded, n: int, cache=None
     ) -> None:
         launch.r_out = plan.r_out
         if n == 0:
             launch._proj_ok = np.zeros(0, bool)
             return
-        # ONE JSON walk per record locates every referenced top-level field
-        # (rp_find_multi); predicate and projection extraction then gather
-        # from the span tables instead of re-walking per field
-        t0 = time.perf_counter()
-        cache = plan.build_find_cache(
-            exploded.joined, exploded.offsets, exploded.sizes
-        )
-        self._stat_add("t_find", time.perf_counter() - t0)
+        if cache is None:
+            # split path (fused explode_find unavailable): ONE JSON walk
+            # per record locates every referenced top-level field
+            # (rp_find_multi); extraction gathers from the span tables
+            t0 = time.perf_counter()
+            cache = plan.build_find_cache(
+                exploded.joined, exploded.offsets, exploded.sizes
+            )
+            self._stat_add("t_find", time.perf_counter() - t0)
         if plan.dev_cols:
             t0 = time.perf_counter()
             n_pad = _bucket_rows(n)

@@ -16,6 +16,19 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.pa
 _SO = os.path.join(_NATIVE_DIR, "libredpanda_native.so")
 
 
+def _pack_paths(paths: list[str]):
+    """Paths -> (blob, offsets, lens, k) — the ONE place that defines the
+    path-table layout both rp_find_multi and rp_explode_find consume."""
+    k = len(paths)
+    encoded = [p.encode() for p in paths]
+    blob = b"".join(encoded)
+    path_off = np.zeros(k, dtype=np.int32)
+    path_len = np.fromiter((len(e) for e in encoded), np.int32, k)
+    if k:
+        np.cumsum(path_len[:-1], out=path_off[1:])
+    return blob, path_off, path_len, k
+
+
 class _NativeLib:
     def __init__(self, dll: ctypes.CDLL):
         self._dll = dll
@@ -58,6 +71,16 @@ class _NativeLib:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
                 ctypes.c_void_p,
+            ]
+        self.has_explode_find = hasattr(dll, "rp_explode_find")
+        if self.has_explode_find:
+            dll.rp_explode_find.restype = ctypes.c_int64
+            dll.rp_explode_find.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
             ]
         self.has_find_multi = hasattr(dll, "rp_find_multi")
         if self.has_find_multi:
@@ -174,12 +197,7 @@ class _NativeLib:
         offsets = np.ascontiguousarray(offsets, dtype=np.int64)
         sizes = np.ascontiguousarray(sizes, dtype=np.int32)
         n = len(sizes)
-        k = len(paths)
-        encoded = [p.encode() for p in paths]
-        blob = b"".join(encoded)
-        path_off = np.zeros(k, dtype=np.int32)
-        path_len = np.fromiter((len(e) for e in encoded), np.int32, k)
-        np.cumsum(path_len[:-1], out=path_off[1:])
+        blob, path_off, path_len, k = _pack_paths(paths)
         joined_arr = np.frombuffer(joined, dtype=np.uint8)
         types = np.empty((n, k), dtype=np.int8)
         vs = np.empty((n, k), dtype=np.int64)
@@ -293,6 +311,40 @@ class _NativeLib:
         if parsed != total:
             raise ValueError(f"record framing parse failed at record {parsed}/{total}")
         return val_off, val_len
+
+    def explode_find(
+        self,
+        joined,
+        payload_off: np.ndarray,
+        payload_len: np.ndarray,
+        counts: np.ndarray,
+        paths: list[str],
+    ):
+        """FUSED explode + find: record framing parse AND the k-path JSON
+        walk in one crossing and one cache-hot traversal (the engine's two
+        hottest stages). Returns (val_off, val_len, types, vs, ve) with
+        the same semantics as parse_many + find_multi."""
+        payload_off = np.ascontiguousarray(payload_off, dtype=np.int64)
+        payload_len = np.ascontiguousarray(payload_len, dtype=np.int32)
+        counts = np.ascontiguousarray(counts, dtype=np.int32)
+        total = int(counts.sum())
+        blob, path_off, path_len, k = _pack_paths(paths)
+        joined_arr = np.frombuffer(joined, dtype=np.uint8)
+        val_off = np.empty(total, dtype=np.int64)
+        val_len = np.empty(total, dtype=np.int32)
+        types = np.empty((total, k), dtype=np.int8)
+        vs = np.empty((total, k), dtype=np.int64)
+        ve = np.empty((total, k), dtype=np.int64)
+        parsed = self._dll.rp_explode_find(
+            joined_arr.ctypes.data, payload_off.ctypes.data,
+            payload_len.ctypes.data, counts.ctypes.data, len(counts),
+            blob, path_off.ctypes.data, path_len.ctypes.data, k,
+            val_off.ctypes.data, val_len.ctypes.data,
+            types.ctypes.data, vs.ctypes.data, ve.ctypes.data,
+        )
+        if parsed != total:
+            raise ValueError(f"record framing parse failed at record {parsed}/{total}")
+        return val_off, val_len, types, vs, ve
 
     def json_find(self, value: bytes, path: str) -> tuple[int, int, int]:
         """(type, value_start, value_end) of `path` in one JSON value.
