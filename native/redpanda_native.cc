@@ -161,37 +161,48 @@ static inline uint8_t* write_zigzag(uint8_t* p, int64_t v) {
 // Parse `count` varint-framed records from a batch payload; emit each
 // record's value offset/length (-1 length for null values). Returns the
 // number of records parsed (== count on success).
+// Walk ONE record's framing from *pp; on success advance *pp past the
+// record and emit the value span (vlen -1 = null value). Shared by the
+// split parse (rp_parse_record_values) and the fused explode+find — the
+// framing rules must not be able to diverge between them.
+static inline bool parse_one_record(const uint8_t** pp, const uint8_t* end,
+                                    const uint8_t** value_out,
+                                    int64_t* vlen_out) {
+  const uint8_t* p = *pp;
+  uint64_t u;
+  p = read_uvarint(p, end, &u);
+  if (!p) return false;
+  int64_t body_len = zz_decode(u);
+  const uint8_t* body_end = p + body_len;
+  if (body_len < 0 || body_end > end) return false;
+  if (p >= body_end) return false;
+  p++;  // attributes
+  if (!(p = read_uvarint(p, body_end, &u))) return false;  // ts delta
+  if (!(p = read_uvarint(p, body_end, &u))) return false;  // offset delta
+  if (!(p = read_uvarint(p, body_end, &u))) return false;  // key len
+  int64_t klen = zz_decode(u);
+  if (klen > 0) p += klen;
+  if (p > body_end) return false;
+  if (!(p = read_uvarint(p, body_end, &u))) return false;  // value len
+  int64_t vlen = zz_decode(u);
+  if (vlen >= 0 && p + vlen > body_end) return false;
+  *value_out = p;
+  *vlen_out = vlen;
+  *pp = body_end;  // skip headers
+  return true;
+}
+
 int32_t rp_parse_record_values(const uint8_t* payload, size_t payload_len,
                                int32_t count, int64_t* val_off,
                                int32_t* val_len) {
   const uint8_t* p = payload;
   const uint8_t* end = payload + payload_len;
   for (int32_t i = 0; i < count; i++) {
-    uint64_t u;
-    p = read_uvarint(p, end, &u);
-    if (!p) return i;
-    int64_t body_len = zz_decode(u);
-    const uint8_t* body_end = p + body_len;
-    if (body_len < 0 || body_end > end) return i;
-    if (p >= body_end) return i;
-    p++;  // attributes
-    if (!(p = read_uvarint(p, body_end, &u))) return i;  // ts delta
-    if (!(p = read_uvarint(p, body_end, &u))) return i;  // offset delta
-    if (!(p = read_uvarint(p, body_end, &u))) return i;  // key len
-    int64_t klen = zz_decode(u);
-    if (klen > 0) p += klen;
-    if (p > body_end) return i;
-    if (!(p = read_uvarint(p, body_end, &u))) return i;  // value len
-    int64_t vlen = zz_decode(u);
-    if (vlen < 0) {
-      val_off[i] = p - payload;
-      val_len[i] = -1;
-    } else {
-      if (p + vlen > body_end) return i;
-      val_off[i] = p - payload;
-      val_len[i] = (int32_t)vlen;
-    }
-    p = body_end;  // skip headers
+    const uint8_t* value;
+    int64_t vlen;
+    if (!parse_one_record(&p, end, &value, &vlen)) return i;
+    val_off[i] = value - payload;
+    val_len[i] = vlen < 0 ? -1 : (int32_t)vlen;
   }
   return count;
 }
@@ -621,41 +632,82 @@ int64_t rp_explode_find(const uint8_t* joined, const int64_t* payload_off,
                         int8_t* types, int64_t* vs_arr, int64_t* ve_arr) {
   int64_t r = 0;
   for (int32_t b = 0; b < n_batches; b++) {
-    const uint8_t* payload = joined + payload_off[b];
-    const uint8_t* p = payload;
-    const uint8_t* end = payload + payload_len[b];
+    const uint8_t* p = joined + payload_off[b];
+    const uint8_t* end = p + payload_len[b];
     for (int32_t i = 0; i < counts[b]; i++, r++) {
-      uint64_t u;
-      p = read_uvarint(p, end, &u);
-      if (!p) return r;
-      int64_t body_len = zz_decode(u);
-      const uint8_t* body_end = p + body_len;
-      if (body_len < 0 || body_end > end) return r;
-      if (p >= body_end) return r;
-      p++;  // attributes
-      if (!(p = read_uvarint(p, body_end, &u))) return r;  // ts delta
-      if (!(p = read_uvarint(p, body_end, &u))) return r;  // offset delta
-      if (!(p = read_uvarint(p, body_end, &u))) return r;  // key len
-      int64_t klen = zz_decode(u);
-      if (klen > 0) p += klen;
-      if (p > body_end) return r;
-      if (!(p = read_uvarint(p, body_end, &u))) return r;  // value len
-      int64_t vlen = zz_decode(u);
+      const uint8_t* value;
+      int64_t vlen;
+      if (!parse_one_record(&p, end, &value, &vlen)) return r;
+      val_off[r] = value - joined;
       if (vlen < 0) {
-        val_off[r] = p - joined;
         val_len[r] = -1;
         std::memset(types + r * k, 0, (size_t)k);
       } else {
-        if (p + vlen > body_end) return r;
-        val_off[r] = p - joined;
         val_len[r] = (int32_t)vlen;
-        find_in_record(p, vlen, paths_blob, path_off, path_lens, k,
+        find_in_record(value, vlen, paths_blob, path_off, path_lens, k,
                        types + r * k, vs_arr + r * k, ve_arr + r * k);
       }
-      p = body_end;  // skip headers
     }
   }
   return r;
+}
+
+// Fused projection: gather every Int/Float/Str projection field straight
+// from the span tables into the PACKED output rows in one pass per record
+// (replaces k gather_* crossings + the numpy row assembly). Byte-layout
+// parity with ColumnarPlan.assemble_rows: int/float = 4 bytes LE;
+// str = LE16 clipped length + w bytes zero-padded. ok[r] mirrors
+// extract_projection's per-kind validity (int: PRESENT|NUMBER|INT_EXACT
+// and |v| <= 999999999; float: PRESENT|NUMBER; str: present and fits w).
+// descs: per field {kind(0 int,1 float,2 str), span col, w, out off}.
+int64_t rp_project_rows(const uint8_t* joined, const int64_t* offsets,
+                        int64_t n, const int8_t* types, const int64_t* vs,
+                        const int64_t* ve, int32_t k, const int32_t* descs,
+                        int32_t n_fields, int32_t r_out, uint8_t* rows,
+                        uint8_t* ok) {
+  for (int64_t r = 0; r < n; r++) {
+    uint8_t* row = rows + r * (int64_t)r_out;
+    std::memset(row, 0, (size_t)r_out);
+    uint8_t okr = 1;
+    const uint8_t* rec = joined + offsets[r];
+    const int8_t* trow = types + r * k;
+    const int64_t* vrow = vs + r * k;
+    const int64_t* erow = ve + r * k;
+    for (int32_t f = 0; f < n_fields; f++) {
+      const int32_t* d = descs + f * 4;
+      int32_t kind = d[0], col = d[1], w = d[2], off = d[3];
+      if (kind == 2) {  // str
+        if (trow[col] != 1) {
+          okr = 0;  // missing / non-string: zeroed slot, record dropped
+          continue;
+        }
+        int64_t vlen = erow[col] - vrow[col];
+        if (vlen < 0) vlen = 0;  // unterminated: empty-but-present
+        if (vlen > w) okr = 0;
+        int32_t slen = (int32_t)(vlen < w ? vlen : w);
+        row[off] = (uint8_t)(slen & 0xFF);
+        row[off + 1] = (uint8_t)((slen >> 8) & 0xFF);
+        std::memcpy(row + off + 2, rec + vrow[col], (size_t)slen);
+      } else {
+        float f32;
+        int32_t i32;
+        uint8_t fl;
+        num_from_span(rec, trow[col], vrow[col], erow[col], &f32, &i32, &fl);
+        if (kind == 0) {  // int
+          const uint8_t need = RP_F_PRESENT | RP_F_NUMBER | RP_F_INT_EXACT;
+          if ((fl & need) != need || i32 > 999999999 || i32 < -999999999)
+            okr = 0;
+          std::memcpy(row + off, &i32, 4);
+        } else {  // float
+          const uint8_t need = RP_F_PRESENT | RP_F_NUMBER;
+          if ((fl & need) != need) okr = 0;
+          std::memcpy(row + off, &f32, 4);
+        }
+      }
+    }
+    ok[r] = okr;
+  }
+  return n;
 }
 
 // Gather a string column from a precomputed span table column.

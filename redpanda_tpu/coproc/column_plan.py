@@ -228,8 +228,22 @@ class ColumnarPlan:
         return out
 
     def extract_projection(self, joined, offsets, sizes, cache=None):
-        """Host-side projection columns -> (per-field data, ok mask [n])."""
+        """Host-side projection columns -> (per-field data, ok mask [n]).
+
+        Fast path: when every projection field is Int/Float/Str over a
+        cached span column, ONE native pass (rp_project_rows) gathers all
+        fields straight into the packed output rows — no per-field
+        [n, w] temporaries, no numpy masking; assemble_rows then just
+        unwraps them. Substr/Concat/nested paths keep the general path."""
         n = len(sizes)
+        fused = self._project_descs(cache)
+        if fused is not None and n:
+            descs, lib = fused
+            rows, ok = lib.project_rows(
+                joined, offsets, cache.types, cache.vs, cache.ve,
+                descs, self.r_out,
+            )
+            return [("rows", rows)], ok
         ok = np.ones(n, dtype=bool)
         data = []
         for f in self.proj:
@@ -266,8 +280,36 @@ class ColumnarPlan:
                 data.append(("str", b, np.clip(v, 0, f.max_len), f.max_len))
         return data, ok
 
+    def _project_descs(self, cache):
+        """[n_fields, 4] int32 {kind, span col, w, out off} when the fused
+        projector applies to this plan, else None. Field order and widths
+        MUST mirror assemble_rows' layout walk."""
+        if cache is None:
+            return None
+        lib = _native()
+        if lib is None or not getattr(lib, "has_project_rows", False):
+            return None
+        descs = []
+        off = 0
+        for f in self.proj:
+            if isinstance(f, Int) and f.key in cache.col:
+                descs.append((0, cache.col[f.key], 0, off))
+                off += 4
+            elif isinstance(f, Float) and f.key in cache.col:
+                descs.append((1, cache.col[f.key], 0, off))
+                off += 4
+            elif type(f) is Str and f.key in cache.col:
+                descs.append((2, cache.col[f.key], f.max_len, off))
+                off += 2 + f.max_len
+            else:  # Substr/Concat/nested: general path
+                return None
+        return np.asarray(descs, dtype=np.int32), lib
+
     def assemble_rows(self, data, n: int) -> tuple[np.ndarray, np.ndarray]:
         """Projection columns -> ([n, r_out] u8 rows, [n] i32 lens)."""
+        if len(data) == 1 and data[0][0] == "rows":
+            # fused projector already packed the rows at extract time
+            return data[0][1], np.full(n, self.r_out, dtype=np.int32)
         rows = np.zeros((n, self.r_out), dtype=np.uint8)
         off = 0
         for item in data:

@@ -327,6 +327,16 @@ _UNKNOWN, _EMPTY, _DEREGISTERED, _LAUNCHED = range(4)
 # (first TPU compile is ~20-40s; a wedged tunnel hangs forever).
 _PROBE_MIN_ROWS = 1024
 _PROBE_DEVICE_TIMEOUT_S = 120.0
+# The probe times only the synchronous predicate leg; the device path
+# additionally pays per-launch costs the probe cannot see (async harvester
+# handoff + GIL contention between the fetch thread and host assembly,
+# dispatch bookkeeping). Bench measurement: with the probe leg favoring
+# the device 3.2x, END-TO-END host columnar still won 1.5x — an unmeasured
+# overhead factor of ~5. The device must therefore beat the host leg by
+# this margin to be picked; on co-located TPU it wins by orders of
+# magnitude, on a tunneled link it loses outright, so the margin only
+# decides the gray zone in between.
+_PROBE_DEVICE_MARGIN = 4.0
 
 
 class Ticket:
@@ -810,10 +820,13 @@ class TpuEngine:
             t_dev = result_q.get(timeout=_PROBE_DEVICE_TIMEOUT_S)
         except queue.Empty:  # wedged link: the thread is abandoned
             t_dev = float("inf")
-        TpuEngine._columnar_backend = "device" if t_dev < t_host else "host"
+        TpuEngine._columnar_backend = (
+            "device" if t_dev * _PROBE_DEVICE_MARGIN < t_host else "host"
+        )
         TpuEngine._columnar_probe = {
             "t_host_s": round(t_host, 6),
             "t_device_s": round(t_dev, 6) if t_dev != float("inf") else None,
+            "margin": _PROBE_DEVICE_MARGIN,
             "chosen": TpuEngine._columnar_backend,
         }
 

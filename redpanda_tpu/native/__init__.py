@@ -82,6 +82,15 @@ class _NativeLib:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p,
             ]
+        self.has_project_rows = hasattr(dll, "rp_project_rows")
+        if self.has_project_rows:
+            dll.rp_project_rows.restype = ctypes.c_int64
+            dll.rp_project_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+            ]
         self.has_find_multi = hasattr(dll, "rp_find_multi")
         if self.has_find_multi:
             dll.rp_find_multi.restype = ctypes.c_int64
@@ -345,6 +354,39 @@ class _NativeLib:
         if parsed != total:
             raise ValueError(f"record framing parse failed at record {parsed}/{total}")
         return val_off, val_len, types, vs, ve
+
+    def project_rows(
+        self,
+        joined,
+        offsets: np.ndarray,
+        types: np.ndarray,
+        vs: np.ndarray,
+        ve: np.ndarray,
+        descs: np.ndarray,
+        r_out: int,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """FUSED projection: every Int/Float/Str field gathered from the
+        span tables straight into packed output rows, one pass per record
+        (layout parity with ColumnarPlan.assemble_rows). descs is
+        [n_fields, 4] int32 {kind, span col, w, out off}. Returns
+        (rows [n, r_out] u8, ok [n] bool)."""
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        types = np.ascontiguousarray(types, dtype=np.int8)
+        vs = np.ascontiguousarray(vs, dtype=np.int64)
+        ve = np.ascontiguousarray(ve, dtype=np.int64)
+        descs = np.ascontiguousarray(descs, dtype=np.int32)
+        n, k = types.shape
+        joined_arr = np.frombuffer(joined, dtype=np.uint8)
+        rows = np.empty((n, r_out), dtype=np.uint8)
+        # the C side writes 0/1 bytes — valid numpy bool storage, no copy
+        ok = np.empty(n, dtype=np.bool_)
+        self._dll.rp_project_rows(
+            joined_arr.ctypes.data, offsets.ctypes.data, n,
+            types.ctypes.data, vs.ctypes.data, ve.ctypes.data, k,
+            descs.ctypes.data, len(descs), r_out,
+            rows.ctypes.data, ok.ctypes.data,
+        )
+        return rows, ok
 
     def json_find(self, value: bytes, path: str) -> tuple[int, int, int]:
         """(type, value_start, value_end) of `path` in one JSON value.
