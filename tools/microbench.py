@@ -77,6 +77,57 @@ def bench_batch_codec(secs: float) -> dict:
     }
 
 
+def bench_explode_find(secs: float) -> dict:
+    """The engine's fused launch stages (rp_explode_find +
+    rp_project_rows) vs the split passes — regressions in either native
+    hot loop show up here per component, not just in the headline."""
+    from redpanda_tpu.coproc import batch_codec
+    from redpanda_tpu.coproc.column_plan import plan_spec
+    from redpanda_tpu.models.record import Record, RecordBatch
+    from redpanda_tpu.ops.exprs import field
+    from redpanda_tpu.ops.transforms import Int, Str, map_project, where
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for p_ in range(64):
+        recs = [
+            Record(
+                offset_delta=i,
+                value=json.dumps({
+                    "level": ["error", "info"][i % 2], "code": i,
+                    "msg": "x" * int(rng.integers(40, 90)),
+                }).encode(),
+            )
+            for i in range(32)
+        ]
+        batches.append(RecordBatch.build(recs, base_offset=0))
+    paths = ["level", "code", "msg"]
+    n_recs = 64 * 32
+    out = {}
+    fused = batch_codec.explode_and_find(batches, paths)
+    if fused is not None:
+        r = _rate(lambda: batch_codec.explode_and_find(batches, paths), secs, n_recs)
+        out["explode_find_recs_per_s"] = round(r, 1)
+    lib = batch_codec._native()
+    ex = batch_codec.explode_batches(batches)
+    if lib is not None and getattr(lib, "has_find_multi", False):
+        split = _rate(
+            lambda: lib.find_multi(ex.joined, ex.offsets, ex.sizes, paths),
+            secs, n_recs,
+        )
+        out["find_multi_recs_per_s"] = round(split, 1)
+    spec = where(field("level") == "error") | map_project(Int("code"), Str("msg", 64))
+    plan = plan_spec(spec)
+    cache = plan.build_find_cache(ex.joined, ex.offsets, ex.sizes)
+    if cache is not None and plan._project_descs(cache) is not None:
+        r = _rate(
+            lambda: plan.extract_projection(ex.joined, ex.offsets, ex.sizes, cache),
+            secs, n_recs,
+        )
+        out["project_rows_recs_per_s"] = round(r, 1)
+    return out
+
+
 def bench_compaction_index(secs: float) -> dict:
     """Key-index build rate (compaction_idx_bench shape)."""
     from redpanda_tpu.storage.compaction import KeyLatestIndex
@@ -147,6 +198,7 @@ BENCHES = {
     "xxhash": bench_xxhash,
     "zstd_stream": bench_zstd_stream,
     "batch_codec": bench_batch_codec,
+    "explode_find": bench_explode_find,
     "compaction_index": bench_compaction_index,
     "allocation": bench_allocation,
     "rpc_echo": bench_rpc_echo,
