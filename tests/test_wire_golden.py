@@ -8,7 +8,10 @@ redpanda_tpu/kafka/protocol/{schema,primitives,batch}.py fails here even
 though the package's own encode/decode round-trips agree with each other.
 Covers classic AND flexible versions of the APIs real clients hit first:
 api_versions, metadata, produce (with a real record batch + CRC), fetch,
-join_group, sync_group, find_coordinator, and both request-header forms.
+join_group, sync_group, find_coordinator, offset_commit, offset_fetch,
+init_producer_id, delete_topics, heartbeat, describe_groups (KIP-430 +
+static membership), list_offsets, create_topics (tagged field), legacy
+v0/v1 message sets, and both request-header forms.
 
 Reference parity: the byte layouts match the schemata the reference
 compiles (kafka/protocol/schemata/*.json via generator.py) and its batch
@@ -777,3 +780,76 @@ def test_heartbeat_v4_flexible_golden():
     })
     resp = i32(0) + i16(27) + TAG0  # REBALANCE_IN_PROGRESS
     _rt(api, "response", resp, 4, {"throttle_time_ms": 0, "error_code": 27})
+
+
+def test_describe_groups_v5_flexible_golden():
+    """v5 is flexible AND carries both round-5 additions on the wire:
+    group_instance_id (v4+, static membership) and authorized_operations
+    (v3+, KIP-430)."""
+    api = m.APIS[m.DESCRIBE_GROUPS]
+    req = carr(1) + cs("g1") + b"\x01" + TAG0  # include_authorized_operations
+    _rt(api, "request", req, 5, {
+        "groups": ["g1"], "include_authorized_operations": True,
+    })
+
+    resp = (
+        i32(0)
+        + carr(1)
+        + i16(0) + cs("g1") + cs("Stable") + cs("consumer") + cs("range")
+        + carr(1)
+        + cs("m-1") + cs("static-a")            # member_id, group_instance_id
+        + cs("cli") + cs("/10.0.0.1")
+        + cb(b"\x00\x01") + cb(b"\x00\x02")     # metadata, assignment
+        + TAG0
+        + i32((1 << 3) | (1 << 6) | (1 << 8))   # read|delete|describe bits
+        + TAG0
+        + TAG0
+    )
+    _rt(api, "response", resp, 5, {
+        "throttle_time_ms": 0,
+        "groups": [{
+            "error_code": 0, "group_id": "g1", "group_state": "Stable",
+            "protocol_type": "consumer", "protocol_data": "range",
+            "members": [{
+                "member_id": "m-1", "group_instance_id": "static-a",
+                "client_id": "cli", "client_host": "/10.0.0.1",
+                "member_metadata": b"\x00\x01",
+                "member_assignment": b"\x00\x02",
+            }],
+            "authorized_operations": (1 << 3) | (1 << 6) | (1 << 8),
+        }],
+    })
+
+
+def test_list_offsets_v5_classic_golden():
+    api = m.APIS[m.LIST_OFFSETS]
+    req = (
+        i32(-1) + i8(0)                       # replica_id, isolation_level
+        + arr(1) + s("orders")
+        + arr(1) + i32(0) + i32(-1) + i64(-1) # partition, leader_epoch, timestamp=-1 (latest)
+    )
+    _rt(api, "request", req, 5, {
+        "replica_id": -1, "isolation_level": 0,
+        "topics": [{
+            "name": "orders",
+            "partitions": [{
+                "partition_index": 0, "current_leader_epoch": -1,
+                "timestamp": -1,
+            }],
+        }],
+    })
+    resp = (
+        i32(0)
+        + arr(1) + s("orders")
+        + arr(1) + i32(0) + i16(0) + i64(123456) + i64(42) + i32(7)
+    )
+    _rt(api, "response", resp, 5, {
+        "throttle_time_ms": 0,
+        "topics": [{
+            "name": "orders",
+            "partitions": [{
+                "partition_index": 0, "error_code": 0, "timestamp": 123456,
+                "offset": 42, "leader_epoch": 7,
+            }],
+        }],
+    })

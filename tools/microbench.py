@@ -78,9 +78,12 @@ def bench_batch_codec(secs: float) -> dict:
 
 
 def bench_explode_find(secs: float) -> dict:
-    """The engine's fused launch stages (rp_explode_find +
-    rp_project_rows) vs the split passes — regressions in either native
-    hot loop show up here per component, not just in the headline."""
+    """Per-component rates for the engine's native launch stages:
+    explode_find = the FUSED framing-parse + JSON-walk pass (one
+    traversal); find_multi = the JSON walk ALONE over pre-exploded
+    records (not directly comparable — it omits the framing parse);
+    project_rows = the fused projection gather. Regressions in any hot
+    loop show up here per component, not just in the headline."""
     from redpanda_tpu.coproc import batch_codec
     from redpanda_tpu.coproc.column_plan import plan_spec
     from redpanda_tpu.models.record import Record, RecordBatch
