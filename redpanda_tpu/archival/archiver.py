@@ -10,6 +10,7 @@ re-downloads it on startup).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 
@@ -57,8 +58,9 @@ class NtpArchiver:
         uploaded = 0
         for seg in self.upload_candidates():
             name = os.path.basename(seg.data_path)
-            with open(seg.data_path, "rb") as f:
-                data = f.read()
+            # a closed segment can be hundreds of MB: reading it inline
+            # would stall every partition on this shard for the disk read
+            data = await asyncio.to_thread(_read_file, seg.data_path)
             key = self.manifest.segment_key(name)
             await self.remote.upload_segment(key, data)
             self.manifest.add(
@@ -71,3 +73,8 @@ class NtpArchiver:
             await self.remote.upload_manifest(self.manifest)
             self._manifest_dirty = False
         return uploaded
+
+
+def _read_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()

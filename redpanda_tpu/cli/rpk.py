@@ -220,8 +220,9 @@ async def cmd_wasm(args) -> int:
     client = await _client(args)
     try:
         if args.wasm_cmd == "deploy":
-            with open(args.file) as f:
-                doc = json.load(f)
+            # rpk shares the reactor checker with the broker: read the spec
+            # off-loop even though the CLI loop has nothing else scheduled
+            doc = json.loads(await asyncio.to_thread(_read_text, args.file))
             if "py_source" in doc:
                 # sandboxed python transform (validated client-side here
                 # and again on every broker at enable time)
@@ -621,6 +622,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "iotune":
         return cmd_iotune(args)
     return asyncio.run(table[args.cmd](args))
+
+
+def _read_text(path: str) -> str:
+    with open(path) as f:
+        return f.read()
 
 
 if __name__ == "__main__":

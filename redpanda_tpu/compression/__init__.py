@@ -3,6 +3,13 @@ from redpanda_tpu.compression.registry import (
     uncompress,
     register_backend,
     active_backend,
+    is_available,
 )
 
-__all__ = ["compress", "uncompress", "register_backend", "active_backend"]
+__all__ = [
+    "compress",
+    "uncompress",
+    "register_backend",
+    "active_backend",
+    "is_available",
+]

@@ -17,7 +17,10 @@ import ctypes.util
 import struct
 import zlib
 
-import zstandard
+try:
+    import zstandard
+except ImportError:  # optional: zstd produce/fetch raises, everything else works
+    zstandard = None
 
 # ------------------------------------------------------------------ gzip
 
@@ -31,18 +34,31 @@ def gzip_uncompress(data: bytes) -> bytes:
 
 
 # ------------------------------------------------------------------ zstd
-_zc = zstandard.ZstdCompressor(level=3)
-_zd = zstandard.ZstdDecompressor()
+_zc = None
+_zd = None
+
+
+def _zstd_ctx():
+    global _zc, _zd
+    if zstandard is None:
+        raise RuntimeError("zstd codec unavailable: `zstandard` is not installed")
+    if _zc is None:
+        # per-process reusable contexts (parity with stream_zstd workspaces)
+        _zc = zstandard.ZstdCompressor(level=3)
+        _zd = zstandard.ZstdDecompressor()
+    return _zc, _zd
 
 
 def zstd_compress(data: bytes) -> bytes:
-    return _zc.compress(data)
+    zc, _ = _zstd_ctx()
+    return zc.compress(data)
 
 
 def zstd_uncompress(data: bytes) -> bytes:
     # Streaming loop: handles frames without a content-size header (the
     # form streaming producers emit) with no fixed output cap.
-    dobj = _zd.decompressobj()
+    _, zd = _zstd_ctx()
+    dobj = zd.decompressobj()
     out = dobj.decompress(data)
     return out
 

@@ -77,3 +77,32 @@ def compress(data: bytes, codec: Compression | int) -> bytes:
 
 def uncompress(data: bytes, codec: Compression | int) -> bytes:
     return _active.uncompress(bytes(data), Compression(codec))
+
+
+def is_available(codec: Compression | int) -> bool:
+    """Can the active backend actually run this codec in THIS process?
+
+    gzip (stdlib zlib) is always available; zstd needs the `zstandard`
+    package; lz4/snappy need the system libraries. Callers that merely
+    prefer a codec (e.g. the coproc output recompressor) use this to fall
+    back instead of failing per batch.
+    """
+    codec = Compression(codec)
+    if codec == Compression.none:
+        return True
+    if codec not in _active.table:
+        return False  # the active backend's table is authoritative
+    if _active is not _HOST:
+        return True  # plugin backends declare support via their table
+    if codec == Compression.gzip:
+        return True  # stdlib zlib
+    if codec == Compression.zstd:
+        return _codecs.zstandard is not None
+    try:
+        if codec == Compression.lz4:
+            _codecs._lz4_handle()
+        elif codec == Compression.snappy:
+            _codecs._snappy_handle()
+    except OSError:
+        return False
+    return True

@@ -180,9 +180,13 @@ class ColumnarPlan:
         import jax.numpy as jnp
 
         expr = self.spec.where
+        # comparison constants are converted HOST-side, once, before the
+        # traced function exists: float()/int()/np.* inside the predicate is
+        # exactly the hot-path impurity pandalint HPS201/HPN211 flags
+        consts = _prepare_cmp_consts(expr)
 
         def predicate(*arrays):
-            keep = _build_expr(jnp, expr, self._bind_slots(arrays))
+            keep = _build_expr(jnp, expr, self._bind_slots(arrays), consts)
             return _packbits(jnp, keep)
 
         if mesh is None:
@@ -209,7 +213,12 @@ class ColumnarPlan:
         binding is shared (_bind_slots), so device and host evaluation
         cannot drift; the bench runs both to measure what the device link
         actually buys."""
-        keep = _build_expr(np, self.spec.where, self._bind_slots(cols))
+        keep = _build_expr(
+            np,
+            self.spec.where,
+            self._bind_slots(cols),
+            _prepare_cmp_consts(self.spec.where),
+        )
         return _packbits(np, np.asarray(keep, dtype=bool))
 
     # ------------------------------------------------------------ host side
@@ -442,13 +451,57 @@ def _packbits(jnp, keep):
     return (b * weights[None, :]).sum(axis=1).astype(jnp.uint8)
 
 
-def _build_expr(jnp, expr, slots):
+def _prepare_cmp_consts(expr) -> dict[int, tuple]:
+    """id(Cmp node) -> (f32 const, i32 const | None), prepared host-side.
+
+    Every numeric comparison constant in the tree is classified and
+    converted ONCE, before tracing: conversions inside the traced predicate
+    would run per trace on host (pandalint HPS201/HPN211 hot-path purity).
+    The i32 constant exists only when the spec value is int32-exact, which
+    is what gates the exact-integer comparison path on device.
+    """
+    out: dict[int, tuple] = {}
+
+    def walk(e):
+        if isinstance(e, (E.And, E.Or)):
+            walk(e.a)
+            walk(e.b)
+        elif isinstance(e, E.Not):
+            walk(e.a)
+        elif isinstance(e, E.Cmp):
+            v = e.value
+            if v is None or isinstance(v, (bool, str, bytes)):
+                return
+            const_int = (
+                isinstance(v, (int, np.integer))
+                and not isinstance(v, bool)
+                and -(2**31) <= int(v) <= 2**31 - 1
+            ) or (
+                isinstance(v, (float, np.floating))
+                and float(v) == int(v)
+                and -(2**31) <= int(v) <= 2**31 - 1
+            )
+            out[id(e)] = (
+                np.float32(float(v)),
+                np.int32(int(v)) if const_int else None,
+            )
+
+    if expr is not None:
+        walk(expr)
+    return out
+
+
+def _build_expr(jnp, expr, slots, consts):
     if isinstance(expr, E.And):
-        return _build_expr(jnp, expr.a, slots) & _build_expr(jnp, expr.b, slots)
+        return _build_expr(jnp, expr.a, slots, consts) & _build_expr(
+            jnp, expr.b, slots, consts
+        )
     if isinstance(expr, E.Or):
-        return _build_expr(jnp, expr.a, slots) | _build_expr(jnp, expr.b, slots)
+        return _build_expr(jnp, expr.a, slots, consts) | _build_expr(
+            jnp, expr.b, slots, consts
+        )
     if isinstance(expr, E.Not):
-        return ~_build_expr(jnp, expr.a, slots)
+        return ~_build_expr(jnp, expr.a, slots, consts)
     if isinstance(expr, E.Exists):
         col = slots[("exists", expr.path)]
         return col != 0
@@ -474,23 +527,16 @@ def _build_expr(jnp, expr, slots):
     if v is None:
         isnull = (flags & E.F_NULL) != 0
         return isnull if expr.op == "eq" else present & ~isnull
-    # numeric constant
-    isnum = (flags & E.F_NUMBER) != 0
-    const_int = (
-        isinstance(v, (int, np.integer))
-        and not isinstance(v, bool)
-        and -(2**31) <= int(v) <= 2**31 - 1
-    ) or (
-        isinstance(v, float)
-        and float(v) == int(v)
-        and -(2**31) <= int(v) <= 2**31 - 1
-    )
+    # numeric constant: prepared host-side by _prepare_cmp_consts — no
+    # conversions may run inside the traced predicate.
     # E._cmp_num is dtype-generic; sharing it keeps host-oracle and device
     # comparison semantics in one place.
-    fcmp = E._cmp_num(expr.op, f32, jnp.float32(np.float32(float(v))))
-    if const_int:
+    isnum = (flags & E.F_NUMBER) != 0
+    f32c, i32c = consts[id(expr)]
+    fcmp = E._cmp_num(expr.op, f32, f32c)
+    if i32c is not None:
         int_exact = (flags & E.F_INT_EXACT) != 0
-        icmp = E._cmp_num(expr.op, i32, jnp.int32(int(v)))
+        icmp = E._cmp_num(expr.op, i32, i32c)
         return isnum & jnp.where(int_exact, icmp, fcmp)
     return isnum & fcmp
 

@@ -64,11 +64,18 @@ def _load_native():
 
 
 def crc32c_update(crc: int, data) -> int:
-    """Core update: crc is the *internal* state (already inverted)."""
+    """Core update: crc is the *internal* state (already inverted).
+
+    Accepts any C-contiguous buffer (bytes, bytearray, memoryview): the
+    produce path hands us zero-copy views of the network frame and must not
+    pay a materialization per batch.
+    """
     native = _load_native()
     if native:
-        return native.crc32c_update(crc, bytes(data))
-    buf = np.frombuffer(bytes(data), dtype=np.uint8)
+        if not isinstance(data, bytes):
+            data = bytes(data)  # ctypes c_char_p needs an owned contiguous blob
+        return native.crc32c_update(crc, data)
+    buf = np.frombuffer(data, dtype=np.uint8)
     c = np.uint32(crc)
     n = len(buf)
     # slicing-by-8 main loop

@@ -135,7 +135,7 @@ class Reader:
         for _ in range(self.uvarint()):
             tag = self.uvarint()
             size = self.uvarint()
-            out[tag] = bytes(self._take(size))
+            out[tag] = bytes(self._take(size))  # pandalint: disable=IOB401 -- passthrough tags outlive the frame buffer; they must own their bytes
         return out
 
 

@@ -138,26 +138,15 @@ class Broker:
         (<data>/<ns>/<topic>/<partition>) plus their persisted configs. In a
         cluster the controller STM replay rebuilds the topic table instead;
         here the disk IS the source of truth (log_manager.cc:179 recovery)."""
+        import asyncio
         import json
-        import os
 
         from redpanda_tpu.storage.kvstore import KeySpace
 
         base = self.storage.log_mgr.config.base_dir
-        if not os.path.isdir(base):
-            return
-        found: dict[tuple[str, str], int] = {}  # (ns, topic) -> partitions
-        for ns in os.listdir(base):
-            ns_dir = os.path.join(base, ns)
-            if not os.path.isdir(ns_dir):
-                continue
-            for topic in os.listdir(ns_dir):
-                t_dir = os.path.join(ns_dir, topic)
-                if not os.path.isdir(t_dir):
-                    continue
-                parts = [p for p in os.listdir(t_dir) if p.isdigit()]
-                if parts:
-                    found[(ns, topic)] = max(int(p) for p in parts) + 1
+        # the three-level dir walk is pure disk metadata: off-loop, so a
+        # restart over a large data dir doesn't freeze the accept loop
+        found = await asyncio.to_thread(_scan_topic_tree, base)
         for (ns, topic), n_parts in sorted(found.items()):
             if self.topic_table.contains(topic):
                 continue
@@ -317,3 +306,24 @@ class Broker:
 
     def is_internal_topic(self, name: str) -> bool:
         return name.startswith("__") or name.startswith("_redpanda")
+
+
+def _scan_topic_tree(base: str) -> dict[tuple[str, str], int]:
+    """(ns, topic) -> partition count from <base>/<ns>/<topic>/<partition>."""
+    import os
+
+    found: dict[tuple[str, str], int] = {}
+    if not os.path.isdir(base):
+        return found
+    for ns in os.listdir(base):
+        ns_dir = os.path.join(base, ns)
+        if not os.path.isdir(ns_dir):
+            continue
+        for topic in os.listdir(ns_dir):
+            t_dir = os.path.join(ns_dir, topic)
+            if not os.path.isdir(t_dir):
+                continue
+            parts = [p for p in os.listdir(t_dir) if p.isdigit()]
+            if parts:
+                found[(ns, topic)] = max(int(p) for p in parts) + 1
+    return found

@@ -109,11 +109,13 @@ class MetricsRegistry:
         for h in self._hists.values():
             _head(h.name, h.help, "histogram")
             for upper, cum in h.hist.cumulative_buckets():
+                le = 'le="%s"' % upper
                 lines.append(
-                    f"{PREFIX}_{h.name}_bucket{_labelstr(h.labels, f'le=\"{upper}\"')} {cum}"
+                    f"{PREFIX}_{h.name}_bucket{_labelstr(h.labels, le)} {cum}"
                 )
+            le_inf = 'le="+Inf"'
             lines.append(
-                f"{PREFIX}_{h.name}_bucket{_labelstr(h.labels, 'le=\"+Inf\"')} {h.hist.count}"
+                f"{PREFIX}_{h.name}_bucket{_labelstr(h.labels, le_inf)} {h.hist.count}"
             )
             lines.append(f"{PREFIX}_{h.name}_sum{_labelstr(h.labels)} {h.hist.sum}")
             lines.append(f"{PREFIX}_{h.name}_count{_labelstr(h.labels)} {h.hist.count}")

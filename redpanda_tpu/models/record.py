@@ -115,7 +115,8 @@ class Record:
             else:
                 body += encode_zigzag(len(h.value))
                 body += h.value
-        return bytes(encode_zigzag(len(body)) + bytes(body))
+        # bytes + bytearray concatenates to bytes: one copy, not three
+        return encode_zigzag(len(body)) + body
 
     @staticmethod
     def decode(buf, offset: int = 0) -> tuple["Record", int]:

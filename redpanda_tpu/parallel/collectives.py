@@ -18,7 +18,11 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from redpanda_tpu.parallel.mesh import PARTITION_AXIS

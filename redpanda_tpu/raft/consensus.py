@@ -133,6 +133,10 @@ class Consensus:
         self._last_leader_contact = 0.0
         self._election_task: asyncio.Task | None = None
         self._recovery_tasks: dict[int, asyncio.Task] = {}
+        # fire-and-forget work (step-down, transfer elections, quorum acks):
+        # handles are retained so the tasks can't be GC'd mid-flight and are
+        # cancelled on stop() (pandalint TSK301)
+        self._bg_tasks: set[asyncio.Task] = set()
         self._batcher: _ReplicateBatcher | None = None
         self._snapshots = SnapshotManager(log.dir, name="raft_snapshot")
         self._snapshot_rx: dict | None = None  # in-progress chunked install
@@ -237,9 +241,26 @@ class Consensus:
                         self.config_mgr.add(b.base_offset, cfg)
             at = batches[-1].last_offset + 1
 
+    def _spawn_bg(self, coro) -> asyncio.Task:
+        """create_task with a retained handle: fire-and-forget raft work
+        (step-down, transfer elections, quorum acks) must not be GC'd
+        mid-flight and must die with the group (pandalint TSK301)."""
+        t = asyncio.create_task(coro)
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+        return t
+
     async def stop(self) -> None:
         self._stopped = True
-        tasks = [t for t in [self._election_task, *self._recovery_tasks.values()] if t]
+        tasks = [
+            t
+            for t in [
+                self._election_task,
+                *self._recovery_tasks.values(),
+                *self._bg_tasks,
+            ]
+            if t
+        ]
         if self._batcher is not None:
             tasks.extend(self._batcher.tasks())
         for t in tasks:
@@ -728,7 +749,7 @@ class Consensus:
     async def handle_timeout_now(self, req: dict) -> dict:
         if req["term"] < self.term:
             return {"term": self.term, "result": 1}
-        asyncio.create_task(self.dispatch_election(leadership_transfer=True))
+        self._spawn_bg(self.dispatch_election(leadership_transfer=True))
         return {"term": self.term, "result": 0}
 
     async def do_transfer_leadership(self, target_id: int = -1) -> bool:
@@ -876,7 +897,7 @@ class Consensus:
         if not self.is_leader():
             return
         if reply["term"] > self.term:
-            asyncio.create_task(self._step_down(reply["term"]))
+            self._spawn_bg(self._step_down(reply["term"]))
             return
         f = self._followers.get(reply["node"]["id"])
         if f is None:
@@ -957,11 +978,18 @@ class _ReplicateBatcher:
                 except RaftError as e:
                     if not rep.done():
                         rep.set_exception(e)
+                except asyncio.CancelledError:
+                    # stop() cancels retained bg tasks: submitters must not
+                    # hang on a future nobody will resolve
+                    if not rep.done():
+                        rep.set_exception(RaftError(Errc.shutting_down))
+                    raise
 
             # Don't block the batcher loop on quorum: new submissions keep
-            # coalescing while acks stream in.
+            # coalescing while acks stream in. Handles live in the consensus
+            # bg set so stop() cancels pending quorum waits.
             for (batches, enq, rep, t), last in zip(pending, lasts):
-                asyncio.create_task(wait_one(last, rep, t))
+                c._spawn_bg(wait_one(last, rep, t))
 
 
 def _encode_entries(batches: list[RecordBatch]) -> bytes:

@@ -100,6 +100,11 @@ class Transport:
             raise RpcError(wire.STATUS_REQUEST_TIMEOUT, "client timeout")
 
     async def close(self) -> None:
+        # Take the writer FIRST: cancelling the read loop runs _fail_all,
+        # which nulls _writer — checking it afterwards means the socket is
+        # never actually closed, and the server leaks a connection handler
+        # per churn (caught by the tron soak test's zero-leak assertion).
+        w, self._writer = self._writer, None
         if self._read_task is not None:
             self._read_task.cancel()
             try:
@@ -107,8 +112,7 @@ class Transport:
             except (asyncio.CancelledError, Exception):
                 pass
             self._read_task = None
-        if self._writer is not None:
-            w, self._writer = self._writer, None
+        if w is not None:
             try:
                 w.close()
                 await w.wait_closed()

@@ -102,28 +102,34 @@ class DiskLog:
 
             file_sanitizer.enable()
         log = cls(ntp, config)
-        os.makedirs(log.dir, exist_ok=True)
+        # The segment scan + tail CRC recovery is pure disk work on an
+        # object nothing else references yet; inline it and a node restart
+        # with many partitions would stall every other recovery on the loop.
+        await asyncio.to_thread(log._open_sync)
+        return log
+
+    def _open_sync(self) -> None:
+        os.makedirs(self.dir, exist_ok=True)
         stems = sorted(
-            (f for f in os.listdir(log.dir) if f.endswith(".log")),
+            (f for f in os.listdir(self.dir) if f.endswith(".log")),
             key=lambda f: int(f.split("-")[0]),
         )
         for i, fname in enumerate(stems):
             base, term, _ = fname.split("-", 2)
-            seg = Segment(log.dir, int(base), int(term))
+            seg = Segment(self.dir, int(base), int(term))
             last = i == len(stems) - 1
             seg.open_existing(writable=False)
             if last:
                 # CRC-scan the tail (crash recovery), truncating at the
                 # first corrupt frame, then reopen for append.
-                recover_segment(seg, use_device=config.use_device_recovery)
+                recover_segment(seg, use_device=self.config.use_device_recovery)
                 seg._file = open(seg.data_path, "ab")
-            log.segments.append(seg)
-            log._term = max(log._term, seg.term)
-        if log.segments:
-            log._start_offset = log.segments[0].base_offset
-            log._committed = log.segments[-1].dirty_offset
-            log._active_created_at = time.monotonic()
-        return log
+            self.segments.append(seg)
+            self._term = max(self._term, seg.term)
+        if self.segments:
+            self._start_offset = self.segments[0].base_offset
+            self._committed = self.segments[-1].dirty_offset
+            self._active_created_at = time.monotonic()
 
     async def close(self):
         async with self._lock:

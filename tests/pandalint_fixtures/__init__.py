@@ -1,0 +1,2 @@
+# Deliberate-violation fixture modules for tests/test_pandalint.py.
+# These files are linted, never imported or executed.

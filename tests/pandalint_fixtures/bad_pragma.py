@@ -1,0 +1,7 @@
+"""A disable pragma without a reason suppresses nothing (SUP001)."""
+
+import time
+
+
+async def handler():
+    time.sleep(0.5)  # pandalint: disable=RCT101

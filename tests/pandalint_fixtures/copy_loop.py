@@ -1,0 +1,20 @@
+"""iobuf copy-discipline violations. Linted by test_pandalint, never run."""
+
+from redpanda_tpu.hashing.crc32c import crc32c
+
+
+def per_record_copies(frame: memoryview, offsets):
+    out = []
+    for start, end in offsets:
+        rec = bytes(frame[start:end])          # line 9: IOB401
+        out.append(crc32c(bytes(frame[start:end])))  # line 10: IOB401 + IOB402
+    return out, rec
+
+
+def boundary_ok(frame: memoryview):
+    out = bytearray()
+    for b in frame:
+        out.append(b)
+        if b == 0:
+            return bytes(out)  # fine: loop-exit materialization
+    return bytes(out)

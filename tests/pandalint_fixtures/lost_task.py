@@ -1,0 +1,24 @@
+"""Task-hygiene violations. Linted by test_pandalint, never run."""
+
+import asyncio
+
+
+async def worker():
+    await asyncio.sleep(0)
+
+
+class Service:
+    async def _loop(self):
+        await asyncio.sleep(0)
+
+    def start(self):
+        asyncio.create_task(self._loop())      # line 15: TSK301
+
+    async def kick(self):
+        self._loop()                           # line 18: TSK302
+        worker()                               # line 19: TSK302
+
+    def start_retained(self):
+        # fine: handle kept
+        self._task = asyncio.create_task(self._loop())
+        return self._task

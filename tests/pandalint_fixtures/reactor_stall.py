@@ -1,0 +1,22 @@
+"""Reactor-discipline violations. Linted by test_pandalint, never run."""
+
+import socket
+import subprocess
+import time
+
+
+async def handler():
+    time.sleep(0.5)                          # line 9: RCT101
+    subprocess.run(["sync"])                 # line 10: RCT102
+    with open("/tmp/x", "rb") as f:          # line 11: RCT103
+        return f.read()
+
+
+async def resolver():
+    sock = socket.create_connection(("127.0.0.1", 9092))  # line 16: RCT104
+    return sock
+
+
+def sync_helper():
+    time.sleep(0.5)  # fine: not inside async def
+    return open("/tmp/x", "rb")

@@ -1,0 +1,16 @@
+"""Every violation here carries a reasoned pragma: zero active findings."""
+
+import asyncio
+import time
+
+
+async def delay_fault():
+    time.sleep(0.01)  # pandalint: disable=RCT101 -- injected fault must actually block; test-only path
+
+
+class Gadget:
+    async def _loop(self):
+        await asyncio.sleep(0)
+
+    def start(self):
+        asyncio.create_task(self._loop())  # pandalint: disable=TSK301 -- process-lifetime daemon; dies with the loop

@@ -1,0 +1,34 @@
+"""Hot-path purity violations. Linted by test_pandalint, never run."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def leaky_kernel(x, n):
+    scale = float(n)                 # line 10: HPS201
+    peak = x.max().item()            # line 11: HPS202
+    host = jax.device_get(x)         # line 12: HPS203
+    mean = np.mean(x)                # line 13: HPN211
+    if n > 3:                        # line 14: HPC221 (traced arg in test)
+        x = x * scale
+    return x + peak + host + mean
+
+
+def _helper(y):
+    # reachable from the jit root below -> same rules apply
+    return float(y)                  # line 21: HPS201
+
+
+def make_fn():
+    return jax.vmap(_rooted)
+
+
+def _rooted(y):
+    return _helper(y) + 1.0
+
+
+def host_side(v):
+    # NOT reachable from any jit root: conversions here are fine
+    return float(v) + np.mean(np.ones(3))

@@ -240,10 +240,14 @@ def test_microbench_runs_and_reports(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     out = json.loads(r.stdout.strip().splitlines()[-1])
     expected = {
-        "crc32c_mb_s", "xxhash64_mb_s", "zstd_compress_mb_s",
-        "zstd_uncompress_mb_s", "batch_encode_per_s", "batch_decode_per_s",
-        "compaction_keyindex_keys_per_s", "allocator_assignments_per_s",
-        "rpc_echo_rtt_per_s",
+        "crc32c_mb_s", "xxhash64_mb_s", "batch_encode_per_s",
+        "batch_decode_per_s", "compaction_keyindex_keys_per_s",
+        "allocator_assignments_per_s", "rpc_echo_rtt_per_s",
     }
+    from redpanda_tpu.compression import is_available
+    from redpanda_tpu.models.record import Compression
+
+    if is_available(Compression.zstd):
+        expected |= {"zstd_compress_mb_s", "zstd_uncompress_mb_s"}
     assert expected <= set(out), out
-    assert all(v > 0 for v in out.values()), out
+    assert all(v > 0 for k, v in out.items() if not k.endswith("_skipped")), out

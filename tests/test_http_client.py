@@ -491,6 +491,7 @@ def test_tls_round_trip_and_verification(tmp_path):
     untrusted CA; verify_tls=False permits it (debug posture)."""
     import ssl
 
+    pytest.importorskip("cryptography", reason="test CA needs `cryptography`")
     from test_tls import _issue, _make_ca
 
     async def go():

@@ -297,6 +297,7 @@ def test_stop_aborts_idle_keepalive_connections():
 def test_tls_serving(tmp_path):
     import ssl
 
+    pytest.importorskip("cryptography", reason="test CA needs `cryptography`")
     from test_tls import _issue, _make_ca
 
     async def go():

@@ -1,0 +1,273 @@
+"""pandalint: per-checker fixture coverage + the package-wide strict gate.
+
+The last test IS the CI wiring: the tree must stay pandalint-clean, so any
+PR that reintroduces a reactor stall, tracer leak, lost task or hot-loop
+copy fails tier-1 here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from tools.pandalint.baseline import load_baseline, write_baseline
+from tools.pandalint.checkers import rule_catalog
+from tools.pandalint.cli import main as pandalint_main
+from tools.pandalint.config import Config
+from tools.pandalint.engine import LintEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "pandalint_fixtures")
+
+
+def _lint(path: str, rules: set[str] | None = None, relpath: str | None = None):
+    report = LintEngine(rules=rules).lint_file(
+        path, relpath or os.path.relpath(path, REPO)
+    )
+    return report.findings
+
+
+def _active(findings):
+    return [(f.rule, f.line) for f in findings if not f.suppressed]
+
+
+# --------------------------------------------------------------- per checker
+def test_reactor_rules_exact_lines():
+    got = _active(_lint(os.path.join(FIXTURES, "reactor_stall.py")))
+    assert got == [
+        ("RCT101", 9),
+        ("RCT102", 10),
+        ("RCT103", 11),
+        ("RCT104", 16),
+    ]
+
+
+def test_hotpath_rules_exact_lines():
+    got = _active(_lint(os.path.join(FIXTURES, "tracer_leak.py")))
+    assert got == [
+        ("HPS201", 10),
+        ("HPS202", 11),
+        ("HPS203", 12),
+        ("HPN211", 13),
+        ("HPC221", 14),
+        ("HPS201", 21),  # via the jax.vmap(_rooted) -> _helper call chain
+    ]
+
+
+def test_task_hygiene_rules_exact_lines():
+    got = _active(_lint(os.path.join(FIXTURES, "lost_task.py")))
+    assert got == [
+        ("TSK301", 15),
+        ("TSK302", 18),
+        ("TSK302", 19),
+    ]
+
+
+def test_iobuf_rules_exact_lines():
+    got = _active(_lint(os.path.join(FIXTURES, "copy_loop.py")))
+    assert got == [
+        ("IOB401", 9),
+        ("IOB401", 10),
+        ("IOB402", 10),
+    ]
+
+
+# --------------------------------------------------------------- suppression
+def test_reasoned_pragmas_silence_findings():
+    findings = _lint(os.path.join(FIXTURES, "suppressed_ok.py"))
+    assert _active(findings) == []
+    suppressed = [(f.rule, f.suppress_reason) for f in findings if f.suppressed]
+    assert ("RCT101", "injected fault must actually block; test-only path") in suppressed
+    assert ("TSK301", "process-lifetime daemon; dies with the loop") in suppressed
+
+
+def test_file_level_pragma_in_header(tmp_path):
+    src = (
+        "# pandalint: disable-file=RCT101 -- fault-injection module; sleeps are the product\n"
+        "import time\n\n\n"
+        "async def a():\n"
+        "    time.sleep(1)\n\n\n"
+        "async def b():\n"
+        "    time.sleep(2)\n"
+    )
+    p = tmp_path / "faults.py"
+    p.write_text(src)
+    findings = _lint(str(p))
+    assert _active(findings) == []
+    assert [f.rule for f in findings if f.suppressed] == ["RCT101", "RCT101"]
+    # the same pragma BELOW the header does not suppress (and is reported)
+    p2 = tmp_path / "late.py"
+    p2.write_text(
+        "import time\n\n\n"
+        "async def a():\n"
+        "    time.sleep(1)\n\n\n"
+        "# pandalint: disable-file=RCT101 -- too late, not a header pragma\n"
+    )
+    got = _active(_lint(str(p2)))
+    assert ("RCT101", 5) in got
+    assert any(r == "SUP001" for r, _ in got)
+
+
+def test_pragma_without_reason_suppresses_nothing():
+    got = _active(_lint(os.path.join(FIXTURES, "bad_pragma.py")))
+    assert ("SUP001", 7) in got
+    assert ("RCT101", 7) in got  # the finding survives
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    findings = _lint(str(p))
+    assert [f.rule for f in findings] == ["SYN001"]
+
+
+# --------------------------------------------------------------- scoping
+def test_default_scopes_cover_the_whole_package(tmp_path):
+    """A violation injected ANYWHERE under the package must fail the gate:
+    default scopes are package-wide."""
+    for sub in ("kafka", "models", "ops"):
+        pkg = tmp_path / "redpanda_tpu" / sub
+        pkg.mkdir(parents=True)
+        dst = pkg / "leak.py"
+        shutil.copyfile(os.path.join(FIXTURES, "tracer_leak.py"), dst)
+        report = LintEngine(Config()).lint_file(
+            str(dst), f"redpanda_tpu/{sub}/leak.py"
+        )
+        assert any(f.rule.startswith("HP") for f in report.findings), sub
+
+
+def test_scope_override_narrows_a_checker(tmp_path):
+    """[tool.pandalint.scopes] can restrict a checker to named subtrees."""
+    cfg = Config()
+    cfg.scopes["hotpath-sync"] = ("redpanda_tpu/ops",)
+    cfg.scopes["hotpath-numpy"] = ("redpanda_tpu/ops",)
+    cfg.scopes["hotpath-control"] = ("redpanda_tpu/ops",)
+    pkg = tmp_path / "redpanda_tpu" / "kafka"
+    pkg.mkdir(parents=True)
+    dst = pkg / "leak.py"
+    shutil.copyfile(os.path.join(FIXTURES, "tracer_leak.py"), dst)
+    report = LintEngine(cfg).lint_file(str(dst), "redpanda_tpu/kafka/leak.py")
+    assert not any(f.rule.startswith("HP") for f in report.findings)
+    ops = tmp_path / "redpanda_tpu" / "ops"
+    ops.mkdir()
+    dst2 = ops / "leak.py"
+    shutil.copyfile(os.path.join(FIXTURES, "tracer_leak.py"), dst2)
+    report2 = LintEngine(cfg).lint_file(str(dst2), "redpanda_tpu/ops/leak.py")
+    assert any(f.rule.startswith("HP") for f in report2.findings)
+    # fixtures OUTSIDE the package root always get every checker
+    out = tmp_path / "leak.py"
+    shutil.copyfile(os.path.join(FIXTURES, "tracer_leak.py"), out)
+    report3 = LintEngine(cfg).lint_file(str(out), "fixtures/leak.py")
+    assert any(f.rule.startswith("HP") for f in report3.findings)
+
+
+# --------------------------------------------------------------- baseline
+def test_baseline_ratchets_to_new_violations_only(tmp_path):
+    src = os.path.join(FIXTURES, "reactor_stall.py")
+    baseline_file = tmp_path / "base.json"
+    findings = _lint(src)
+    write_baseline(str(baseline_file), findings)
+    fps = load_baseline(str(baseline_file))
+    assert len(fps) == len({f.fingerprint() for f in findings})
+    # every current finding is baselined...
+    assert all(f.fingerprint() in fps for f in findings)
+    # ...and a NEW violation is not
+    mutated = tmp_path / "reactor_stall.py"
+    mutated.write_text(
+        open(src).read() + "\n\nasync def fresh():\n    time.sleep(1)\n"
+    )
+    rel = os.path.relpath(src, REPO)  # same file identity, edited content
+    new = [f for f in _lint(str(mutated), relpath=rel) if f.fingerprint() not in fps]
+    assert [(f.rule, f.line) for f in new if not f.suppressed] == [("RCT101", 26)]
+
+
+def test_baseline_survives_line_shifts(tmp_path):
+    src = os.path.join(FIXTURES, "lost_task.py")
+    baseline_file = tmp_path / "base.json"
+    write_baseline(str(baseline_file), _lint(src))
+    fps = load_baseline(str(baseline_file))
+    shifted = tmp_path / "lost_task.py"
+    shifted.write_text("# a new comment shifting every line\n" + open(src).read())
+    rel = os.path.relpath(src, REPO)
+    assert all(f.fingerprint() in fps for f in _lint(str(shifted), relpath=rel))
+
+
+# --------------------------------------------------------------- CLI
+def test_cli_strict_fails_on_fixture_violations(capsys):
+    rc = pandalint_main([os.path.join(FIXTURES, "reactor_stall.py"), "--strict"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "RCT101" in out
+
+
+def test_cli_json_output(capsys):
+    rc = pandalint_main(
+        [os.path.join(FIXTURES, "copy_loop.py"), "--format", "json"]
+    )
+    assert rc == 0  # findings exist but --strict was not given
+    doc = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in doc["active"]} == {"IOB401", "IOB402"}
+    assert all(set(f) >= {"rule", "path", "line", "fingerprint"} for f in doc["active"])
+
+
+def test_cli_rule_filter(capsys):
+    rc = pandalint_main(
+        [os.path.join(FIXTURES, "reactor_stall.py"), "--rules", "RCT102", "--strict"]
+    )
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "RCT102" in out and "RCT101" not in out
+
+
+def test_cli_usage_errors(capsys):
+    assert pandalint_main([]) == 2
+    assert pandalint_main(["/nonexistent/path"]) == 2
+    assert pandalint_main(["--rules", "NOPE99", FIXTURES]) == 2
+
+
+def test_module_entrypoint_runs():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.pandalint", "--list-rules"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    for rule in rule_catalog():
+        assert rule in proc.stdout
+
+
+# --------------------------------------------------------------- the CI gate
+def test_package_is_pandalint_clean():
+    """`python -m tools.pandalint redpanda_tpu/ --strict` must stay green:
+    this is the tier-1 regression gate for the whole invariant set."""
+    cwd = os.getcwd()
+    os.chdir(REPO)
+    try:
+        rc = pandalint_main(["redpanda_tpu/", "--strict"])
+    finally:
+        os.chdir(cwd)
+    assert rc == 0, "pandalint --strict found new violations in redpanda_tpu/"
+
+
+def test_injected_violation_fails_the_gate(tmp_path):
+    """Acceptance check: dropping any fixture violation into the package
+    scope makes the strict gate exit non-zero."""
+    pkg = tmp_path / "redpanda_tpu" / "raft"
+    pkg.mkdir(parents=True)
+    shutil.copyfile(
+        os.path.join(FIXTURES, "lost_task.py"), pkg / "injected.py"
+    )
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        rc = pandalint_main(["redpanda_tpu/", "--strict"])
+    finally:
+        os.chdir(cwd)
+    assert rc == 1

@@ -9,6 +9,10 @@ import ssl
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="TLS tests generate test CAs with `cryptography`"
+)
+
 from redpanda_tpu.security.tls import ReloadableTlsContext, TlsConfig
 
 

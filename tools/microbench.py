@@ -51,9 +51,11 @@ def bench_xxhash(secs: float) -> dict:
 
 
 def bench_zstd_stream(secs: float) -> dict:
-    from redpanda_tpu.compression import compress, uncompress
+    from redpanda_tpu.compression import compress, is_available, uncompress
     from redpanda_tpu.models.record import Compression
 
+    if not is_available(Compression.zstd):
+        return {"zstd_skipped": "zstandard not installed"}
     rng = np.random.default_rng(7)
     # compressible-ish payload (zstd_stream_bench uses realistic frames)
     blob = bytes(rng.integers(0, 16, 1 << 20, dtype=np.uint8))

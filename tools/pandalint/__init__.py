@@ -1,0 +1,41 @@
+"""pandalint — AST invariant checker for reactor-stall and tracer-leak bugs.
+
+The reference Redpanda enforces reactor discipline socially (a blocking call
+inside a seastar task stalls the whole shard); this reproduction has the same
+bug class twice over — a blocking call inside ``async def`` stalls the broker
+event loop, and a host sync inside a jitted op silently serializes the TPU
+hot path. pandalint makes both mechanical:
+
+- **reactor discipline** (RCT1xx): no ``time.sleep`` / ``subprocess`` / sync
+  file or socket I/O lexically inside ``async def`` bodies in the broker,
+  raft, rpc, storage, cloud_storage and archival layers.
+- **hot-path purity** (HPS2xx / HPN2xx / HPC2xx): inside functions reachable
+  from a ``@jax.jit`` / ``partial(jax.jit, ...)`` / ``jax.vmap`` /
+  ``shard_map`` root, no host materialization (``float()`` / ``int()`` /
+  ``bool()`` / ``.item()`` / ``jax.device_get``), no ``np.*`` calls, and no
+  data-dependent Python ``if`` / ``while`` on traced arguments.
+- **task hygiene** (TSK3xx): no dropped ``asyncio.create_task`` handles and
+  no un-awaited coroutine calls (lost-task races).
+- **iobuf copy discipline** (IOB4xx): no ``bytes(...)`` materialization of
+  buffer views inside per-record loops or as throwaway hash/CRC arguments.
+
+Usage::
+
+    python -m tools.pandalint redpanda_tpu/ --strict
+    pandalint redpanda_tpu/ --format json
+    pandalint redpanda_tpu/ --write-baseline pandalint-baseline.json
+    pandalint redpanda_tpu/ --strict --baseline pandalint-baseline.json
+
+Suppress a finding on its line (a reason is mandatory)::
+
+    time.sleep(0.1)  # pandalint: disable=RCT101 -- fault injection only
+
+See tools/pandalint/README.md for the full rule catalog.
+"""
+
+from tools.pandalint.finding import Finding
+from tools.pandalint.engine import LintEngine, lint_paths
+
+__version__ = "0.1.0"
+
+__all__ = ["Finding", "LintEngine", "lint_paths", "__version__"]

@@ -1,0 +1,5 @@
+import sys
+
+from tools.pandalint.cli import main
+
+sys.exit(main())

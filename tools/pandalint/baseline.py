@@ -1,0 +1,43 @@
+"""Baseline files: ratchet the strict gate to *new* violations only.
+
+``--write-baseline FILE`` records the fingerprint of every finding (active
+and suppressed) in the current tree. A later ``--strict --baseline FILE``
+run ignores findings whose fingerprint is recorded, so the gate fails only
+on violations introduced since the baseline. Fingerprints hash the rule id,
+file path and normalized source text — not line numbers — so edits above a
+baselined finding don't break the ratchet.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tools.pandalint.finding import Finding
+
+_VERSION = 1
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    doc = {
+        "version": _VERSION,
+        "findings": {
+            f.fingerprint(): {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "suppressed": f.suppressed,
+            }
+            for f in findings
+        },
+    }
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def load_baseline(path: str) -> set[str]:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("version") != _VERSION:
+        raise ValueError(f"unsupported baseline version: {doc.get('version')!r}")
+    return set(doc.get("findings", {}))

@@ -1,0 +1,34 @@
+"""Checker registry."""
+
+from __future__ import annotations
+
+from tools.pandalint.checkers.base import Checker, FileContext
+from tools.pandalint.checkers.reactor import ReactorChecker
+from tools.pandalint.checkers.hotpath import (
+    HotPathSyncChecker,
+    HotPathNumpyChecker,
+    HotPathControlChecker,
+)
+from tools.pandalint.checkers.tasks import TaskHygieneChecker
+from tools.pandalint.checkers.iobuf import IobufCopyChecker
+
+ALL_CHECKERS: tuple[type[Checker], ...] = (
+    ReactorChecker,
+    HotPathSyncChecker,
+    HotPathNumpyChecker,
+    HotPathControlChecker,
+    TaskHygieneChecker,
+    IobufCopyChecker,
+)
+
+
+def rule_catalog() -> dict[str, tuple[str, str]]:
+    """rule id -> (checker name, description)."""
+    out: dict[str, tuple[str, str]] = {}
+    for cls in ALL_CHECKERS:
+        for rule, desc in cls.rules.items():
+            out[rule] = (cls.name, desc)
+    return out
+
+
+__all__ = ["ALL_CHECKERS", "Checker", "FileContext", "rule_catalog"]

@@ -1,0 +1,83 @@
+"""Checker interface + per-file analysis context."""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from tools.pandalint.jitgraph import JitGraph
+
+
+@dataclass
+class RawFinding:
+    """A violation before suppression/scope handling."""
+
+    rule: str
+    line: int
+    col: int
+    message: str
+
+
+@dataclass
+class FileContext:
+    """Everything a checker may need about one parsed file."""
+
+    relpath: str                 # posix, relative to the lint root
+    tree: ast.Module
+    source: str
+    lines: list[str] = field(default_factory=list)
+    _jit: JitGraph | None = None
+
+    def __post_init__(self) -> None:
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+    @property
+    def jit(self) -> JitGraph:
+        if self._jit is None:
+            self._jit = JitGraph(self.tree)
+        return self._jit
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class Checker:
+    """Base class: subclasses set `name` + `rules` and implement check()."""
+
+    name: str = ""
+    rules: dict[str, str] = {}
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def dotted(node: ast.expr) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def enclosing_async_functions(tree: ast.Module) -> list[ast.AsyncFunctionDef]:
+    return [n for n in ast.walk(tree) if isinstance(n, ast.AsyncFunctionDef)]
+
+
+def walk_in_function(fn) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function defs —
+    a nested sync helper has its own execution context."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,128 @@
+"""Hot-path purity: no host syncs inside jit-reachable code.
+
+Everything reachable from a ``@jax.jit`` / ``jax.vmap`` / ``shard_map`` root
+executes under trace. A ``float()`` / ``int()`` / ``bool()`` / ``.item()``
+on a traced value raises at best and forces a device->host sync at worst; a
+literal ``np.*`` call runs on the host at trace time (silently baking a
+constant into the program, or serializing the dispatch pipeline when fed a
+concrete array between launches); data-dependent Python ``if``/``while`` on
+traced arguments either raises a ConcretizationTypeError or — through
+``static_argnums`` misuse — triggers a silent retrace per distinct value.
+
+Static conversions belong OUTSIDE the traced function (hoist to closure
+setup); if a flagged call is genuinely trace-time-static, suppress with a
+reason saying so.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.checkers.base import Checker, FileContext, RawFinding, dotted
+from tools.pandalint.jitgraph import expr_tainted
+
+_CASTS = {"float", "int", "bool", "complex"}
+_DEVICE_SYNCS = {"device_get", "block_until_ready"}
+_NUMPY_ALIASES = {"np", "numpy"}
+
+
+def _is_const(node: ast.expr) -> bool:
+    """Literal-ish expressions that can't be tracers."""
+    return isinstance(node, (ast.Constant, ast.JoinedStr)) or (
+        isinstance(node, ast.UnaryOp) and isinstance(node.operand, ast.Constant)
+    )
+
+
+class HotPathSyncChecker(Checker):
+    name = "hotpath-sync"
+    rules = {
+        "HPS201": "float()/int()/bool() conversion inside jit-reachable code",
+        "HPS202": ".item() host materialization inside jit-reachable code",
+        "HPS203": "jax.device_get/block_until_ready inside jit-reachable code",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        for info in ctx.jit.reachable_functions():
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in _CASTS:
+                    if node.args and _is_const(node.args[0]):
+                        continue
+                    yield RawFinding(
+                        "HPS201",
+                        node.lineno,
+                        node.col_offset,
+                        f"{f.id}() inside jit-reachable {info.name}() "
+                        f"materializes on host; hoist the conversion out of "
+                        f"the traced function",
+                    )
+                elif isinstance(f, ast.Attribute) and f.attr == "item" and not node.args:
+                    yield RawFinding(
+                        "HPS202",
+                        node.lineno,
+                        node.col_offset,
+                        f".item() inside jit-reachable {info.name}() forces a "
+                        f"device sync",
+                    )
+                elif isinstance(f, ast.Attribute) and f.attr in _DEVICE_SYNCS:
+                    root = dotted(f).split(".", 1)[0]
+                    if root == "jax":
+                        yield RawFinding(
+                            "HPS203",
+                            node.lineno,
+                            node.col_offset,
+                            f"jax.{f.attr}() inside jit-reachable "
+                            f"{info.name}() serializes the dispatch pipeline",
+                        )
+
+
+class HotPathNumpyChecker(Checker):
+    name = "hotpath-numpy"
+    rules = {
+        "HPN211": "numpy call inside jit-reachable code",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        for info in ctx.jit.reachable_functions():
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                root = name.split(".", 1)[0]
+                if root in _NUMPY_ALIASES and "." in name:
+                    yield RawFinding(
+                        "HPN211",
+                        node.lineno,
+                        node.col_offset,
+                        f"{name}() inside jit-reachable {info.name}() runs on "
+                        f"host at trace time; use jnp or hoist to closure "
+                        f"setup",
+                    )
+
+
+class HotPathControlChecker(Checker):
+    name = "hotpath-control"
+    rules = {
+        "HPC221": "data-dependent Python if/while on traced values",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        for info in ctx.jit.reachable_functions():
+            if not info.tainted_params:
+                continue
+            tainted = ctx.jit._tainted_names(info)
+            for node in ast.walk(info.node):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                if expr_tainted(node.test, tainted):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield RawFinding(
+                        "HPC221",
+                        node.lineno,
+                        node.col_offset,
+                        f"data-dependent `{kind}` on traced values in "
+                        f"{info.name}(); use jnp.where/lax.cond/lax.while_loop",
+                    )

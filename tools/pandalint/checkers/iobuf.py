@@ -1,0 +1,106 @@
+"""iobuf copy discipline: keep buffer views zero-copy on the data plane.
+
+The IOBuf/memoryview machinery exists so record payloads cross the broker
+without materializing; a ``bytes(view)`` inside a per-record loop silently
+reintroduces the O(n) copies the fragment design removed. Two shapes:
+
+- IOB401: ``bytes(x)`` / ``bytearray(x)`` lexically inside a ``for`` /
+  ``while`` body. Loop-exit conversions (``return bytes(out)``) are the
+  legitimate single materialization at the API boundary and are ignored.
+- IOB402: ``crc32c(bytes(x))``-style calls anywhere — the CRC/hash helpers
+  accept any buffer, so the copy is pure waste on the hottest validation
+  path (produce CRC covers every batch byte).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.checkers.base import Checker, FileContext, RawFinding, dotted
+
+_HASH_CONSUMERS = {
+    "crc32c",
+    "crc32c_update",
+    "crc32c_extend",
+    "crc32c_many",
+    "xxhash64",
+    "xxhash32",
+    "crc32",
+    "adler32",
+}
+
+
+def _is_copy_call(node: ast.Call) -> bool:
+    return (
+        isinstance(node.func, ast.Name)
+        and node.func.id in ("bytes", "bytearray")
+        and bool(node.args)  # bytes() / bytearray() constructors are fine
+        and not isinstance(node.args[0], ast.Constant)  # bytes(0), bytearray(n)
+    )
+
+
+class IobufCopyChecker(Checker):
+    name = "iobuf-copy"
+    rules = {
+        "IOB401": "bytes()/bytearray() view materialization inside a loop",
+        "IOB402": "bytes() copy fed straight to a buffer-accepting CRC/hash",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        findings: list[RawFinding] = []
+
+        class V(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.loops = 0
+
+            def _loop(self, node) -> None:
+                self.loops += 1
+                self.generic_visit(node)
+                self.loops -= 1
+
+            visit_For = _loop
+            visit_AsyncFor = _loop
+            visit_While = _loop
+
+            def visit_Return(self, node: ast.Return) -> None:
+                pass  # single loop-exit materialization: the API boundary
+
+            def visit_Raise(self, node: ast.Raise) -> None:
+                pass
+
+            def visit_Call(self, node: ast.Call) -> None:
+                if self.loops and _is_copy_call(node):
+                    findings.append(
+                        RawFinding(
+                            "IOB401",
+                            node.lineno,
+                            node.col_offset,
+                            "per-iteration bytes() materialization copies "
+                            "the view each pass; keep the memoryview or "
+                            "hoist the copy out of the loop",
+                        )
+                    )
+                self.generic_visit(node)
+
+        V().visit(ctx.tree)
+
+        # IOB402 applies everywhere, including return statements
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func).rsplit(".", 1)[-1]
+            if name in _HASH_CONSUMERS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Call) and _is_copy_call(arg):
+                        findings.append(
+                            RawFinding(
+                                "IOB402",
+                                arg.lineno,
+                                arg.col_offset,
+                                f"{name}() accepts any buffer — the bytes() "
+                                f"copy of its argument is pure overhead; "
+                                f"pass the view directly",
+                            )
+                        )
+        yield from findings

@@ -1,0 +1,98 @@
+"""Reactor discipline: no blocking calls lexically inside ``async def``.
+
+The broker runs one asyncio loop per shard (the seastar-reactor analogue);
+one blocking call inside a coroutine stalls every connection, raft timer
+and fetch long-poll on that shard. Offload with ``asyncio.to_thread`` /
+``loop.run_in_executor``, use the async primitive (``asyncio.sleep``,
+``asyncio.create_subprocess_exec``, stream APIs), or — for genuinely
+startup-only paths — suppress with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.checkers.base import (
+    Checker,
+    FileContext,
+    RawFinding,
+    dotted,
+    enclosing_async_functions,
+    walk_in_function,
+)
+
+_SLEEPS = {"time.sleep"}
+_SUBPROCESS = {
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.Popen",
+    "os.system",
+    "os.spawnl",
+    "os.spawnv",
+    "os.popen",
+}
+# sync filesystem entry points; os.path.* predicates are cheap metadata and
+# deliberately not flagged
+_FILE_IO = {
+    "open",
+    "io.open",
+    "os.listdir",
+    "os.walk",
+    "os.scandir",
+    "os.replace",
+    "os.rename",
+    "os.remove",
+    "os.unlink",
+    "os.makedirs",
+    "os.rmdir",
+    "shutil.copy",
+    "shutil.copyfile",
+    "shutil.copytree",
+    "shutil.rmtree",
+    "shutil.move",
+}
+_SOCKET = {
+    "socket.create_connection",
+    "socket.socket",
+    "socket.getaddrinfo",
+    "socket.gethostbyname",
+}
+
+
+class ReactorChecker(Checker):
+    name = "reactor"
+    rules = {
+        "RCT101": "blocking time.sleep() inside async def",
+        "RCT102": "blocking subprocess/os-exec call inside async def",
+        "RCT103": "synchronous file I/O inside async def",
+        "RCT104": "synchronous socket call inside async def",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        for fn in enclosing_async_functions(ctx.tree):
+            for node in walk_in_function(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                rule = None
+                if name in _SLEEPS:
+                    rule = "RCT101"
+                elif name in _SUBPROCESS:
+                    rule = "RCT102"
+                elif name in _FILE_IO:
+                    rule = "RCT103"
+                elif name in _SOCKET:
+                    rule = "RCT104"
+                if rule is None:
+                    continue
+                yield RawFinding(
+                    rule,
+                    node.lineno,
+                    node.col_offset,
+                    f"{name}() blocks the event loop inside async "
+                    f"{fn.name}(); use the asyncio primitive or "
+                    f"asyncio.to_thread",
+                )

@@ -1,0 +1,103 @@
+"""Task hygiene: no lost asyncio tasks, no un-awaited coroutines.
+
+``asyncio.create_task`` only holds a weak reference to the task: a task
+whose handle is dropped can be garbage-collected mid-flight, and its
+exceptions vanish into the void (the reference's ssx::spawn_with_gate
+exists for exactly this). Retain handles in a set (add_done_callback to
+discard) and cancel them on shutdown. A bare un-awaited coroutine call
+never runs at all — Python only warns at GC time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.checkers.base import Checker, FileContext, RawFinding, dotted
+
+
+def _is_create_task(node: ast.Call) -> bool:
+    name = dotted(node.func)
+    if name.endswith(".create_task") or name == "create_task":
+        return True
+    # asyncio.get_running_loop().create_task(...) / get_event_loop() chains
+    f = node.func
+    return isinstance(f, ast.Attribute) and f.attr == "create_task"
+
+
+def _ensure_future(node: ast.Call) -> bool:
+    return dotted(node.func).endswith("ensure_future")
+
+
+class TaskHygieneChecker(Checker):
+    name = "task-hygiene"
+    rules = {
+        "TSK301": "asyncio.create_task result dropped (lost task)",
+        "TSK302": "coroutine called but not awaited",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        # --- TSK301: bare-statement create_task ------------------------------
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                call = node.value
+                if _is_create_task(call) or _ensure_future(call):
+                    yield RawFinding(
+                        "TSK301",
+                        node.lineno,
+                        node.col_offset,
+                        "create_task() handle dropped: the task can be "
+                        "GC'd mid-flight and its exceptions are lost; retain "
+                        "it (set + add_done_callback) and cancel on shutdown",
+                    )
+
+        # --- TSK302: bare-statement calls to known-async functions ----------
+        mod_async = {
+            n.name
+            for n in ctx.tree.body
+            if isinstance(n, ast.AsyncFunctionDef)
+        }
+        class_async: list[set[str]] = []
+
+        checker = self
+        findings: list[RawFinding] = []
+
+        class V(ast.NodeVisitor):
+            def visit_ClassDef(self, node: ast.ClassDef) -> None:
+                class_async.append(
+                    {
+                        m.name
+                        for m in node.body
+                        if isinstance(m, ast.AsyncFunctionDef)
+                    }
+                )
+                self.generic_visit(node)
+                class_async.pop()
+
+            def visit_Expr(self, node: ast.Expr) -> None:
+                if not isinstance(node.value, ast.Call):
+                    return
+                f = node.value.func
+                target = None
+                if isinstance(f, ast.Name) and f.id in mod_async:
+                    target = f.id
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                    and any(f.attr in s for s in class_async)
+                ):
+                    target = "self." + f.attr
+                if target is not None:
+                    findings.append(
+                        RawFinding(
+                            "TSK302",
+                            node.lineno,
+                            node.col_offset,
+                            f"{target}() is a coroutine function but the "
+                            f"call is not awaited — it never runs",
+                        )
+                    )
+
+        V().visit(ctx.tree)
+        yield from findings

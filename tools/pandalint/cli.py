@@ -1,0 +1,160 @@
+"""pandalint CLI.
+
+Exit codes: 0 = gate passes, 1 = active findings under --strict (or parse
+errors in any mode), 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.pandalint.baseline import load_baseline, write_baseline
+from tools.pandalint.checkers import rule_catalog
+from tools.pandalint.config import Config
+from tools.pandalint.engine import LintEngine
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pandalint",
+        description="AST invariant checker: reactor stalls, TPU tracer "
+        "leaks, lost tasks, iobuf copies.",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories to lint")
+    p.add_argument(
+        "--strict",
+        action="store_true",
+        help="exit 1 on any active (non-suppressed, non-baselined) finding",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
+    )
+    p.add_argument(
+        "--rules",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help="ignore findings whose fingerprint is recorded in FILE",
+    )
+    p.add_argument(
+        "--write-baseline",
+        metavar="FILE",
+        help="record every current finding's fingerprint to FILE and exit 0",
+    )
+    p.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print suppressed findings",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule, (checker, desc) in sorted(rule_catalog().items()):
+            print(f"{rule}  [{checker}] {desc}")
+        print("SUP001  [engine] suppression pragma without a reason")
+        print("SYN001  [engine] file fails to parse")
+        return 0
+
+    if not args.paths:
+        print("pandalint: no paths given (try: pandalint redpanda_tpu/)", file=sys.stderr)
+        return 2
+
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"pandalint: path does not exist: {p}", file=sys.stderr)
+            return 2
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(rule_catalog()) - {"SUP001", "SYN001"}
+        if unknown:
+            print(f"pandalint: unknown rules: {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    config = Config.load("pyproject.toml" if os.path.exists("pyproject.toml") else None)
+    engine = LintEngine(config, rules)
+    reports = engine.lint_paths(args.paths)
+
+    all_findings = [f for r in reports for f in r.findings]
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, all_findings)
+        print(
+            f"pandalint: wrote {len(all_findings)} fingerprint(s) to "
+            f"{args.write_baseline}"
+        )
+        return 0
+
+    baselined: set[str] = set()
+    if args.baseline:
+        try:
+            baselined = load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"pandalint: cannot load baseline: {e}", file=sys.stderr)
+            return 2
+
+    active = [
+        f
+        for f in all_findings
+        if not f.suppressed and f.fingerprint() not in baselined
+    ]
+    suppressed = [f for f in all_findings if f.suppressed]
+    parse_errors = [f for f in all_findings if f.rule == "SYN001"]
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "files": len(reports),
+                    "active": [f.to_dict() for f in active],
+                    "suppressed": [f.to_dict() for f in suppressed],
+                    "baselined": sorted(
+                        f.fingerprint()
+                        for f in all_findings
+                        if not f.suppressed and f.fingerprint() in baselined
+                    ),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in active:
+            print(f.render())
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f.render())
+        n_base = sum(
+            1 for f in all_findings if not f.suppressed and f.fingerprint() in baselined
+        )
+        print(
+            f"pandalint: {len(reports)} file(s), {len(active)} active, "
+            f"{len(suppressed)} suppressed, {n_base} baselined"
+        )
+
+    if parse_errors:
+        return 1
+    if args.strict and active:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""Scoping configuration.
+
+Each checker applies to a set of package subtrees; files under the package
+root that match no scope are skipped for that checker, while files OUTSIDE
+the package root (e.g. test fixtures) always get every checker — fixtures
+must be lintable without ceremony.
+
+Defaults can be overridden from ``pyproject.toml``::
+
+    [tool.pandalint]
+    package_root = "redpanda_tpu"
+
+    [tool.pandalint.scopes]
+    reactor = ["redpanda_tpu/kafka", "redpanda_tpu/raft"]
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# Every checker runs package-wide by default: the hot-path rules are
+# already gated on jit reachability and the reactor rules on `async def`,
+# so broad scope adds no noise — and a violation injected ANYWHERE under
+# the package must fail the gate. Narrow via [tool.pandalint.scopes] when
+# a subtree genuinely owns a different contract (e.g. blocking CLIs).
+DEFAULT_SCOPES: dict[str, tuple[str, ...]] = {
+    "reactor": (),        # empty scope = the whole package
+    "hotpath-sync": (),
+    "hotpath-numpy": (),
+    "hotpath-control": (),
+    "task-hygiene": (),
+    "iobuf-copy": (),
+}
+
+DEFAULT_PACKAGE_ROOT = "redpanda_tpu"
+
+
+@dataclass
+class Config:
+    package_root: str = DEFAULT_PACKAGE_ROOT
+    scopes: dict[str, tuple[str, ...]] = field(
+        default_factory=lambda: dict(DEFAULT_SCOPES)
+    )
+
+    def checker_applies(self, checker_name: str, relpath: str) -> bool:
+        rel = relpath.replace("\\", "/")
+        root = self.package_root.rstrip("/") + "/"
+        if not (rel.startswith(root) or rel == self.package_root):
+            return True  # outside the package (fixtures, tools): lint fully
+        scope = self.scopes.get(checker_name, ())
+        if not scope:
+            return True
+        return any(rel.startswith(p.rstrip("/") + "/") or rel == p for p in scope)
+
+    @classmethod
+    def load(cls, pyproject_path: str | None = None) -> "Config":
+        cfg = cls()
+        if pyproject_path is None:
+            return cfg
+        try:
+            import tomllib
+        except ImportError:  # Python < 3.11
+            try:
+                import tomli as tomllib  # type: ignore[no-redef]
+            except ImportError:
+                return cfg
+        try:
+            with open(pyproject_path, "rb") as f:
+                data = tomllib.load(f)
+        except (OSError, ValueError):
+            return cfg
+        section = data.get("tool", {}).get("pandalint", {})
+        if "package_root" in section:
+            cfg.package_root = str(section["package_root"])
+        for name, paths in section.get("scopes", {}).items():
+            cfg.scopes[name] = tuple(str(p) for p in paths)
+        return cfg

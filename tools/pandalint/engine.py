@@ -1,0 +1,129 @@
+"""Lint engine: walk files, parse, run checkers, apply suppressions."""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.pandalint.checkers import ALL_CHECKERS, FileContext
+from tools.pandalint.config import Config
+from tools.pandalint.finding import FileReport, Finding
+from tools.pandalint.suppress import SuppressionTable
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def iter_python_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    return out
+
+
+class LintEngine:
+    def __init__(self, config: Config | None = None, rules: set[str] | None = None):
+        self.config = config or Config()
+        self.rules = rules  # None = all
+        self.checkers = [cls() for cls in ALL_CHECKERS]
+
+    # ------------------------------------------------------------ one file
+    def lint_file(self, path: str, relpath: str | None = None) -> FileReport:
+        rel = (relpath or path).replace(os.sep, "/")
+        report = FileReport(path=rel)
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                source = fh.read()
+        except OSError as e:
+            report.parse_error = str(e)
+            report.findings.append(
+                Finding("SYN001", rel, 1, 0, f"cannot read file: {e}", "engine")
+            )
+            return report
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            report.parse_error = str(e)
+            report.findings.append(
+                Finding(
+                    "SYN001",
+                    rel,
+                    e.lineno or 1,
+                    (e.offset or 1) - 1,
+                    f"syntax error: {e.msg} (file cannot import on this "
+                    f"interpreter)",
+                    "engine",
+                    source_line=(e.text or "").strip(),
+                )
+            )
+            return report
+
+        ctx = FileContext(relpath=rel, tree=tree, source=source)
+        table = SuppressionTable(source)
+        for pragma in table.malformed:
+            report.findings.append(
+                Finding(
+                    "SUP001",
+                    rel,
+                    pragma.line,
+                    0,
+                    "pandalint pragma without a `-- reason` (or disable-file "
+                    "below the file header): nothing is suppressed",
+                    "engine",
+                    source_line=ctx.line_text(pragma.line),
+                )
+            )
+
+        for checker in self.checkers:
+            if not self.config.checker_applies(checker.name, rel):
+                continue
+            for raw in checker.check(ctx):
+                if self.rules is not None and raw.rule not in self.rules:
+                    continue
+                # a pragma may sit on the finding's line or on the first
+                # line of the enclosing logical statement (one line up for
+                # wrapped expressions)
+                candidates = (raw.line, raw.line - 1)
+                pragma = table.lookup(raw.rule, candidates)
+                report.findings.append(
+                    Finding(
+                        raw.rule,
+                        rel,
+                        raw.line,
+                        raw.col,
+                        raw.message,
+                        checker.name,
+                        source_line=ctx.line_text(raw.line),
+                        suppressed=pragma is not None,
+                        suppress_reason=pragma.reason if pragma else "",
+                    )
+                )
+        report.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        return report
+
+    # ------------------------------------------------------------ many files
+    def lint_paths(self, paths: list[str], root: str | None = None) -> list[FileReport]:
+        root = root or os.getcwd()
+        reports = []
+        for path in iter_python_files(paths):
+            rel = os.path.relpath(path, root)
+            if rel.startswith(".."):
+                rel = path
+            reports.append(self.lint_file(path, rel))
+        return reports
+
+
+def lint_paths(
+    paths: list[str],
+    config: Config | None = None,
+    rules: set[str] | None = None,
+    root: str | None = None,
+) -> list[FileReport]:
+    return LintEngine(config, rules).lint_paths(paths, root)

@@ -1,0 +1,59 @@
+"""Finding record + stable fingerprints for baseline comparison."""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str           # e.g. "RCT101"
+    path: str           # repo-relative posix path
+    line: int           # 1-based
+    col: int            # 0-based
+    message: str
+    checker: str        # checker name, e.g. "reactor"
+    source_line: str = ""       # stripped text of the offending line
+    suppressed: bool = False    # a disable pragma with a reason covers it
+    suppress_reason: str = ""
+
+    def fingerprint(self) -> str:
+        """Stable identity for baselines: rule + file + normalized source
+        text (NOT the line number, so unrelated edits above the finding
+        don't invalidate the baseline)."""
+        h = hashlib.sha256()
+        h.update(self.rule.encode())
+        h.update(b"\0")
+        h.update(self.path.encode())
+        h.update(b"\0")
+        h.update(" ".join(self.source_line.split()).encode())
+        return h.hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "checker": self.checker,
+            "suppressed": self.suppressed,
+            "suppress_reason": self.suppress_reason,
+            "fingerprint": self.fingerprint(),
+        }
+
+    def render(self) -> str:
+        tag = " (suppressed: %s)" % self.suppress_reason if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}{tag}"
+
+
+@dataclass
+class FileReport:
+    """All findings for one file, plus parse status."""
+
+    path: str
+    findings: list[Finding] = field(default_factory=list)
+    parse_error: str | None = None

@@ -1,0 +1,258 @@
+"""Module-local jit reachability + light taint analysis.
+
+The hot-path checkers need to know, per file:
+
+1. which functions are **jit roots** — decorated with ``@jax.jit`` /
+   ``@jit`` / ``@partial(jax.jit, ...)`` / ``@jax.vmap`` / ``@jax.pmap``,
+   or passed by name to ``jax.jit(f)`` / ``jax.vmap(f)`` /
+   ``shard_map(f, ...)``;
+2. which functions are **reachable** from a root through module-local
+   calls (bare-name calls and ``self.method`` calls within a class) —
+   everything a root calls executes under trace, so the purity rules apply
+   to the whole reachable set;
+3. which names inside a reachable function are **traced** — seeded from the
+   root's parameters and propagated through call arguments and simple
+   assignments, with ``.shape`` / ``.ndim`` / ``.dtype`` / ``len()``
+   explicitly laundering taint (static under jit).
+
+The analysis is intentionally module-local and name-based: cross-module
+reachability would need import resolution for marginal gain, and a false
+edge is worse than a missed one for a lint gate people must keep green.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+_JIT_ATTRS = {"jit", "vmap", "pmap"}
+_WRAPPER_CALLS = {"jit", "vmap", "pmap", "shard_map"}
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+_TAINT_LAUNDER_CALLS = {"len", "range", "enumerate", "isinstance", "type"}
+
+
+def _dotted(node: ast.expr) -> str:
+    """'jax.jit' for Attribute/Name chains, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_marker(node: ast.expr) -> bool:
+    """True for jax.jit / jit / jax.vmap / partial(jax.jit, ...) etc."""
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func)
+        if name.rsplit(".", 1)[-1] == "partial":
+            return any(_is_jit_marker(a) for a in node.args)
+        return name.rsplit(".", 1)[-1] in _WRAPPER_CALLS
+    name = _dotted(node)
+    return name.rsplit(".", 1)[-1] in _JIT_ATTRS
+
+
+@dataclass
+class FuncInfo:
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    cls: str | None                      # enclosing class name, if a method
+    is_root: bool = False
+    reachable: bool = False
+    tainted_params: set[str] = field(default_factory=set)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def param_names(self) -> list[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+
+class JitGraph:
+    """Reachability + taint facts for one parsed module."""
+
+    def __init__(self, tree: ast.Module):
+        self.funcs: dict[int, FuncInfo] = {}        # id(node) -> info
+        self._by_name: dict[str, list[FuncInfo]] = {}
+        self._collect(tree)
+        self._mark_roots(tree)
+        self._propagate()
+
+    # ------------------------------------------------------------ collection
+    def _collect(self, tree: ast.Module) -> None:
+        stack: list[str | None] = [None]
+
+        graph = self
+
+        class V(ast.NodeVisitor):
+            def visit_ClassDef(self, node: ast.ClassDef) -> None:
+                stack.append(node.name)
+                self.generic_visit(node)
+                stack.pop()
+
+            def _func(self, node) -> None:
+                info = FuncInfo(node, cls=stack[-1])
+                graph.funcs[id(node)] = info
+                graph._by_name.setdefault(node.name, []).append(info)
+                if any(_is_jit_marker(d) for d in node.decorator_list):
+                    info.is_root = True
+                self.generic_visit(node)
+
+            visit_FunctionDef = _func
+            visit_AsyncFunctionDef = _func
+
+        V().visit(tree)
+
+    def _mark_roots(self, tree: ast.Module) -> None:
+        # jax.jit(fn) / shard_map(_local, ...) style roots: the function is
+        # passed by name as the first positional argument
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func).rsplit(".", 1)[-1]
+            if name not in _WRAPPER_CALLS or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                for info in self._by_name.get(arg.id, []):
+                    info.is_root = True
+
+    # ------------------------------------------------------------ reachability
+    def _callees(self, info: FuncInfo) -> list[tuple[FuncInfo, ast.Call]]:
+        out = []
+        for sub in ast.walk(info.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if isinstance(f, ast.Name):
+                for cand in self._by_name.get(f.id, []):
+                    out.append((cand, sub))
+            elif (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("self", "cls")
+                and info.cls is not None
+            ):
+                for cand in self._by_name.get(f.attr, []):
+                    if cand.cls == info.cls:
+                        out.append((cand, sub))
+        return out
+
+    def _propagate(self) -> None:
+        work = []
+        for info in self.funcs.values():
+            if info.is_root:
+                info.reachable = True
+                info.tainted_params.update(info.param_names())
+                work.append(info)
+        # termination: a function re-enters the worklist only when its
+        # reachable flag or tainted_params grew, both monotonic
+        while work:
+            info = work.pop()
+            tainted = self._tainted_names(info)
+            for callee, call in self._callees(info):
+                changed = not callee.reachable
+                callee.reachable = True
+                params = callee.param_names()
+                for i, arg in enumerate(call.args):
+                    if i < len(params) and expr_tainted(arg, tainted):
+                        if params[i] not in callee.tainted_params:
+                            callee.tainted_params.add(params[i])
+                            changed = True
+                for kw in call.keywords:
+                    if kw.arg and kw.arg in params and expr_tainted(kw.value, tainted):
+                        if kw.arg not in callee.tainted_params:
+                            callee.tainted_params.add(kw.arg)
+                            changed = True
+                if changed:
+                    work.append(callee)
+
+    # ------------------------------------------------------------ taint
+    def _tainted_names(self, info: FuncInfo) -> set[str]:
+        """Forward pass over the function body: names carrying traced data."""
+        tainted = set(info.tainted_params)
+        # two passes to settle simple use-before-reassign chains
+        for _ in range(2):
+            for stmt in ast.walk(info.node):
+                if isinstance(stmt, ast.Assign):
+                    src = expr_tainted(stmt.value, tainted)
+                    for tgt in stmt.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                if src:
+                                    tainted.add(n.id)
+                                else:
+                                    tainted.discard(n.id)
+                elif isinstance(stmt, ast.AugAssign) and isinstance(stmt.target, ast.Name):
+                    if expr_tainted(stmt.value, tainted):
+                        tainted.add(stmt.target.id)
+        return tainted
+
+    # ------------------------------------------------------------ queries
+    def info_for(self, node) -> FuncInfo | None:
+        return self.funcs.get(id(node))
+
+    def reachable_functions(self) -> list[FuncInfo]:
+        return [f for f in self.funcs.values() if f.reachable]
+
+
+def expr_tainted(node: ast.expr, tainted: set[str]) -> bool:
+    """Does the expression mention a tainted name, modulo laundering?
+
+    ``x.shape`` / ``x.ndim`` / ``x.dtype`` / ``x.size`` and ``len(x)`` are
+    static under jit and do not propagate taint.
+    """
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _SHAPE_ATTRS:
+            continue
+        if isinstance(sub, ast.Call):
+            fname = _dotted(sub.func).rsplit(".", 1)[-1]
+            if fname in _TAINT_LAUNDER_CALLS:
+                continue
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            # laundered when it only appears under .shape/.len — approximate:
+            # check the direct parent chain instead of re-walking; cheap
+            # version: treat any bare mention as tainted unless the WHOLE
+            # expression is a shape access
+            if not _under_launder(node, sub):
+                return True
+    return False
+
+
+def _under_launder(root: ast.expr, target: ast.Name) -> bool:
+    """True when `target` only feeds shape/len-style static accessors."""
+
+    class P(ast.NodeVisitor):
+        def __init__(self):
+            self.hit = False
+
+        def visit_Attribute(self, node: ast.Attribute) -> None:
+            if node.attr in _SHAPE_ATTRS and any(
+                sub is target for sub in ast.walk(node.value)
+            ):
+                return  # laundered subtree: don't descend
+            self.generic_visit(node)
+
+        def visit_Call(self, node: ast.Call) -> None:
+            fname = _dotted(node.func).rsplit(".", 1)[-1]
+            if fname in _TAINT_LAUNDER_CALLS and any(
+                sub is target for a in node.args for sub in ast.walk(a)
+            ):
+                return
+            self.generic_visit(node)
+
+        def visit_Name(self, node: ast.Name) -> None:
+            if node is target:
+                self.hit = True
+
+    p = P()
+    p.visit(root)
+    return not p.hit

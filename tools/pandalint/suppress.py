@@ -1,0 +1,95 @@
+"""Suppression pragmas.
+
+Syntax, anchored to the line carrying the finding (or the first line of the
+enclosing multi-line statement)::
+
+    expr  # pandalint: disable=RCT101 -- why this is safe here
+    expr  # pandalint: disable=RCT101,TSK301 -- one reason covers both
+
+A whole file can opt out of specific rules (line 1-5 header comment)::
+
+    # pandalint: disable-file=HPN211 -- numpy host twin, not traced
+
+A reason string after ``--`` is REQUIRED: a disable without one does not
+suppress anything and is itself reported as SUP001, so every silenced
+finding carries its justification in the source.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+
+_PRAGMA = re.compile(
+    r"#\s*pandalint:\s*(?P<kind>disable(?:-file)?)\s*=\s*"
+    r"(?P<rules>[A-Z0-9*,\s]+?)\s*(?:--\s*(?P<reason>.*\S))?\s*$"
+)
+
+_FILE_HEADER_LINES = 5  # disable-file pragmas must appear near the top
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: tuple[str, ...]   # rule ids, or ("*",)
+    reason: str              # "" when missing (malformed)
+    file_level: bool
+
+    def covers(self, rule: str) -> bool:
+        return "*" in self.rules or rule in self.rules
+
+
+class SuppressionTable:
+    """Parsed pragmas for one file."""
+
+    def __init__(self, source: str):
+        self.line_pragmas: dict[int, Pragma] = {}
+        self.file_pragmas: list[Pragma] = []
+        self.malformed: list[Pragma] = []  # pragma without a reason
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            comments = [
+                (tok.start[0], tok.string)
+                for tok in tokens
+                if tok.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = [
+                (i + 1, line[line.index("#"):])
+                for i, line in enumerate(source.splitlines())
+                if "#" in line
+            ]
+        for lineno, text in comments:
+            m = _PRAGMA.search(text)
+            if not m:
+                continue
+            rules = tuple(
+                r.strip() for r in m.group("rules").split(",") if r.strip()
+            )
+            reason = (m.group("reason") or "").strip()
+            file_level = m.group("kind") == "disable-file"
+            pragma = Pragma(lineno, rules, reason, file_level)
+            if not reason:
+                self.malformed.append(pragma)
+                continue
+            if file_level:
+                if lineno <= _FILE_HEADER_LINES:
+                    self.file_pragmas.append(pragma)
+                else:
+                    self.malformed.append(pragma)
+            else:
+                self.line_pragmas[lineno] = pragma
+
+    def lookup(self, rule: str, lines: tuple[int, ...]) -> Pragma | None:
+        """First pragma covering `rule` on any of the candidate lines, else a
+        file-level pragma, else None."""
+        for ln in lines:
+            p = self.line_pragmas.get(ln)
+            if p is not None and p.covers(rule):
+                return p
+        for p in self.file_pragmas:
+            if p.covers(rule):
+                return p
+        return None
