@@ -10,6 +10,9 @@ Parity with redpanda/admin_server.cc:
 - GET/POST/DELETE /v1/security/users   (:401-483 SCRAM CRUD)
 - GET  /v1/failure-probes, PUT /v1/failure-probes/{m}/{p}/{type} (:948)
 - GET  /metrics                        (:148-151 prometheus)
+- GET  /v1/trace/recent, /v1/trace/slow (pandaprobe span traces; no
+  reference analogue — seastar requests never leave their shard, ours
+  cross the engine's harvester thread)
 - GET  /v1/status/ready
 Served on the owned HTTP server (the reference uses seastar httpd with swagger routes).
 """
@@ -122,6 +125,8 @@ class AdminServer:
             web.put("/v1/failure-probes/{module}/{probe}/{type}", self._set_probe),
             web.delete("/v1/failure-probes/{module}/{probe}", self._unset_probe),
             web.get("/metrics", self._metrics),
+            web.get("/v1/trace/recent", self._trace_recent),
+            web.get("/v1/trace/slow", self._trace_slow),
             web.get("/v1/status/ready", self._ready),
         ])
         from redpanda_tpu.utils.http_server import start_site
@@ -460,3 +465,32 @@ class AdminServer:
             content_type="text/plain",
             charset="utf-8",
         )
+
+    # ------------------------------------------------------------ traces
+    async def _trace_recent(self, req: web.Request) -> web.Response:
+        from redpanda_tpu.observability import tracer
+
+        try:
+            # clamp: recent(0) means "whole ring" programmatically, but an
+            # HTTP limit<=0 must never turn a poll into a full-ring dump
+            limit = max(1, int(req.query.get("limit", "20")))
+        except ValueError:
+            return web.json_response({"error": "limit must be an int"}, status=400)
+        return web.json_response({
+            "enabled": tracer.enabled,
+            "spans_recorded": tracer.spans_recorded,
+            "traces": tracer.recent(limit),
+        })
+
+    async def _trace_slow(self, req: web.Request) -> web.Response:
+        from redpanda_tpu.observability import tracer
+
+        try:
+            limit = max(1, int(req.query.get("limit", "50")))
+        except ValueError:
+            return web.json_response({"error": "limit must be an int"}, status=400)
+        return web.json_response({
+            "enabled": tracer.enabled,
+            "threshold_ms": tracer.slow_threshold_us / 1000.0,
+            "spans": tracer.slow(limit),
+        })

@@ -99,6 +99,15 @@ class Application:
         from redpanda_tpu.utils.platform import pin_cpu_if_requested
 
         pin_cpu_if_requested()
+        # pandaprobe: the probe histograms are always on; the span tracer
+        # only spends clock reads + ring slots when the operator asks
+        from redpanda_tpu.observability import tracer
+
+        tracer.configure(
+            enabled=c.trace_enabled,
+            capacity=c.trace_ring_capacity,
+            slow_threshold_ms=float(c.trace_slow_threshold_ms),
+        )
         # rpk iotune's characterization, when present (io-config.json in the
         # data dir): published below as metrics for operators/dashboards
         from redpanda_tpu.config.io_config import load_io_config
@@ -377,6 +386,16 @@ class Application:
         registry.gauge("readers_cache_hits", lambda: rc.hits, "Read cursor hits")
         registry.gauge(
             "readers_cache_misses", lambda: rc.misses, "Read cursor misses"
+        )
+        from redpanda_tpu.observability import tracer
+
+        registry.gauge(
+            "trace_enabled", lambda: 1.0 if tracer.enabled else 0.0,
+            "pandaprobe span tracer armed",
+        )
+        registry.gauge(
+            "trace_spans_recorded", lambda: tracer.spans_recorded,
+            "Spans committed to the trace ring since start",
         )
         if self.io_config:
             io = self.io_config

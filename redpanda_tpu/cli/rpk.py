@@ -312,10 +312,50 @@ async def cmd_config(args) -> int:
 
 # ================================================================ debug / generate / tune
 async def cmd_debug(args) -> int:
-    """debug bundle: gather admin state into a tar.gz (rpk debug bundle)."""
+    """debug diagnostics: bundle (tar.gz of admin state) or trace (render
+    the broker's recent pandaprobe spans)."""
     import io
     import tarfile
     import time
+
+    if args.debug_cmd == "trace":
+        path = (
+            f"/v1/trace/slow?limit={args.limit}"
+            if args.slow
+            else f"/v1/trace/recent?limit={args.limit}"
+        )
+        status, body = await _admin_request(args, "GET", path)
+        if status != 200:
+            print(f"admin api returned {status}: {body}")
+            return 1
+        if args.json:
+            print(json.dumps(body, indent=2))
+            return 0
+        if args.slow:
+            spans = body.get("spans", [])
+            if not spans:
+                print(f"no spans over {body.get('threshold_ms')} ms")
+            for s in spans:
+                extra = {
+                    k: v for k, v in s.items()
+                    if k not in ("trace_id", "name", "start_us", "dur_us", "thread")
+                }
+                print(
+                    f"{s['name']:<28}{s['dur_us'] / 1000.0:>10.2f}ms  "
+                    f"trace={s['trace_id']} thread={s['thread']} {extra or ''}"
+                )
+            return 0
+        try:
+            from tools.traceview import render_report
+        except ImportError:  # rpk installed without the tools tree
+            print(json.dumps(body, indent=2))
+            return 0
+        if not body.get("enabled") and not body.get("traces"):
+            print("tracer is disabled and the ring is empty; enable with "
+                  "`trace_enabled: true` in the broker config")
+            return 0
+        print(render_report(body, max_traces=args.limit))
+        return 0
 
     bundle: dict[str, object] = {}
     for name, path in [
@@ -323,6 +363,7 @@ async def cmd_debug(args) -> int:
         ("brokers.json", "/v1/brokers"),
         ("partitions.json", "/v1/partitions"),
         ("metrics.txt", "/metrics"),
+        ("traces.json", "/v1/trace/recent"),
     ]:
         status, body = await _admin_request(args, "GET", path)
         bundle[name] = body if status == 200 else {"error": status}
@@ -363,7 +404,12 @@ def cmd_generate(args) -> int:
             "panels": [
                 {"title": "Partitions", "expr": "redpanda_tpu_partitions_total"},
                 {"title": "Topics", "expr": "redpanda_tpu_topics_total"},
-                {"title": "Produce latency", "expr": "redpanda_tpu_produce_latency_us_bucket"},
+                {"title": "Produce latency", "expr": "redpanda_tpu_kafka_produce_latency_us_bucket"},
+                {"title": "Fetch latency", "expr": "redpanda_tpu_kafka_fetch_latency_us_bucket"},
+                {"title": "Storage append latency", "expr": "redpanda_tpu_storage_append_latency_us_bucket"},
+                {"title": "Raft replicate latency", "expr": "redpanda_tpu_raft_replicate_latency_us_bucket"},
+                {"title": "Coproc stage latency", "expr": "redpanda_tpu_coproc_stage_latency_us_bucket"},
+                {"title": "Device link bytes", "expr": "redpanda_tpu_coproc_device_transfer_bytes_total"},
             ],
         }, indent=2))
     return 0
@@ -508,6 +554,10 @@ def build_parser() -> argparse.ArgumentParser:
     dsub = dp.add_subparsers(dest="debug_cmd", required=True)
     db = dsub.add_parser("bundle")
     db.add_argument("-o", "--output")
+    dt = dsub.add_parser("trace", help="recent pandaprobe spans (admin api)")
+    dt.add_argument("--slow", action="store_true", help="slow-request log only")
+    dt.add_argument("--limit", type=int, default=10, help="traces/spans to fetch")
+    dt.add_argument("--json", action="store_true", help="raw JSON, no rendering")
 
     gp = sub.add_parser("generate", help="monitoring + deployment configs")
     gsub = gp.add_subparsers(dest="generate_cmd", required=True)

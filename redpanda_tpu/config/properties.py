@@ -111,6 +111,13 @@ PROPERTIES: list[Property] = [
     Property("kafka_qdc_enable", "Queue-depth latency control on the kafka path", False, bool),
     Property("kafka_qdc_max_latency_ms", "qdc target handler latency", 80, int, _positive),
     Property("debug_sanitize_files", "Debug file-handle sanitizer on storage I/O", False, bool),
+    # --- observability (pandaprobe; probes at /metrics are always on).
+    # All three snapshot into the tracer once at app start: needs_restart
+    # stays True until a runtime config-set path actually re-applies them
+    # (tracer.configure() itself is hot-safe when that path arrives).
+    Property("trace_enabled", "Record pandaprobe spans (GET /v1/trace/recent)", False, bool),
+    Property("trace_ring_capacity", "Bounded span ring size", 2048, int, _positive),
+    Property("trace_slow_threshold_ms", "Spans over this land in the slow-request log", 500, int, _positive),
     # --- security
     Property("enable_sasl", "Require SASL on the kafka listener", False, bool),
     Property("superusers", "Comma-separated superuser principals", ""),

@@ -52,6 +52,8 @@ import numpy as np
 from redpanda_tpu.hashing.xx import xxhash64
 from redpanda_tpu.models.fundamental import NTP
 from redpanda_tpu.models.record import Compression, RecordBatch
+from redpanda_tpu.observability import probes
+from redpanda_tpu.observability.trace import tracer
 from redpanda_tpu.ops.pipeline import IN_META, make_packed_pipeline, unpack_result
 
 logger = logging.getLogger("rptpu.coproc.engine")
@@ -98,6 +100,10 @@ class ProcessBatchItem:
 @dataclass
 class ProcessBatchRequest:
     items: list[ProcessBatchItem] = field(default_factory=list)
+    # pandaprobe trace id: executor threads don't inherit the caller's task
+    # context, so the ambient id rides the request object across the hop
+    # (pacemaker tick → engine submit → harvester thread).
+    trace_id: int | None = None
 
 
 @dataclass
@@ -137,11 +143,14 @@ class _Launch:
     __slots__ = ("script_id", "policy", "mode", "r_out", "ranges", "fits",
                  "engine", "n", "_packed_dev", "_mask_dev", "_mask_np",
                  "_mask_event", "_proj_data", "_proj_ok", "_plan",
-                 "_exploded", "_mat", "_framed", "_lock")
+                 "_exploded", "_mat", "_framed", "_lock",
+                 "trace_id", "_enq_t")
 
     def __init__(self, script_id: int, policy: ErrorPolicy):
         self.script_id = script_id
         self.policy = policy
+        self.trace_id: int | None = None
+        self._enq_t = 0.0
         self.mode = "payload"
         self.r_out = 0
         self.ranges: list[tuple[int, int]] = []
@@ -296,8 +305,12 @@ class _Launch:
         return self._mat
 
     def _stat(self, key: str, t0: float):
+        dt = time.perf_counter() - t0
         if self.engine is not None:
-            self.engine._stat_add(key, time.perf_counter() - t0)
+            self.engine._stat_add(key, dt)
+        # harvest-side stage span (fetch/assemble): runs on whatever thread
+        # materializes, so the launch's explicit trace id carries it
+        tracer.record("coproc." + key[2:], dt * 1e6, self.trace_id, start_perf=t0)
 
 
 def _pack_values(ex, stride: int):
@@ -344,10 +357,15 @@ class Ticket:
 
     def __init__(self, engine: "TpuEngine"):
         self._engine = engine
+        self.trace_id: int | None = None
         # (disposition, item, launch, [batch range indices])
         self._slots: list[tuple] = []
 
     def result(self) -> ProcessBatchReply:
+        with tracer.span("coproc.harvest", trace_id=self.trace_id):
+            return self._result_impl()
+
+    def _result_impl(self) -> ProcessBatchReply:
         reply = ProcessBatchReply()
         dereg: set[int] = set()
         failed_scripts: set[int] = set()
@@ -467,12 +485,25 @@ class TpuEngine:
     def _harvest_loop(self) -> None:
         while True:
             launch = self._harvest_q.get()
+            t_get = time.perf_counter()
             try:
                 if launch._mask_dev is not None:
-                    launch._mask_np = np.asarray(launch._mask_dev)
+                    launch._mask_np = np.asarray(launch._mask_dev)  # pandalint: disable=ENG502 -- dedicated harvester thread; paying the D2H sync off the event loop is its entire job
             except Exception:
                 launch._mask_np = None  # materialize() falls back
             finally:
+                t_done = time.perf_counter()
+                # device-time span: the asarray completes the async D2H, so
+                # its wall time is the post-block_until_ready device leg;
+                # queue_us is how long the launch waited for this thread.
+                tracer.record(
+                    "coproc.device_harvest",
+                    (t_done - t_get) * 1e6,
+                    launch.trace_id,
+                    start_perf=t_get,
+                    queue_us=int((t_get - launch._enq_t) * 1e6),
+                    device_us=int((t_done - t_get) * 1e6),
+                )
                 launch._mask_event.set()
 
     # ------------------------------------------------------------ control
@@ -600,8 +631,20 @@ class TpuEngine:
 
     def _stat_add(self, key: str, v: float) -> None:
         # Harvests may run on executor threads concurrently with dispatch.
+        # The probe mirror records UNDER the same lock: HdrHist.record is a
+        # read-modify-write, and concurrent harvest threads would lose
+        # samples recorded outside it. Per-launch cadence, so the lock is
+        # off the per-record path. Stage wall times become
+        # coproc_stage_latency_us{stage=...}; link traffic becomes the
+        # device-transfer counters.
         with self._stats_lock:
             self._stats[key] += v
+            if key.startswith("t_"):
+                probes.coproc_stage_hist(key[2:]).record(int(v * 1e6))
+            elif key == "bytes_h2d":
+                probes.coproc_h2d_bytes.inc(v)
+            elif key == "bytes_d2h":
+                probes.coproc_d2h_bytes.inc(v)
 
     def heartbeat(self) -> int:
         """Returns the number of registered scripts (liveness probe)."""
@@ -631,6 +674,7 @@ class TpuEngine:
         # script_id -> list of (ticket, slot_idx, item)
         by_script: dict[int, list[tuple]] = {}
         for ticket, req in zip(tickets, reqs):
+            ticket.trace_id = req.trace_id
             for item in req.items:
                 if item.script_id not in self._handles:
                     ticket._slots.append((_UNKNOWN, item, None, None))
@@ -643,8 +687,12 @@ class TpuEngine:
         for script_id, entries in by_script.items():
             handle = self._handles[script_id]
             launch = _Launch(script_id, handle.policy)
+            # a fused launch serves many requests; the first requester's
+            # trace adopts it (the pacemaker submits one request per tick)
+            launch.trace_id = entries[0][0].trace_id
             try:
-                self._dispatch(script_id, launch, entries)
+                with tracer.span("coproc.dispatch", trace_id=launch.trace_id):
+                    self._dispatch(script_id, launch, entries)
                 ridx = 0
                 for ticket, slot_idx, item in entries:
                     rng = list(range(ridx, ridx + len(item.batches)))
@@ -690,6 +738,8 @@ class TpuEngine:
         launch.n = n
         self._stat_add("n_records", n)
         self._stat_add("n_launches", 1)
+        with self._stats_lock:  # concurrent submits: HdrHist isn't thread-safe
+            probes.coproc_launch_rows_hist.record(n)
         if plan.mode == "payload":
             self._dispatch_payload(launch, exploded, n)
         elif plan.mode == "columnar":
@@ -770,6 +820,7 @@ class TpuEngine:
                 launch._mask_dev = mask
                 launch._mask_event = threading.Event()
                 self._ensure_harvester()
+                launch._enq_t = time.perf_counter()
                 self._harvest_q.put(launch)
         # Projection extraction overlaps the device launch.
         t0 = time.perf_counter()

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 
 from redpanda_tpu.coproc.engine import (
     ProcessBatchItem,
@@ -26,6 +27,7 @@ from redpanda_tpu.coproc.engine import (
     TpuEngine,
 )
 from redpanda_tpu.models.fundamental import NTP, MaterializedNTP
+from redpanda_tpu.observability.trace import tracer
 from redpanda_tpu.storage.kvstore import KeySpace
 
 logger = logging.getLogger("rptpu.coproc.pacemaker")
@@ -92,6 +94,7 @@ class ScriptContext:
         pm = self.pacemaker
         items = []
         read_high: dict[NTP, int] = {}
+        t_read0 = time.perf_counter()
         for ntp in self._input_ntps():
             batches = await self._read_ntp(ntp)
             if batches:
@@ -99,26 +102,38 @@ class ScriptContext:
                 read_high[ntp] = batches[-1].last_offset
         if not items:
             return False
-        # Submit AND harvest run in worker threads: the first dispatch of a
-        # spec jit-compiles for seconds, and anything that blocks the
-        # broker's event loop that long stops raft heartbeats and forces
-        # cluster-wide re-elections (measured: every group re-elected ~10s
-        # after the first deploy when submit ran on-loop).
-        loop = asyncio.get_running_loop()
-        req = ProcessBatchRequest(items)
-        ticket = await loop.run_in_executor(None, pm.engine.submit, req)
-        reply = await loop.run_in_executor(None, ticket.result)
-        if self.script_id in reply.deregistered:
-            logger.warning("script %s deregistered by engine policy", self.name)
-            pm.detach_script(self.name)
-            self._task = None
-            raise _StopScript()
-        moved = False
-        for item in reply.items:
-            if await self._write_materialized(item.source, item.batches):
-                self.offsets[item.source] = read_high[item.source]
-                moved = True
-        return moved
+        # One trace per productive tick (idle ticks would drown the ring);
+        # the read phase is back-dated into it once we know work exists.
+        with tracer.span("coproc.tick", root=True) as tick_span:
+            tracer.record(
+                "coproc.read",
+                (time.perf_counter() - t_read0) * 1e6,
+                tick_span.trace_id,
+                start_perf=t_read0,
+            )
+            # Submit AND harvest run in worker threads: the first dispatch of
+            # a spec jit-compiles for seconds, and anything that blocks the
+            # broker's event loop that long stops raft heartbeats and forces
+            # cluster-wide re-elections (measured: every group re-elected
+            # ~10s after the first deploy when submit ran on-loop).
+            loop = asyncio.get_running_loop()
+            req = ProcessBatchRequest(items, trace_id=tick_span.trace_id)
+            with tracer.span("coproc.submit.wait"):
+                ticket = await loop.run_in_executor(None, pm.engine.submit, req)
+            with tracer.span("coproc.harvest.wait"):
+                reply = await loop.run_in_executor(None, ticket.result)
+            if self.script_id in reply.deregistered:
+                logger.warning("script %s deregistered by engine policy", self.name)
+                pm.detach_script(self.name)
+                self._task = None
+                raise _StopScript()
+            moved = False
+            with tracer.span("coproc.write"):
+                for item in reply.items:
+                    if await self._write_materialized(item.source, item.batches):
+                        self.offsets[item.source] = read_high[item.source]
+                        moved = True
+            return moved
 
     def _input_ntps(self) -> list[NTP]:
         out = []

@@ -19,6 +19,7 @@ from redpanda_tpu.kafka.protocol.batch import decode_wire_batches, encode_wire_b
 from redpanda_tpu.kafka.protocol.errors import ErrorCode
 from redpanda_tpu.cluster.partition import ConsistencyLevel
 from redpanda_tpu.cluster.topic_table import TopicConfig
+from redpanda_tpu.observability.trace import tracer
 from redpanda_tpu.security.acl import AclOperation, ResourceType
 
 E = ErrorCode
@@ -225,6 +226,15 @@ def _valid_topic_name(name: str) -> bool:
 
 # ---------------------------------------------------------------- produce
 async def handle_produce(ctx) -> dict | None:
+    # Request entry point: a fresh trace per produce; raft.replicate /
+    # storage.append spans below join it via the ambient id. The latency
+    # HISTOGRAM is recorded once at the dispatch layer (protocol._dispatch
+    # → probes.kafka_produce_hist), which also covers decode/encode.
+    with tracer.span("kafka.produce", root=True):
+        return await _do_handle_produce(ctx)
+
+
+async def _do_handle_produce(ctx) -> dict | None:
     acks = ctx.request["acks"]
     if acks not in (-1, 0, 1):
         responses = [
@@ -370,6 +380,15 @@ async def _produce_one(broker, topic: str, p: dict, level: int, api_version: int
 
 # ---------------------------------------------------------------- fetch
 async def handle_fetch(ctx) -> dict:
+    # The span deliberately includes the long-poll wait (that IS the op's
+    # latency) but is exempt from the slow-request log: an empty long poll
+    # hitting max_wait_ms is intentional waiting, and would otherwise bury
+    # genuinely slow work in the slow ring. Histogram: protocol._dispatch.
+    with tracer.span("kafka.fetch", root=True, no_slow=True):
+        return await _do_handle_fetch(ctx)
+
+
+async def _do_handle_fetch(ctx) -> dict:
     from redpanda_tpu.kafka.server.fetch_session_cache import resolve_session
 
     req = ctx.request

@@ -27,7 +27,6 @@ from redpanda_tpu.kafka.protocol.messages import (
     SASL_HANDSHAKE,
     SYNC_GROUP,
 )
-from redpanda_tpu.metrics import registry as _metrics
 from redpanda_tpu.kafka.protocol.primitives import Reader
 from redpanda_tpu.kafka.protocol.schema import (
     RequestHeader,
@@ -43,12 +42,12 @@ MAX_PIPELINE = 64  # max in-flight requests per connection
 
 # HDR latency probes for the two hot APIs (kafka/latency_probe.h:33-43:
 # the reference histograms produce and fetch specifically), exported at
-# /metrics with cumulative buckets + sum/count for quantile queries
-_produce_latency = _metrics.histogram(
-    "kafka_produce_latency_us", "Produce handler latency (microseconds)"
-)
-_fetch_latency = _metrics.histogram(
-    "kafka_fetch_latency_us", "Fetch handler latency (microseconds)"
+# /metrics with cumulative buckets + sum/count for quantile queries.
+# Defined once in observability/probes.py; recorded ONLY here at the
+# dispatch layer so decode/encode are covered and nothing double-counts.
+from redpanda_tpu.observability.probes import (  # noqa: E402
+    kafka_fetch_hist as _fetch_latency,
+    kafka_produce_hist as _produce_latency,
 )
 
 

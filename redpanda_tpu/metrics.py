@@ -47,8 +47,18 @@ class Histogram:
         self.hist.record(value)
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping: backslash, quote, newline."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    """HELP lines escape backslash and newline (not quotes)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _labelstr(labels: tuple[tuple[str, str], ...], extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in labels]
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in labels]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -92,7 +102,7 @@ class MetricsRegistry:
 
         def _head(name: str, help: str, typ: str) -> None:
             if name not in seen_help:
-                lines.append(f"# HELP {PREFIX}_{name} {help}")
+                lines.append(f"# HELP {PREFIX}_{name} {_escape_help(help)}")
                 lines.append(f"# TYPE {PREFIX}_{name} {typ}")
                 seen_help.add(name)
 
@@ -120,6 +130,29 @@ class MetricsRegistry:
             lines.append(f"{PREFIX}_{h.name}_sum{_labelstr(h.labels)} {h.hist.sum}")
             lines.append(f"{PREFIX}_{h.name}_count{_labelstr(h.labels)} {h.hist.count}")
         return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """Point-in-time metric values keyed by UNPREFIXED series name +
+        labels (exposition lines additionally carry the ``redpanda_tpu_``
+        prefix) — the before/after anchor tools/microbench.py emits so a
+        bench run can be diffed against the counters it moved."""
+        out: dict[str, object] = {}
+        for c in self._counters.values():
+            out[f"{c.name}{_labelstr(c.labels)}"] = c.value
+        for g in self._gauges.values():
+            try:
+                v = g.fn()
+            except Exception:
+                v = None
+            out[f"{g.name}{_labelstr(g.labels)}"] = v
+        for h in self._hists.values():
+            out[f"{h.name}{_labelstr(h.labels)}"] = {
+                "count": h.hist.count,
+                "sum": h.hist.sum,
+                "max": h.hist.max,
+                "p99": h.hist.percentile(99),
+            }
+        return out
 
 
 # process-wide registry, like the seastar metrics singleton

@@ -1,0 +1,16 @@
+"""pandaprobe: end-to-end span tracing + per-subsystem latency probes.
+
+Two complementary layers:
+
+* ``probes`` — always-on prometheus histograms/counters per subsystem,
+  exported at ``/metrics`` (the reference's probe.h pattern).
+* ``tracer`` — an opt-in span tracer (``trace_enabled`` config) that
+  stitches one batch's produce → raft → TPU-transform → fetch journey into
+  a single trace retrievable at ``/v1/trace/recent`` and renderable with
+  ``tools/traceview.py`` (or ``rpk debug trace``).
+"""
+
+from redpanda_tpu.observability import probes
+from redpanda_tpu.observability.trace import Tracer, tracer
+
+__all__ = ["Tracer", "probes", "tracer"]

@@ -1,0 +1,107 @@
+"""Per-subsystem latency probes over the metrics registry.
+
+Parity with the reference's probe-per-subsystem pattern (storage/probe.h,
+raft/probe.cc, kafka/latency_probe.h): each hot path owns a histogram in
+the process-wide registry, exported at /metrics. Unlike the tracer
+(trace.py) these are ALWAYS on — a histogram record is a dict lookup plus
+integer bucket math, the price the reference pays on every request too.
+
+Naming convention (README "Observability"): ``<subsystem>_<stage>_latency_us``
+for latency histograms, ``coproc_stage_latency_us{stage=...}`` for the
+engine's per-stage breakdown, ``*_bytes_total`` for transfer counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from redpanda_tpu.metrics import Counter, Histogram, registry
+
+# ------------------------------------------------------------ broker path
+storage_append_hist = registry.histogram(
+    "storage_append_latency_us", "Storage log append latency (us)"
+)
+storage_housekeeping_hist = registry.histogram(
+    "storage_housekeeping_latency_us",
+    "One compaction/retention housekeeping pass over a log (us)",
+)
+raft_replicate_hist = registry.histogram(
+    "raft_replicate_latency_us",
+    "Raft replicate() to the requested consistency level (us)",
+)
+# Recorded at the kafka dispatch layer (server/protocol.py _dispatch), so
+# one request is one sample — handler wrappers must NOT record these too.
+kafka_produce_hist = registry.histogram(
+    "kafka_produce_latency_us", "Produce handler latency (microseconds)"
+)
+kafka_fetch_hist = registry.histogram(
+    "kafka_fetch_latency_us",
+    "Fetch handler latency incl. long-poll wait (microseconds)",
+)
+rpc_request_hist = registry.histogram(
+    "rpc_request_latency_us", "Internal RPC round-trip latency (us)"
+)
+
+# ------------------------------------------------------------ coproc engine
+coproc_h2d_bytes = registry.counter(
+    "coproc_device_transfer_bytes_total",
+    "Bytes staged to / fetched from the device",
+    direction="h2d",
+)
+coproc_d2h_bytes = registry.counter(
+    "coproc_device_transfer_bytes_total",
+    "Bytes staged to / fetched from the device",
+    direction="d2h",
+)
+coproc_launch_rows_hist = registry.histogram(
+    "coproc_launch_rows",
+    "Records fused into one device launch (bucket size after shape rounding)",
+)
+
+_coproc_stage: dict[str, Histogram] = {}
+_coproc_stage_lock = threading.Lock()
+
+
+def coproc_stage_hist(stage: str) -> Histogram:
+    """Histogram for one engine stage (explode/pack/dispatch/fetch/...).
+
+    Locked creation: harvests run on executor threads, and an unlocked
+    check-then-create could register one Histogram in the registry while
+    caching a twin here — the exported series would then stay frozen.
+    Callers serialize record() themselves (the engine records under its
+    _stats_lock; HdrHist's read-modify-write is not thread-safe)."""
+    h = _coproc_stage.get(stage)
+    if h is None:
+        with _coproc_stage_lock:
+            h = _coproc_stage.get(stage)
+            if h is None:
+                h = registry.histogram(
+                    "coproc_stage_latency_us",
+                    "TPU engine per-stage wall time (us)",
+                    stage=stage,
+                )
+                _coproc_stage[stage] = h
+    return h
+
+
+def observe_us(hist: Histogram, t0: float) -> None:
+    """Record elapsed-since-t0 (a perf_counter timestamp) in microseconds."""
+    hist.record(int((time.perf_counter() - t0) * 1e6))
+
+
+__all__ = [
+    "Counter",
+    "Histogram",
+    "coproc_d2h_bytes",
+    "coproc_h2d_bytes",
+    "coproc_launch_rows_hist",
+    "coproc_stage_hist",
+    "kafka_fetch_hist",
+    "kafka_produce_hist",
+    "observe_us",
+    "raft_replicate_hist",
+    "rpc_request_hist",
+    "storage_append_hist",
+    "storage_housekeeping_hist",
+]

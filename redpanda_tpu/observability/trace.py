@@ -1,0 +1,317 @@
+"""pandaprobe span tracer: where does a record batch spend its time?
+
+The reference answers "what is slow" with per-subsystem probes exported at
+/metrics; it has no cross-subsystem *trace* because a seastar request never
+leaves its shard. Our produce → raft → TPU-transform → fetch path crosses
+an event loop, an executor pool AND the engine's harvester thread, so the
+aggregate histograms (observability/probes.py) are paired with a span
+tracer that stitches one batch's journey back together:
+
+  with tracer.span("raft.replicate"):
+      ...
+
+* A span inherits the ambient trace id (a ``contextvars.ContextVar``, so it
+  follows the asyncio task across awaits); ``root=True`` starts a fresh
+  trace, and a mid-path span with NO ambient trace is a no-op (heartbeat /
+  follower chatter must not mint orphan traces that evict real ones).
+  Work hopping to another thread carries the id EXPLICITLY
+  (``ProcessBatchRequest.trace_id`` → ``Ticket`` → ``_Launch`` → the
+  harvester thread) because executor threads do not inherit task context.
+* Completed spans land in a bounded ring (``collections.deque(maxlen=N)``)
+  — tracing a busy broker must never grow memory; old traces fall off.
+* Spans record wall time; stages that wait in a queue or block on the
+  device attach ``queue_us`` / ``device_us`` extras (the harvester records
+  device time AFTER the async D2H lands, i.e. post-``block_until_ready``
+  semantics).
+* Spans over ``slow_threshold_us`` additionally land in a slow-request
+  ring and a WARNING log line — the "why was this one produce 2s" answer
+  without trawling the full ring.
+
+Cost discipline: a disabled tracer does ONE attribute check per span and
+returns a shared no-op context manager — no clock read, no allocation, no
+lock (tools/microbench.py --only tracer_overhead measures the delta).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import threading
+import time
+from contextvars import ContextVar
+
+logger = logging.getLogger("rptpu.observability.trace")
+
+# Ambient trace id for the current asyncio task / thread.
+_current_trace: ContextVar[int | None] = ContextVar("rptpu_trace_id", default=None)
+
+_UNSET = object()
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the entire cost of a disabled tracer."""
+
+    __slots__ = ()
+    trace_id = None
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, key: str, value) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _Detached:
+    """Nulls the ambient trace id for the duration of the block."""
+
+    __slots__ = ("_token",)
+
+    def __enter__(self) -> "_Detached":
+        self._token = _current_trace.set(None)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _current_trace.reset(self._token)
+        return False
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "trace_id", "_token", "_t0", "extras",
+                 "_no_slow")
+
+    def __init__(
+        self, tracer: "Tracer", name: str, trace_id: int, no_slow: bool
+    ) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self._token = None
+        self._t0 = 0.0
+        self.extras: dict | None = None
+        self._no_slow = no_slow
+
+    def set(self, key: str, value) -> None:
+        """Attach an extra (queue_us, device_us, bytes, ...) to this span."""
+        if self.extras is None:
+            self.extras = {}
+        self.extras[key] = value
+
+    def __enter__(self) -> "_Span":
+        self._token = _current_trace.set(self.trace_id)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = time.perf_counter()
+        _current_trace.reset(self._token)
+        self._tracer._commit(
+            self.name,
+            self.trace_id,
+            self._t0,
+            (t1 - self._t0) * 1e6,
+            self.extras,
+            no_slow=self._no_slow,
+        )
+        return False
+
+
+class Tracer:
+    """Bounded, thread-safe span recorder. One process-wide instance
+    (``tracer`` below), configured from broker config in app startup."""
+
+    def __init__(
+        self,
+        *,
+        enabled: bool = False,
+        capacity: int = 2048,
+        slow_capacity: int = 256,
+        slow_threshold_ms: float = 500.0,
+    ) -> None:
+        self.enabled = enabled
+        self.slow_threshold_us = float(slow_threshold_ms) * 1000.0
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._slow: collections.deque = collections.deque(maxlen=slow_capacity)
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._recorded = 0
+        # wall-clock anchor so start_us is meaningful across processes
+        self._epoch_wall = time.time()
+        self._epoch_perf = time.perf_counter()
+
+    # ------------------------------------------------------------ config
+    def configure(
+        self,
+        *,
+        enabled: bool | None = None,
+        capacity: int | None = None,
+        slow_threshold_ms: float | None = None,
+    ) -> None:
+        with self._lock:
+            if capacity is not None and capacity != self._ring.maxlen:
+                self._ring = collections.deque(self._ring, maxlen=capacity)
+            if slow_threshold_ms is not None:
+                self.slow_threshold_us = float(slow_threshold_ms) * 1000.0
+        if enabled is not None:
+            self.enabled = enabled  # last: spans only start once ring is sized
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._slow.clear()
+            self._recorded = 0
+
+    # ------------------------------------------------------------ ids
+    def new_trace_id(self) -> int:
+        return next(self._ids)
+
+    def current_trace(self) -> int | None:
+        """Ambient trace id (None when disabled or outside any span) —
+        what cross-thread hops stamp onto their request objects."""
+        if not self.enabled:
+            return None
+        return _current_trace.get()
+
+    @property
+    def spans_recorded(self) -> int:
+        return self._recorded
+
+    # ------------------------------------------------------------ spans
+    def span(
+        self, name: str, trace_id=_UNSET, *, root: bool = False,
+        no_slow: bool = False,
+    ):
+        """Context manager timing one stage.
+
+        - ``span(name)``: joins the ambient trace; NO-OP when there is
+          none. Traces only ever originate at request entry points
+          (``root=True``) — a mid-path span (storage.append on a follower,
+          an rpc.send heartbeat) must not mint single-span orphan traces,
+          or steady-state chatter evicts the end-to-end traces the ring
+          exists for.
+        - ``span(name, root=True)``: starts a fresh trace (request entry
+          points: kafka produce/fetch, a coproc tick).
+        - ``span(name, trace_id=tid)``: explicit id carried across a
+          thread hop; ``tid=None`` means "caller had no trace" → no-op.
+        - ``no_slow=True``: exempt from the slow-request log — for spans
+          whose duration is INTENTIONAL waiting (a fetch long poll), which
+          would otherwise bury real slow work.
+        """
+        if not self.enabled:
+            return _NOOP
+        if root:
+            tid = self.new_trace_id()
+        elif trace_id is _UNSET:
+            tid = _current_trace.get()
+            if tid is None:
+                return _NOOP
+        elif trace_id is None:
+            return _NOOP
+        else:
+            tid = trace_id
+        return _Span(self, name, tid, no_slow)
+
+    def detached(self):
+        """Wrap creation of LONG-LIVED tasks (a replicate batcher's flush
+        loop, follower recovery) in this: ``asyncio.create_task`` copies the
+        caller's contextvars, so a task spawned inside a request span would
+        otherwise attribute every span it ever records to that first
+        request's trace — starving later traces of their legs and growing
+        one ancient trace forever. Work the task does on behalf of many
+        requests either carries ids explicitly or goes untraced."""
+        return _Detached()
+
+    def record(
+        self,
+        name: str,
+        dur_us: float,
+        trace_id: int | None = None,
+        *,
+        start_perf: float | None = None,
+        **extras,
+    ) -> None:
+        """Manually record a completed stage (used where a context manager
+        cannot wrap the work: harvester thread, pre-trace read phases)."""
+        if not self.enabled or trace_id is None:
+            return
+        t0 = start_perf if start_perf is not None else time.perf_counter() - dur_us / 1e6
+        self._commit(name, trace_id, t0, dur_us, extras or None)
+
+    def _commit(
+        self,
+        name: str,
+        trace_id: int,
+        t0: float,
+        dur_us: float,
+        extras: dict | None,
+        *,
+        no_slow: bool = False,
+    ) -> None:
+        span = {
+            "trace_id": trace_id,
+            "name": name,
+            "start_us": int((t0 - self._epoch_perf) * 1e6),
+            "dur_us": int(dur_us),
+            "thread": threading.current_thread().name,
+        }
+        if extras:
+            span.update(extras)
+        with self._lock:
+            self._ring.append(span)
+            self._recorded += 1
+            if not no_slow and dur_us >= self.slow_threshold_us:
+                self._slow.append(span)
+                slow = True
+            else:
+                slow = False
+        if slow:
+            logger.warning(
+                "slow span %s: %.1f ms (trace %d, thread %s)",
+                name, dur_us / 1000.0, trace_id, span["thread"],
+            )
+
+    # ------------------------------------------------------------ queries
+    def recent(self, limit: int = 20) -> list[dict]:
+        """Newest-first traces: [{trace_id, wall_us, spans:[...]}, ...].
+
+        Spans of one trace are grouped and time-ordered; a trace whose
+        early spans already fell off the ring shows what survived.
+        """
+        with self._lock:
+            spans = list(self._ring)
+        by_trace: dict[int, list[dict]] = {}
+        order: list[int] = []
+        for s in spans:
+            tid = s["trace_id"]
+            if tid not in by_trace:
+                by_trace[tid] = []
+                order.append(tid)
+            by_trace[tid].append(s)
+        out = []
+        for tid in reversed(order[-limit:] if limit else order):
+            group = sorted(by_trace[tid], key=lambda s: s["start_us"])
+            first = min(s["start_us"] for s in group)
+            last = max(s["start_us"] + s["dur_us"] for s in group)
+            out.append({
+                "trace_id": tid,
+                "epoch": self._epoch_wall,
+                "wall_us": last - first,
+                "spans": group,
+            })
+        return out
+
+    def slow(self, limit: int = 50) -> list[dict]:
+        """Newest-first spans that crossed the slow threshold."""
+        with self._lock:
+            return list(self._slow)[-limit:][::-1]
+
+
+# Process-wide tracer, like the metrics registry singleton: subsystems
+# import this instance; app startup flips it on from config.
+tracer = Tracer()

@@ -20,8 +20,11 @@ import bisect
 import logging
 import random
 import struct
+import time
 
 from redpanda_tpu.models.fundamental import NTP
+from redpanda_tpu.observability import probes
+from redpanda_tpu.observability.trace import tracer
 from redpanda_tpu.models.record import Record, RecordBatch, RecordBatchType
 from redpanda_tpu.raft.configuration import ConfigurationManager, GroupConfiguration
 from redpanda_tpu.raft.types import (
@@ -244,8 +247,12 @@ class Consensus:
     def _spawn_bg(self, coro) -> asyncio.Task:
         """create_task with a retained handle: fire-and-forget raft work
         (step-down, transfer elections, quorum acks) must not be GC'd
-        mid-flight and must die with the group (pandalint TSK301)."""
-        t = asyncio.create_task(coro)
+        mid-flight and must die with the group (pandalint TSK301).
+        Detached from any ambient trace: these outlive the request that
+        triggered them, and create_task's context copy would otherwise pin
+        its trace id onto everything they ever record."""
+        with tracer.detached():
+            t = asyncio.create_task(coro)
         self._bg_tasks.add(t)
         t.add_done_callback(self._bg_tasks.discard)
         return t
@@ -429,9 +436,16 @@ class Consensus:
         consistency: ConsistencyLevel = ConsistencyLevel.quorum_ack,
         timeout: float | None = 10.0,
     ) -> ReplicateResult:
-        enqueued, replicated = await self.replicate_in_stages(batches, consistency, timeout)
-        await enqueued
-        return await replicated
+        t0 = time.perf_counter()
+        try:
+            with tracer.span("raft.replicate"):
+                enqueued, replicated = await self.replicate_in_stages(
+                    batches, consistency, timeout
+                )
+                await enqueued
+                return await replicated
+        finally:
+            probes.observe_us(probes.raft_replicate_hist, t0)
 
     async def replicate_in_stages(
         self,
@@ -491,7 +505,10 @@ class Consensus:
         if f.is_recovering or self._stopped or not self.is_leader():
             return
         f.is_recovering = True
-        t = asyncio.create_task(self._recover_follower(f))
+        # detached: recovery outlives the replicate that kicked it and
+        # serves every later append too — no single trace owns it
+        with tracer.detached():
+            t = asyncio.create_task(self._recover_follower(f))
         self._recovery_tasks[f.node.id] = t
         t.add_done_callback(lambda _t: self._recovery_tasks.pop(f.node.id, None))
 
@@ -935,7 +952,11 @@ class _ReplicateBatcher:
         replicated: asyncio.Future = loop.create_future()
         self._pending.append((batches, enqueued, replicated, timeout))
         if self._flush_task is None or self._flush_task.done():
-            self._flush_task = asyncio.create_task(self._flush())
+            # detached: under sustained load this task loops across MANY
+            # coalesced replicates — inheriting the first caller's trace id
+            # would mis-attribute every later append's spans to it
+            with tracer.detached():
+                self._flush_task = asyncio.create_task(self._flush())
         return enqueued, replicated
 
     async def _flush(self) -> None:

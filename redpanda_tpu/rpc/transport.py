@@ -15,8 +15,11 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import time
 
 from redpanda_tpu.hashing.jump import jump_consistent_hash
+from redpanda_tpu.observability import probes
+from redpanda_tpu.observability.trace import tracer
 from redpanda_tpu.rpc import wire
 
 logger = logging.getLogger("rpc.transport")
@@ -89,15 +92,25 @@ class Transport:
         corr = next(self._corr)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._inflight[corr] = fut
-        self._writer.write(wire.frame(payload, method_id, corr, compress=self.compress))
-        await self._writer.drain()
+        t0 = time.perf_counter()
         try:
-            if timeout is not None:
-                return await asyncio.wait_for(fut, timeout)
-            return await fut
-        except asyncio.TimeoutError:
-            self._inflight.pop(corr, None)
-            raise RpcError(wire.STATUS_REQUEST_TIMEOUT, "client timeout")
+            with tracer.span("rpc.send") as sp:
+                sp.set("method_id", method_id)
+                self._writer.write(
+                    wire.frame(payload, method_id, corr, compress=self.compress)
+                )
+                await self._writer.drain()
+                try:
+                    if timeout is not None:
+                        return await asyncio.wait_for(fut, timeout)
+                    return await fut
+                except asyncio.TimeoutError:
+                    self._inflight.pop(corr, None)
+                    raise RpcError(wire.STATUS_REQUEST_TIMEOUT, "client timeout")
+        finally:
+            # every exit path — success, timeout, peer-closed RpcError —
+            # lands in the histogram, or an incident's latency never shows
+            probes.observe_us(probes.rpc_request_hist, t0)
 
     async def close(self) -> None:
         # Take the writer FIRST: cancelling the read loop runs _fail_all,

@@ -22,6 +22,8 @@ from dataclasses import dataclass, field
 from redpanda_tpu.finjector import honey_badger
 from redpanda_tpu.models.fundamental import NTP
 from redpanda_tpu.models.record import RecordBatch
+from redpanda_tpu.observability import probes
+from redpanda_tpu.observability.trace import tracer
 from redpanda_tpu.storage.segment import Segment
 from redpanda_tpu.storage.recovery import recover_segment
 
@@ -165,6 +167,16 @@ class DiskLog:
         if not batches:
             off = self.offsets()
             return AppendResult(off.dirty_offset + 1, off.dirty_offset, 0)
+        t_probe = time.perf_counter()
+        try:
+            with tracer.span("storage.append"):
+                return await self._append_locked(batches, term, assign_offsets)
+        finally:
+            probes.observe_us(probes.storage_append_hist, t_probe)
+
+    async def _append_locked(
+        self, batches: list[RecordBatch], term: int | None, assign_offsets: bool
+    ) -> AppendResult:
         async with self._lock:
             honey_badger.inject_sync("storage", "log_append")
             if term is not None and term > self._term:

@@ -1,0 +1,22 @@
+"""Fixture: device→host syncs on the engine tick/harvest path (ENG50x)."""
+import jax
+import numpy as np
+
+
+async def tick(self):
+    dev = self.mask_dev
+    blob = dev.tobytes()
+    arr = np.asarray(dev)
+    dev.block_until_ready()
+    got = jax.device_get(dev)
+    return blob, arr, got
+
+
+def harvest_loop(q):
+    launch = q.get()
+    return np.asarray(launch.mask_dev)
+
+
+def assemble(values):
+    # not flagged: sync function, name is neither tick nor harvest
+    return np.asarray(values)

@@ -1,0 +1,47 @@
+"""Shared election-stability helper for the raft/cluster test fixtures.
+
+Deflake contract (ISSUE 2 satellite): at startup every node races its first
+election, and a second candidate can depose the first winner moments after
+a test grabbed it (~10-30% of runs under load). A leader only counts once
+it has SURVIVED one full election timeout in the same term — by then every
+peer has seen its heartbeats and won't start a rival election — and has
+committed an entry of its own term (raft §8 ``leadership_settled``), so
+replicate/read assertions built on it hold.
+
+Not collected by pytest (no ``test_`` prefix); imported by test_raft.py and
+test_cluster.py, which differ only in how a node's consensus is reached.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+
+async def wait_for_stable_leader(
+    find_leader: Callable,
+    get_consensus: Callable,
+    election_timeout_s: float,
+    timeout: float = 16.0,
+    what: str = "leader",
+):
+    """Return the first node whose leadership survives one full election
+    timeout in-term with §8 settled; AssertionError after ``timeout``."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        node = find_leader()
+        if node is None:
+            await asyncio.sleep(0.02)
+            continue
+        c = get_consensus(node)
+        term = c.term
+        await asyncio.sleep(election_timeout_s)
+        c = get_consensus(node)
+        if (
+            c is not None
+            and c.is_leader()
+            and c.term == term
+            and c.leadership_settled()
+        ):
+            return node
+    raise AssertionError(f"no stable {what} within timeout")

@@ -250,4 +250,10 @@ def test_microbench_runs_and_reports(tmp_path):
     if is_available(Compression.zstd):
         expected |= {"zstd_compress_mb_s", "zstd_uncompress_mb_s"}
     assert expected <= set(out), out
-    assert all(v > 0 for k, v in out.items() if not k.endswith("_skipped")), out
+    # rates/costs must be positive; the tracer-overhead percentages are
+    # MEANT to sit at ~0 (a 0.0 reading is the bench's best outcome)
+    assert all(
+        v > 0 for k, v in out.items()
+        if not k.endswith("_skipped") and not k.endswith("_pct")
+    ), out
+    assert all(v >= 0 for k, v in out.items() if k.endswith("_pct")), out

@@ -36,6 +36,8 @@ from redpanda_tpu.raft.group_manager import GroupManager
 from redpanda_tpu.raft.types import ConsistencyLevel, VNode
 from redpanda_tpu.storage.log_manager import StorageApi
 
+from raft_stability import wait_for_stable_leader
+
 FAST = dict(election_timeout_ms=150, heartbeat_interval_ms=40)
 
 
@@ -141,7 +143,7 @@ class ClusterFixture:
         seeds = [n.vnode for n in self.nodes]
         for n in self.nodes:
             await n.start_control_plane(seeds)
-        leader = await self.wait_controller_leader()
+        leader = await self.wait_for_stable_leader()
         # seed brokers register themselves (application start does this on join)
         for n in self.nodes:
             await n.dispatcher.replicate(
@@ -167,11 +169,15 @@ class ClusterFixture:
                 return n
         return None
 
-    async def wait_controller_leader(self, timeout: float = 8.0):
-        await wait_until(
-            lambda: self.controller_leader() is not None, timeout, msg="no controller leader"
+    async def wait_for_stable_leader(self, timeout: float = 16.0):
+        """Deflake: see raft_stability.wait_for_stable_leader."""
+        return await wait_for_stable_leader(
+            self.controller_leader,
+            lambda n: n.controller.consensus if n.controller else None,
+            FAST["election_timeout_ms"] / 1000.0,
+            timeout,
+            what="controller leader",
         )
-        return self.controller_leader()
 
     async def wait_converged(self, pred_per_node, timeout: float = 8.0, msg: str = ""):
         await wait_until(
@@ -189,7 +195,7 @@ def test_create_topic_reconciles_on_all_replicas(tmp_path):
     async def main():
         fx = await ClusterFixture(tmp_path, 3).start()
         try:
-            leader = fx.controller_leader()
+            leader = await fx.wait_for_stable_leader()
             await leader.controller.create_topic(
                 TopicConfig("events", partition_count=2, replication_factor=3)
             )
@@ -231,7 +237,7 @@ def test_forwarding_from_non_leader(tmp_path):
     async def main():
         fx = await ClusterFixture(tmp_path, 3).start()
         try:
-            leader = fx.controller_leader()
+            leader = await fx.wait_for_stable_leader()
             follower = next(n for n in fx.nodes if n is not leader)
             # create through a NON-leader broker: dispatcher forwards
             ntp = NTP.kafka("fwd", 0)
@@ -254,7 +260,7 @@ def test_delete_topic_removes_partitions(tmp_path):
     async def main():
         fx = await ClusterFixture(tmp_path, 3).start()
         try:
-            leader = fx.controller_leader()
+            leader = await fx.wait_for_stable_leader()
             await leader.controller.create_topic(
                 TopicConfig("gone", partition_count=1, replication_factor=3)
             )
@@ -278,7 +284,7 @@ def test_metadata_cache_and_leader_gossip(tmp_path):
     async def main():
         fx = await ClusterFixture(tmp_path, 3).start()
         try:
-            leader = fx.controller_leader()
+            leader = await fx.wait_for_stable_leader()
             await leader.controller.create_topic(
                 TopicConfig("md", partition_count=1, replication_factor=3)
             )
@@ -307,7 +313,7 @@ def test_replica_move(tmp_path):
     async def main():
         fx = await ClusterFixture(tmp_path, 4).start()
         try:
-            leader = fx.controller_leader()
+            leader = await fx.wait_for_stable_leader()
             await leader.controller.create_topic(
                 TopicConfig("mv", partition_count=1, replication_factor=3)
             )
@@ -345,7 +351,7 @@ def test_decommission_drains_node(tmp_path):
     async def main():
         fx = await ClusterFixture(tmp_path, 4).start()
         try:
-            leader = fx.controller_leader()
+            leader = await fx.wait_for_stable_leader()
             await leader.controller.create_topic(
                 TopicConfig("dr", partition_count=2, replication_factor=3)
             )
@@ -418,7 +424,7 @@ def test_duplicate_create_applies_as_first_wins_noop(tmp_path):
     async def main():
         fx = await ClusterFixture(tmp_path, 3).start()
         try:
-            leader = fx.controller_leader()
+            leader = await fx.wait_for_stable_leader()
             ntp = NTP.kafka("dup", 0)
             cmd1 = ccmds.create_topic_cmd(
                 {"name": "dup", "ns": "kafka", "replication_factor": 3, "overrides": {}},
@@ -444,7 +450,7 @@ def test_join_via_non_leader_seed(tmp_path):
     async def main():
         fx = await ClusterFixture(tmp_path, 3).start()
         try:
-            leader = fx.controller_leader()
+            leader = await fx.wait_for_stable_leader()
             seed = next(n for n in fx.nodes if n is not leader)  # NON-leader seed
             from redpanda_tpu.cluster import Broker, join_cluster
 
@@ -488,7 +494,7 @@ def test_offsets_gap_free_across_leadership_transfers(tmp_path):
     async def main():
         fx = await ClusterFixture(tmp_path, 3).start()
         try:
-            leader = fx.controller_leader()
+            leader = await fx.wait_for_stable_leader()
             await leader.controller.create_topic(
                 TopicConfig("gapless", partition_count=1, replication_factor=3)
             )

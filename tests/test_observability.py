@@ -1,0 +1,417 @@
+"""pandaprobe: span tracer semantics, per-subsystem probes, trace endpoints.
+
+Covers the ISSUE 2 acceptance surface: /metrics exposes per-stage latency
+histograms for storage append, raft replicate, kafka produce/fetch and the
+coproc engine stages; a produce → coproc → fetch round trip yields one
+trace with >= 6 spans retrievable via /v1/trace/recent; the disabled
+tracer is a shared no-op (the <2% microbench bar lives in
+tools/microbench.py --assert-tracer-overhead).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import aiohttp
+
+from redpanda_tpu.admin import AdminServer
+from redpanda_tpu.cluster.topic_table import TopicConfig
+from redpanda_tpu.coproc.api import CoprocApi
+from redpanda_tpu.kafka.client import KafkaClient
+from redpanda_tpu.kafka.server.broker import Broker, BrokerConfig
+from redpanda_tpu.kafka.server.protocol import KafkaServer
+from redpanda_tpu.metrics import registry
+from redpanda_tpu.observability import probes
+from redpanda_tpu.observability.trace import Tracer, tracer
+from redpanda_tpu.ops.transforms import Int, Str, filter_field_eq, identity, map_project
+from redpanda_tpu.storage.log_manager import StorageApi
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+async def wait_until(pred, timeout=10.0, interval=0.03, msg=""):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not pred():
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(f"timeout: {msg}")
+        await asyncio.sleep(interval)
+
+
+# ---------------------------------------------------------------- tracer unit
+def test_disabled_tracer_is_a_shared_noop():
+    t = Tracer()
+    assert t.span("a") is t.span("b")  # one singleton, no allocation
+    with t.span("x") as sp:
+        sp.set("k", 1)  # must not blow up on the noop
+        assert sp.trace_id is None
+    t.record("manual", 5.0, 123)
+    assert t.spans_recorded == 0
+    assert t.recent() == [] and t.slow() == []
+    assert t.current_trace() is None
+
+
+def test_span_nesting_groups_one_trace():
+    t = Tracer(enabled=True)
+    with t.span("outer", root=True) as outer:
+        with t.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+        t.record("manual", 42.0, outer.trace_id, bytes=7)
+    traces = t.recent()
+    assert len(traces) == 1
+    spans = traces[0]["spans"]
+    assert [s["name"] for s in spans] == ["outer", "inner", "manual"] or {
+        s["name"] for s in spans
+    } == {"outer", "inner", "manual"}
+    manual = next(s for s in spans if s["name"] == "manual")
+    assert manual["bytes"] == 7 and manual["dur_us"] == 42
+    # context is restored: a new root starts a NEW trace
+    with t.span("again", root=True):
+        pass
+    assert len(t.recent()) == 2
+    assert t.recent()[0]["trace_id"] != traces[0]["trace_id"]  # newest first
+
+
+def test_explicit_none_trace_id_is_noop():
+    t = Tracer(enabled=True)
+    with t.span("hop", trace_id=None):
+        pass
+    t.record("hop", 1.0, None)
+    assert t.spans_recorded == 0
+
+
+def test_mid_path_span_without_ambient_trace_is_noop():
+    """Traces only originate at root spans: steady-state chatter (raft
+    heartbeat rpc.send, follower storage.append) must not mint single-span
+    orphan traces that evict the end-to-end ones from the ring."""
+    t = Tracer(enabled=True)
+    with t.span("rpc.send"):  # no ambient trace → skipped entirely
+        pass
+    assert t.spans_recorded == 0 and t.recent() == []
+    with t.span("kafka.produce", root=True):
+        with t.span("rpc.send"):  # joins the request trace normally
+            pass
+    assert {s["name"] for s in t.recent()[0]["spans"]} == {
+        "kafka.produce", "rpc.send"
+    }
+
+
+def test_ring_is_bounded_and_configure_resizes():
+    t = Tracer(enabled=True, capacity=8)
+    for _ in range(50):
+        with t.span("s", root=True):
+            pass
+    assert t.spans_recorded == 50
+    assert sum(len(tr["spans"]) for tr in t.recent(limit=0)) == 8
+    t.configure(capacity=4)
+    assert sum(len(tr["spans"]) for tr in t.recent(limit=0)) == 4
+
+
+def test_slow_spans_land_in_slow_log():
+    t = Tracer(enabled=True, slow_threshold_ms=0.0)  # everything is slow
+    with t.span("crawl", root=True):
+        pass
+    assert [s["name"] for s in t.slow()] == ["crawl"]
+    t.configure(slow_threshold_ms=10_000.0)
+    with t.span("fast", root=True):
+        pass
+    assert [s["name"] for s in t.slow()] == ["crawl"]
+
+
+def test_no_slow_spans_skip_the_slow_log():
+    """Intentional waits (the fetch long poll) must not bury real slow
+    work: a no_slow span lands in the ring but never in the slow log."""
+    t = Tracer(enabled=True, slow_threshold_ms=0.0)
+    with t.span("kafka.fetch", root=True, no_slow=True):
+        pass
+    with t.span("kafka.produce", root=True):
+        pass
+    assert t.spans_recorded == 2
+    assert [s["name"] for s in t.slow()] == ["kafka.produce"]
+
+
+def test_detached_blocks_trace_inheritance():
+    """Long-lived tasks (batcher flush, follower recovery) are created
+    under tracer.detached() so create_task's contextvars copy cannot pin
+    the first requester's trace id onto work serving later requests."""
+    t = Tracer(enabled=True)
+    with t.span("request", root=True) as root:
+        assert t.current_trace() == root.trace_id
+        with t.detached():
+            assert t.current_trace() is None
+            with t.span("bg.append"):  # would-be task body: no ambient → noop
+                pass
+        assert t.current_trace() == root.trace_id
+    assert [s["name"] for s in t.recent()[0]["spans"]] == ["request"]
+
+
+def test_cross_thread_spans_join_the_trace():
+    """The engine hop: an executor/harvester thread has no task context, so
+    the id rides the request object and joins via explicit trace_id."""
+    t = Tracer(enabled=True)
+    with t.span("tick", root=True) as root:
+        tid = root.trace_id
+
+        def harvester():
+            with t.span("device_harvest", trace_id=tid) as sp:
+                sp.set("queue_us", 11)
+
+        th = threading.Thread(target=harvester)
+        th.start()
+        th.join()
+    traces = t.recent()
+    assert len(traces) == 1
+    names = {s["name"] for s in traces[0]["spans"]}
+    assert names == {"tick", "device_harvest"}
+    hv = next(s for s in traces[0]["spans"] if s["name"] == "device_harvest")
+    assert hv["queue_us"] == 11 and hv["thread"] != "MainThread"
+
+
+# ---------------------------------------------------------------- helpers
+async def _start_stack(tmp_path):
+    storage = await StorageApi(str(tmp_path)).start()
+    cfg = BrokerConfig(data_dir=str(tmp_path))
+    broker = Broker(cfg, storage)
+    server = await KafkaServer(broker, "127.0.0.1", 0).start()
+    cfg.advertised_port = server.port
+    api = await CoprocApi(broker).start()
+    api.poll_interval_s = 0.02
+    broker.coproc_api = api
+    admin = await AdminServer(broker, port=0).start()
+    return storage, broker, server, api, admin
+
+
+async def _stop_stack(storage, server, api, admin):
+    await admin.stop()
+    await api.stop()
+    await server.stop()
+    await storage.stop()
+
+
+# ---------------------------------------------------------------- probes e2e
+def test_metrics_expose_per_stage_histograms(tmp_path):
+    """Acceptance: after a produce → coproc → fetch round trip, /metrics
+    carries latency histograms for the kafka handlers, storage append and
+    >= 4 coproc engine stages (raft replicate is covered separately by a
+    real consensus group below)."""
+
+    async def main():
+        storage, broker, server, api, admin = await _start_stack(tmp_path)
+        client = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        try:
+            await broker.create_topic(TopicConfig("obs", 1))
+            # a columnar script (filter+project) exercises the extract /
+            # dispatch stages a host-plan identity never touches
+            spec = filter_field_eq("level", "error") | map_project(
+                Int("code"), Str("msg", 16)
+            )
+            await api.deploy("errs", spec.to_json(), ["obs"])
+            await wait_until(lambda: "errs" in api.active_scripts(), msg="deployed")
+            values = [
+                json.dumps(
+                    {"level": ["error", "info"][i % 2], "code": i, "msg": f"m{i}"},
+                    separators=(",", ":"),
+                ).encode()
+                for i in range(8)
+            ]
+            await client.produce("obs", 0, values)
+            mat = "obs.$errs$"
+            await wait_until(
+                lambda: (
+                    (p := broker.get_partition(mat, 0)) is not None
+                    and p.high_watermark >= 4
+                ),
+                msg="materialized",
+            )
+            batches, _ = await client.fetch("obs", 0, 0)
+            assert sum(len(b.records()) for b in batches) == 8
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{admin.port}/metrics"
+                ) as resp:
+                    assert resp.status == 200
+                    text = await resp.text()
+            for series in (
+                "kafka_produce_latency_us_bucket",
+                "kafka_fetch_latency_us_bucket",
+                "storage_append_latency_us_bucket",
+                "coproc_launch_rows_bucket",
+            ):
+                assert f"redpanda_tpu_{series}" in text, series
+            stages = {
+                line.split('stage="', 1)[1].split('"', 1)[0]
+                for line in text.splitlines()
+                if line.startswith("redpanda_tpu_coproc_stage_latency_us_count")
+            }
+            assert len(stages) >= 4, stages
+        finally:
+            await client.close()
+            await _stop_stack(storage, server, api, admin)
+
+    run(main())
+
+
+def test_raft_replicate_histogram_records():
+    """raft.replicate goes through a REAL consensus group (single voter:
+    elects itself immediately), not a direct-write partition."""
+
+    async def main(tmp_path):
+        from redpanda_tpu import rpc
+        from redpanda_tpu.models.fundamental import NTP
+        from redpanda_tpu.models.record import Record, RecordBatch, RecordBatchType
+        from redpanda_tpu.raft.consensus import RaftTimings
+        from redpanda_tpu.raft.group_manager import GroupManager
+        from redpanda_tpu.raft.types import VNode
+
+        before = probes.raft_replicate_hist.hist.count
+        storage = await StorageApi(tmp_path).start()
+        vnode = VNode(0, 0)
+        gm = GroupManager(
+            vnode, storage, rpc.ConnectionCache(),
+            timings=RaftTimings(election_timeout_ms=150, heartbeat_interval_ms=30),
+        )
+        await gm.start()
+        try:
+            c = await gm.create_group(9, NTP("kafka", "obsraft", 0), [vnode])
+            await wait_until(lambda: c.is_leader(), msg="self-election")
+            batch = RecordBatch.build(
+                [Record(offset_delta=0, value=b"v")], type=RecordBatchType.raft_data
+            )
+            await c.replicate([batch])
+            assert probes.raft_replicate_hist.hist.count > before
+        finally:
+            await gm.stop()
+            await storage.stop()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        run(main(d))
+
+
+# ---------------------------------------------------------------- trace e2e
+def test_produce_coproc_fetch_round_trip_traces(tmp_path):
+    """Acceptance: with tracing enabled, one produce → coproc → fetch round
+    trip yields a coproc tick trace with >= 6 spans — including the
+    harvest-side stages recorded from OTHER threads — retrievable via
+    GET /v1/trace/recent, and kafka.produce traces contain the nested
+    storage.append span."""
+
+    async def main():
+        storage, broker, server, api, admin = await _start_stack(tmp_path)
+        client = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        try:
+            await broker.create_topic(TopicConfig("traced", 1))
+            await api.deploy("ident", identity().to_json(), ["traced"])
+            await wait_until(lambda: "ident" in api.active_scripts(), msg="deployed")
+            await client.produce("traced", 0, [b"r0", b"r1"])
+            mat = "traced.$ident$"
+            await wait_until(
+                lambda: (
+                    (p := broker.get_partition(mat, 0)) is not None
+                    and p.high_watermark >= 2
+                ),
+                msg="materialized",
+            )
+            await client.fetch("traced", 0, 0)
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{admin.port}/v1/trace/recent?limit=50"
+                ) as resp:
+                    assert resp.status == 200
+                    doc = await resp.json()
+                async with s.get(
+                    f"http://127.0.0.1:{admin.port}/v1/trace/slow"
+                ) as resp:
+                    assert resp.status == 200
+                    slow_doc = await resp.json()
+            assert doc["enabled"] is True
+            assert "threshold_ms" in slow_doc
+            traces = doc["traces"]
+            by_root = {}
+            for tr in traces:
+                for s_ in tr["spans"]:
+                    by_root.setdefault(s_["name"], []).append(tr)
+            # the coproc tick trace stitches the whole transform round trip
+            tick_traces = by_root.get("coproc.tick", [])
+            assert tick_traces, [t["spans"][0]["name"] for t in traces]
+            best = max(tick_traces, key=lambda t: len(t["spans"]))
+            names = [s_["name"] for s_ in best["spans"]]
+            assert len(names) >= 6, names
+            for expected in ("coproc.read", "coproc.dispatch", "coproc.harvest"):
+                assert any(n.startswith(expected) for n in names), (expected, names)
+            # the engine hop carried the id across threads
+            threads = {s_["thread"] for s_ in best["spans"]}
+            assert len(threads) >= 2, threads
+            # a produce trace nests the storage append under the handler
+            produce_traces = by_root.get("kafka.produce", [])
+            assert any(
+                "storage.append" in [s_["name"] for s_ in tr["spans"]]
+                for tr in produce_traces
+            ), [t["spans"] for t in produce_traces][:2]
+            assert by_root.get("kafka.fetch"), "fetch trace missing"
+            return doc
+        finally:
+            await client.close()
+            await _stop_stack(storage, server, api, admin)
+
+    tracer.configure(enabled=True, slow_threshold_ms=10_000)
+    tracer.reset()
+    try:
+        doc = run(main())
+    finally:
+        tracer.configure(enabled=False)
+        tracer.reset()
+
+    # the dumped document renders: breakdown table + flamegraph text
+    from tools.traceview import render_report
+
+    report = render_report(doc)
+    assert "coproc.tick" in report and "stage" in report
+    assert "trace " in report
+
+
+# ---------------------------------------------------------------- traceview
+def test_traceview_renders_breakdown_and_flamegraph():
+    from tools.traceview import render_report, render_trace, stage_breakdown
+
+    doc = {
+        "traces": [
+            {
+                "trace_id": 7,
+                "wall_us": 1000,
+                "spans": [
+                    {"trace_id": 7, "name": "kafka.produce", "start_us": 0,
+                     "dur_us": 1000, "thread": "MainThread"},
+                    {"trace_id": 7, "name": "raft.replicate", "start_us": 100,
+                     "dur_us": 700, "thread": "MainThread"},
+                    {"trace_id": 7, "name": "storage.append", "start_us": 200,
+                     "dur_us": 300, "thread": "MainThread", "bytes": 4096},
+                ],
+            }
+        ]
+    }
+    table = stage_breakdown(doc["traces"])
+    assert "kafka.produce" in table and "share" in table
+    fg = render_trace(doc["traces"][0])
+    lines = fg.splitlines()
+    # containment indentation: append nests deeper than replicate
+    lvl = {ln.strip().split()[0]: len(ln) - len(ln.lstrip()) for ln in lines[1:]}
+    assert lvl["storage.append"] > lvl["raft.replicate"] > lvl["kafka.produce"]
+    assert "bytes=4096" in fg
+    report = render_report(doc)
+    assert "trace 7" in report
+    # stdin/file entry point parses the admin-endpoint document shape
+    from tools import traceview
+
+    assert traceview._coerce_traces(doc) == doc["traces"]
+
+
+def test_registry_snapshot_is_jsonable():
+    snap = registry.snapshot()
+    json.dumps(snap)  # no weird types leak out of the registry

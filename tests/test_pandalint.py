@@ -68,6 +68,35 @@ def test_task_hygiene_rules_exact_lines():
     ]
 
 
+def test_engine_sync_rules_exact_lines():
+    got = _active(_lint(os.path.join(FIXTURES, "engine_sync.py")))
+    assert got == [
+        ("ENG501", 8),
+        ("ENG502", 9),
+        ("ENG503", 10),
+        ("ENG503", 11),
+        ("ENG502", 17),  # sync fn, but harvest-named: the loop contract applies
+    ]
+
+
+def test_engine_sync_scoped_to_coproc(tmp_path):
+    """engine-sync defaults to redpanda_tpu/coproc: np.asarray in async code
+    is normal elsewhere in the package, and must not trip the gate there."""
+    cfg = Config()
+    for sub, expect in (("kafka", False), ("coproc", True)):
+        pkg = tmp_path / "redpanda_tpu" / sub
+        pkg.mkdir(parents=True)
+        dst = pkg / "sync.py"
+        shutil.copyfile(os.path.join(FIXTURES, "engine_sync.py"), dst)
+        report = LintEngine(cfg).lint_file(str(dst), f"redpanda_tpu/{sub}/sync.py")
+        assert any(f.rule.startswith("ENG") for f in report.findings) is expect, sub
+    # fixtures outside the package root always get every checker
+    out = tmp_path / "sync.py"
+    shutil.copyfile(os.path.join(FIXTURES, "engine_sync.py"), out)
+    report = LintEngine(cfg).lint_file(str(out), "fixtures/sync.py")
+    assert any(f.rule.startswith("ENG") for f in report.findings)
+
+
 def test_iobuf_rules_exact_lines():
     got = _active(_lint(os.path.join(FIXTURES, "copy_loop.py")))
     assert got == [

@@ -27,6 +27,8 @@ from redpanda_tpu.raft import (
 )
 from redpanda_tpu.storage.log_manager import StorageApi
 
+from raft_stability import wait_for_stable_leader
+
 FAST = dict(election_timeout_ms=200.0, heartbeat_interval_ms=25.0, rpc_timeout_s=0.5)
 GROUP = 7
 NTP_ = NTP("kafka", "rtest", 0)
@@ -131,6 +133,15 @@ class RaftGroupFixture:
         await wait_until(lambda: self.leader() is not None, timeout, msg="no leader elected")
         return self.leader()
 
+    async def wait_for_stable_leader(self, timeout: float = 16.0) -> "RaftNode":
+        """Deflake: see raft_stability.wait_for_stable_leader."""
+        return await wait_for_stable_leader(
+            self.leader,
+            lambda n: n.consensus() if n.gm is not None else None,
+            FAST["election_timeout_ms"] / 1000.0,
+            timeout,
+        )
+
 
 def data_batch(*values: bytes) -> RecordBatch:
     return RecordBatch.build(
@@ -156,12 +167,20 @@ def test_elect_single_leader(tmp_path):
     async def main():
         fx = await RaftGroupFixture(tmp_path, 3).start()
         try:
-            await fx.wait_for_leader()
-            await asyncio.sleep(0.3)  # stability: still exactly one leader
-            leaders = [n for n in fx.nodes if n.consensus().is_leader()]
-            assert len(leaders) == 1
-            term = leaders[0].consensus().term
-            assert all(n.consensus().term == term for n in fx.nodes)
+            await fx.wait_for_stable_leader()
+
+            def settled() -> bool:
+                # exactly one leader and every node in its term — sampled
+                # until true: under heavy load a re-election may still fire
+                # after the stable wait, but split-brain (two leaders, or a
+                # node stuck in an old term) never settles and still fails
+                leaders = [n for n in fx.nodes if n.consensus().is_leader()]
+                if len(leaders) != 1:
+                    return False
+                term = leaders[0].consensus().term
+                return all(n.consensus().term == term for n in fx.nodes)
+
+            await wait_until(settled, msg="one leader, uniform term")
         finally:
             await fx.stop()
 
@@ -172,7 +191,7 @@ def test_replicate_quorum_reaches_all_nodes(tmp_path):
     async def main():
         fx = await RaftGroupFixture(tmp_path, 3).start()
         try:
-            leader = (await fx.wait_for_leader()).consensus()
+            leader = (await fx.wait_for_stable_leader()).consensus()
             res = await leader.replicate([data_batch(b"a", b"b")], ConsistencyLevel.quorum_ack)
             assert leader.commit_index >= res.last_offset
             assert await committed_values(leader) == [b"a", b"b"]
@@ -193,7 +212,7 @@ def test_replicate_coalesces_concurrent_writes(tmp_path):
     async def main():
         fx = await RaftGroupFixture(tmp_path, 3).start()
         try:
-            leader = (await fx.wait_for_leader()).consensus()
+            leader = (await fx.wait_for_stable_leader()).consensus()
             results = await asyncio.gather(
                 *(leader.replicate([data_batch(b"m%d" % i)]) for i in range(20))
             )
@@ -211,7 +230,7 @@ def test_leader_ack_and_no_ack(tmp_path):
     async def main():
         fx = await RaftGroupFixture(tmp_path, 3).start()
         try:
-            leader = (await fx.wait_for_leader()).consensus()
+            leader = (await fx.wait_for_stable_leader()).consensus()
             r1 = await leader.replicate([data_batch(b"la")], ConsistencyLevel.leader_ack)
             r2 = await leader.replicate([data_batch(b"na")], ConsistencyLevel.no_ack)
             assert r2.last_offset > r1.last_offset
@@ -227,7 +246,7 @@ def test_not_leader_rejection(tmp_path):
     async def main():
         fx = await RaftGroupFixture(tmp_path, 3).start()
         try:
-            await fx.wait_for_leader()
+            await fx.wait_for_stable_leader()
             follower = next(n for n in fx.nodes if not n.consensus().is_leader())
             with pytest.raises(RaftError):
                 await follower.consensus().replicate([data_batch(b"x")])
@@ -241,7 +260,7 @@ def test_leader_failover_and_rejoin(tmp_path):
     async def main():
         fx = await RaftGroupFixture(tmp_path, 3).start()
         try:
-            old = await fx.wait_for_leader()
+            old = await fx.wait_for_stable_leader()
             leader_c = old.consensus()
             await leader_c.replicate([data_batch(b"pre")])
             old_dir = old.base_dir
@@ -284,7 +303,7 @@ def test_follower_recovery_after_missing_writes(tmp_path):
     async def main():
         fx = await RaftGroupFixture(tmp_path, 3).start()
         try:
-            leader_node = await fx.wait_for_leader()
+            leader_node = await fx.wait_for_stable_leader()
             leader = leader_node.consensus()
             victim = next(n for n in fx.nodes if n is not leader_node)
             vid, vdir = victim.node_id, victim.base_dir
@@ -315,7 +334,7 @@ def test_leadership_transfer(tmp_path):
     async def main():
         fx = await RaftGroupFixture(tmp_path, 3).start()
         try:
-            old = await fx.wait_for_leader()
+            old = await fx.wait_for_stable_leader()
             target = next(n for n in fx.nodes if n is not old)
             ok = await old.consensus().do_transfer_leadership(target.node_id)
             assert ok
@@ -340,7 +359,7 @@ def test_membership_change_add_node(tmp_path):
             initial = [fx.nodes[i].vnode for i in range(3)]
             for node in fx.nodes[:3]:
                 await node.gm.create_group(GROUP, NTP_, initial)
-            leader = (await fx.wait_for_leader()).consensus()
+            leader = (await fx.wait_for_stable_leader()).consensus()
             await leader.replicate([data_batch(b"before")])
             # node 3 starts empty with the group (learner-style bootstrap)
             await fx.nodes[3].gm.create_group(GROUP, NTP_, initial)
@@ -364,7 +383,7 @@ def test_snapshot_install_for_lagging_follower(tmp_path):
     async def main():
         fx = await RaftGroupFixture(tmp_path, 3).start()
         try:
-            leader_node = await fx.wait_for_leader()
+            leader_node = await fx.wait_for_stable_leader()
             leader = leader_node.consensus()
             victim = next(n for n in fx.nodes if n is not leader_node)
             vid, vdir = victim.node_id, victim.base_dir
@@ -408,7 +427,7 @@ def test_term_and_vote_persist_across_restart(tmp_path):
     async def main():
         fx = await RaftGroupFixture(tmp_path, 3).start()
         try:
-            leader = await fx.wait_for_leader()
+            leader = await fx.wait_for_stable_leader()
             term_before = leader.consensus().term
             await leader.consensus().replicate([data_batch(b"p")])
             nid, ndir = leader.node_id, leader.base_dir
@@ -451,7 +470,7 @@ def test_state_machine_apply_loop(tmp_path):
     async def main():
         fx = await RaftGroupFixture(tmp_path, 3).start()
         try:
-            leader = (await fx.wait_for_leader()).consensus()
+            leader = (await fx.wait_for_stable_leader()).consensus()
             stm = await CountingStm(leader).start()
             for i in range(3):
                 await leader.replicate([data_batch(b"e%d" % i)])

@@ -161,6 +161,110 @@ def bench_allocation(secs: float) -> dict:
     return {"allocator_assignments_per_s": round(_rate(alloc, secs, 16 * 6), 1)}
 
 
+def bench_tracer_overhead(secs: float) -> dict:
+    """Disabled-tracer cost on a produce-hot-path-shaped op.
+
+    Baseline = batch build+encode (the codec work every produce pays);
+    traced = the same op under a DISABLED ``tracer.span(...)`` — the
+    exact no-op the instrumented produce path executes when tracing is
+    off. The always-on probe layer (a perf_counter pair + histogram
+    record, the reference's probe.h cost) is measured and reported
+    SEPARATELY (``probe_cost_ns``): it is a deliberate steady cost, not
+    part of the disabled-tracer budget.
+
+    The headline ``tracer_disabled_overhead_pct`` is DERIVED: (min-based
+    per-call cost of the disabled span alone) / (min-based per-op cost of
+    the payload). The span is strictly additive straight-line code, so
+    the quotient IS its share of the hot path — and both measurements use
+    timeit's min-of-many-blocks posture, which resolves nanoseconds
+    reliably. The direct A/B wall-clock ratio is reported too
+    (``tracer_ab_overhead_pct``) but is informational only: its
+    shared-machine noise floor (~5-10%) sits far above the sub-1% signal,
+    as an A/A control run demonstrates. The acceptance bar (<2%) is
+    asserted by --assert-tracer-overhead, not here."""
+    from redpanda_tpu.observability import tracer
+
+    was_enabled = tracer.enabled
+    tracer.configure(enabled=False)
+    try:
+        return _bench_tracer_overhead_disabled(secs)
+    finally:
+        # the process-wide tracer must come back even if the bench raises
+        tracer.configure(enabled=was_enabled)
+
+
+def _bench_tracer_overhead_disabled(secs: float) -> dict:
+    from redpanda_tpu.models.record import Record, RecordBatch
+    from redpanda_tpu.observability import probes, tracer
+
+    recs = [Record(offset_delta=i, value=b"x" * 256) for i in range(32)]
+
+    def op():
+        RecordBatch.build(recs, base_offset=0).encode_internal()
+
+    # scratch histogram, NOT a registered series: the probe-cost loop below
+    # records thousands of synthetic samples, which must never leak into
+    # the live registry a --metrics-snapshot run is diffing
+    from redpanda_tpu.metrics import Histogram
+
+    hist = Histogram("bench_scratch_us", "unregistered bench scratch")
+
+    def traced_op():
+        with tracer.span("bench.produce"):
+            op()
+
+    def timed_block(fn, k: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(k):
+            fn()
+        return time.perf_counter() - t0
+
+    # warmup + block sizing: many short rounds inside the time budget
+    op()
+    traced_op()
+    per_op = min(timed_block(op, 4) / 4 for _ in range(3))
+    # ~3 ms blocks: short enough that plenty of rounds dodge load spikes
+    # entirely, long enough to amortize the timer reads
+    k = max(4, int(0.003 / per_op))
+    rounds = max(24, int(secs * 2 / (2 * k * per_op)))
+    best_base = float("inf")
+    best_traced = float("inf")
+    n_done = 0
+    for r in range(rounds):
+        if r % 2 == 0:
+            tb, tt = timed_block(op, k), timed_block(traced_op, k)
+        else:
+            tt, tb = timed_block(traced_op, k), timed_block(op, k)
+        best_base = min(best_base, tb / k)
+        best_traced = min(best_traced, tt / k)
+        n_done += 2 * k
+    # per-call cost of the disabled span alone, then of one probe
+    # histogram observation — same min-of-blocks discipline
+    span_ns = float("inf")
+    probe_ns = float("inf")
+    for _ in range(10):
+        n_raw = 2000
+        t0 = time.perf_counter()
+        for _ in range(n_raw):
+            with tracer.span("bench.noop"):
+                pass
+        span_ns = min(span_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+        t0 = time.perf_counter()
+        for _ in range(n_raw):
+            probes.observe_us(hist, t0)
+        probe_ns = min(probe_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+    ab_pct = (best_traced / best_base - 1.0) * 100.0 if best_base else 0.0
+    overhead_pct = span_ns / (best_base * 1e9) * 100.0 if best_base else 0.0
+    return {
+        "tracer_block_ops": n_done,
+        "tracer_span_cost_ns": round(span_ns, 1),
+        "probe_cost_ns": round(probe_ns, 1),
+        "tracer_op_cost_ns": round(best_base * 1e9, 1),
+        "tracer_ab_overhead_pct": round(max(ab_pct, 0.0), 2),
+        "tracer_disabled_overhead_pct": round(overhead_pct, 2),
+    }
+
+
 def bench_rpc_echo(secs: float) -> dict:
     """Loopback RPC round trips (rpc_bench shape) over the real stack."""
     from redpanda_tpu import rpc
@@ -207,6 +311,7 @@ BENCHES = {
     "compaction_index": bench_compaction_index,
     "allocation": bench_allocation,
     "rpc_echo": bench_rpc_echo,
+    "tracer_overhead": bench_tracer_overhead,
 }
 
 
@@ -214,15 +319,51 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--secs", type=float, default=0.5, help="time budget per bench")
     p.add_argument("--only", help="comma-separated bench names")
+    p.add_argument(
+        "--metrics-snapshot",
+        help="write {before, after} registry snapshots to this JSON file, so "
+        "a bench run can be diffed against the probe counters it moved",
+    )
+    p.add_argument(
+        "--assert-tracer-overhead",
+        type=float,
+        metavar="PCT",
+        help="fail (exit 1) if the disabled-tracer overhead exceeds PCT "
+        "percent; implies the tracer_overhead bench",
+    )
     args = p.parse_args(argv)
     names = [n.strip() for n in args.only.split(",")] if args.only else list(BENCHES)
     unknown = [n for n in names if n not in BENCHES]
     if unknown:
         p.error(f"unknown bench(es) {unknown}; choose from {sorted(BENCHES)}")
+    if args.assert_tracer_overhead is not None and "tracer_overhead" not in names:
+        names.append("tracer_overhead")
+    snap_before = None
+    if args.metrics_snapshot:
+        from redpanda_tpu.metrics import registry
+
+        snap_before = registry.snapshot()
     out: dict[str, float] = {}
     for name in names:
         out.update(BENCHES[name](args.secs))
+    if args.metrics_snapshot:
+        from redpanda_tpu.metrics import registry
+
+        with open(args.metrics_snapshot, "w") as f:
+            json.dump(
+                {"before": snap_before, "after": registry.snapshot()},
+                f, indent=2, sort_keys=True,
+            )
     print(json.dumps(out))
+    if args.assert_tracer_overhead is not None:
+        pct = out.get("tracer_disabled_overhead_pct", 0.0)
+        if pct > args.assert_tracer_overhead:
+            print(
+                f"tracer overhead {pct}% exceeds budget "
+                f"{args.assert_tracer_overhead}%",
+                file=sys.stderr,
+            )
+            return 1
     return 0
 
 

@@ -11,6 +11,7 @@ from tools.pandalint.checkers.hotpath import (
 )
 from tools.pandalint.checkers.tasks import TaskHygieneChecker
 from tools.pandalint.checkers.iobuf import IobufCopyChecker
+from tools.pandalint.checkers.enginesync import EngineSyncChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     ReactorChecker,
@@ -19,6 +20,7 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     HotPathControlChecker,
     TaskHygieneChecker,
     IobufCopyChecker,
+    EngineSyncChecker,
 )
 
 

@@ -30,6 +30,10 @@ DEFAULT_SCOPES: dict[str, tuple[str, ...]] = {
     "hotpath-control": (),
     "task-hygiene": (),
     "iobuf-copy": (),
+    # Engine-loop host-sync rules reason about the coproc data path's
+    # async-dispatch contract; np.asarray on host data is perfectly normal
+    # elsewhere in the package, so this checker does NOT run package-wide.
+    "engine-sync": ("redpanda_tpu/coproc",),
 }
 
 DEFAULT_PACKAGE_ROOT = "redpanda_tpu"

@@ -1,0 +1,146 @@
+"""Render pandaprobe trace dumps as per-stage tables + text flamegraphs.
+
+Input is the JSON shape ``GET /v1/trace/recent`` returns (or the bare list
+of traces inside it): each trace is ``{"trace_id": n, "wall_us": n,
+"spans": [{"name", "start_us", "dur_us", "thread", ...extras}]}``.
+
+Usage:
+    python tools/traceview.py dump.json          # from a saved dump
+    rpk debug trace | ...                        # rpk renders via this module
+    curl -s :9644/v1/trace/recent | python tools/traceview.py -
+
+Two views per run:
+  * a per-stage breakdown across all traces (count / total / mean / max /
+    share of traced wall time) — the "where does the time go" table the
+    BASELINE perf work needs;
+  * a flamegraph-style tree per trace, spans indented by containment, with
+    proportional bars — the "what happened to THIS batch" view.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_BAR_WIDTH = 24
+_EXTRA_KEYS_SKIP = {"trace_id", "name", "start_us", "dur_us", "thread"}
+
+
+def _fmt_us(us: float) -> str:
+    if us >= 1_000_000:
+        return f"{us / 1e6:.2f}s"
+    if us >= 1_000:
+        return f"{us / 1e3:.2f}ms"
+    return f"{int(us)}us"
+
+
+def _extras(span: dict) -> str:
+    kv = {k: v for k, v in span.items() if k not in _EXTRA_KEYS_SKIP}
+    return " ".join(f"{k}={v}" for k, v in sorted(kv.items()))
+
+
+def stage_breakdown(traces: list[dict]) -> str:
+    """Aggregate per-stage table over every span of every trace."""
+    agg: dict[str, list[int]] = {}  # name -> [count, total_us, max_us]
+    wall = 0
+    for t in traces:
+        wall += t.get("wall_us", 0)
+        for s in t.get("spans", []):
+            row = agg.setdefault(s["name"], [0, 0, 0])
+            row[0] += 1
+            row[1] += s["dur_us"]
+            row[2] = max(row[2], s["dur_us"])
+    if not agg:
+        return "(no spans)"
+    name_w = max(len(n) for n in agg) + 2
+    lines = [
+        f"{'stage':<{name_w}}{'count':>7}{'total':>11}{'mean':>11}"
+        f"{'max':>11}{'share':>8}"
+    ]
+    total_all = sum(r[1] for r in agg.values())
+    for name, (count, total, mx) in sorted(
+        agg.items(), key=lambda kv: -kv[1][1]
+    ):
+        share = 100.0 * total / total_all if total_all else 0.0
+        lines.append(
+            f"{name:<{name_w}}{count:>7}{_fmt_us(total):>11}"
+            f"{_fmt_us(total / count):>11}{_fmt_us(mx):>11}{share:>7.1f}%"
+        )
+    lines.append(
+        f"{len(traces)} trace(s), {sum(r[0] for r in agg.values())} span(s), "
+        f"{_fmt_us(wall)} traced wall time"
+    )
+    return "\n".join(lines)
+
+
+def render_trace(trace: dict) -> str:
+    """One trace as an indentation flamegraph: a span nests under the
+    nearest earlier span whose [start, end) interval contains it."""
+    spans = sorted(
+        trace.get("spans", []), key=lambda s: (s["start_us"], -s["dur_us"])
+    )
+    if not spans:
+        return f"trace {trace.get('trace_id', '?')}: (empty)"
+    t0 = min(s["start_us"] for s in spans)
+    wall = max(1, trace.get("wall_us") or 1)
+    lines = [f"trace {trace.get('trace_id', '?')}  wall={_fmt_us(wall)}"]
+    stack: list[tuple[int, int]] = []  # (end_us, depth)
+    name_w = max(len(s["name"]) for s in spans) + 2
+    for s in spans:
+        start, end = s["start_us"], s["start_us"] + s["dur_us"]
+        while stack and start >= stack[-1][0]:
+            stack.pop()
+        depth = stack[-1][1] + 1 if stack else 0
+        stack.append((end, depth))
+        bar_n = max(1, round(_BAR_WIDTH * s["dur_us"] / wall))
+        pad = "  " * depth
+        extras = _extras(s)
+        lines.append(
+            f"  {pad}{s['name']:<{max(1, name_w - len(pad))}}"
+            f"{_fmt_us(s['dur_us']):>10}  +{_fmt_us(start - t0):<9}"
+            f"{'#' * bar_n:<{_BAR_WIDTH}} {s['thread']}"
+            + (f"  [{extras}]" if extras else "")
+        )
+    return "\n".join(lines)
+
+
+def _coerce_traces(doc) -> list[dict]:
+    if isinstance(doc, dict):
+        doc = doc.get("traces", [])
+    if not isinstance(doc, list):
+        raise ValueError("expected a trace list or a /v1/trace/recent object")
+    return doc
+
+
+def render_report(doc, max_traces: int = 10) -> str:
+    """Breakdown table + per-trace flamegraphs for a dump document."""
+    traces = _coerce_traces(doc)
+    parts = [stage_breakdown(traces)]
+    for t in traces[:max_traces]:
+        parts.append("")
+        parts.append(render_trace(t))
+    if len(traces) > max_traces:
+        parts.append(f"... {len(traces) - max_traces} more trace(s) not shown")
+    return "\n".join(parts)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("path", help="trace dump JSON file, or - for stdin")
+    p.add_argument(
+        "--max-traces", type=int, default=10, help="flamegraphs to render"
+    )
+    args = p.parse_args(argv)
+    try:
+        raw = sys.stdin.read() if args.path == "-" else open(args.path).read()
+        doc = json.loads(raw)
+        print(render_report(doc, max_traces=args.max_traces))
+    except (OSError, ValueError) as e:
+        print(f"traceview: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
