@@ -54,6 +54,10 @@ DEPTH = int(os.environ.get("BENCH_DEPTH", "3"))  # launch groups in flight
 # ~0.27s run and understate the sustained rate by ~40%
 MEASURE_TICKS = int(os.environ.get("BENCH_TICKS", "160"))
 BASELINE_TICKS = int(os.environ.get("BENCH_BASELINE_TICKS", "4"))
+# Host-stage pool size for the headline runs (coproc/host_pool.py). The
+# workers=1 ablation rides in the same JSON so every BENCH artifact proves
+# the pool-off path did not regress.
+HOST_WORKERS = int(os.environ.get("BENCH_HOST_WORKERS", "4"))
 
 
 def _probe_tpu(timeout_s: int = 150) -> bool:
@@ -138,14 +142,34 @@ def _run_engine_stream(engine, req, n_ticks, group, depth) -> float:
     return n_ticks * n_batches / elapsed
 
 
-def _run_engine_mode(req, force_mode: str | None) -> tuple[float, dict]:
+def _fmt_stages(stats: dict) -> dict:
+    """Stage keys only (the t_/n_/bytes_ prefixes stats() documents):
+    probe records and numeric metadata like host_workers are reported at
+    the top level instead, so the per-stage tables stay diffable across
+    BENCH artifacts."""
+    out = {}
+    for k, v in sorted(stats.items()):
+        if k.startswith(("t_", "n_", "bytes_")):
+            out[k] = round(v, 4) if k.startswith("t_") else int(v)
+    return out
+
+
+def _run_engine_mode(
+    req, force_mode: str | None, host_workers: int = HOST_WORKERS
+) -> tuple[float, dict, list | None, dict]:
     """One measured engine run. force_mode None = the PRODUCT path (the
     engine's own measured device-vs-host probe picks where the predicate
     runs); "columnar_device"/"columnar_host" pin each half so every BENCH
-    carries the full ablation regardless of what the probe chose."""
+    carries the full ablation regardless of what the probe chose.
+    host_workers sizes the host-stage shard pool (1 = inline ablation).
+    Returns (rate, stage dict, per-shard stage splits of the last launch,
+    probe record) — the probe entries ride on engine.stats() since the
+    reset hook landed, so bench no longer reaches into class attributes."""
     from redpanda_tpu.coproc import TpuEngine
 
-    engine = TpuEngine(row_stride=ROW_STRIDE, force_mode=force_mode)
+    engine = TpuEngine(
+        row_stride=ROW_STRIDE, force_mode=force_mode, host_workers=host_workers
+    )
     codes = engine.enable_coprocessors([(1, _spec().to_json(), ("bench",))])
     assert codes[0] == 0
     # warmup: compile the GROUP-sized shape and, when MEASURE_TICKS is not a
@@ -155,18 +179,28 @@ def _run_engine_mode(req, force_mode: str | None) -> tuple[float, dict]:
     _run_engine_stream(engine, req, GROUP + (tail or min(GROUP, MEASURE_TICKS)), GROUP, DEPTH)
     engine.reset_stats()
     rate = _run_engine_stream(engine, req, MEASURE_TICKS, GROUP, DEPTH)
-    stages = {
-        k: (round(v, 4) if k.startswith("t_") else int(v))
-        for k, v in sorted(engine.stats().items())
+    stats = engine.stats()
+    probe = {
+        "columnar_backend": stats.get("columnar_backend"),
+        "columnar_probe": stats.get("columnar_probe"),
+        "host_pool_probe": stats.get("host_pool_probe"),
     }
-    return rate, stages
+    return rate, _fmt_stages(stats), engine.last_launch_shards, probe
 
 
 def run_cpu_baseline(req) -> float:
     """Single-core host engine: per-record decode + json.loads + predicate +
     rebuild + re-CRC (the work profile of the reference's JS supervisor)."""
+    from redpanda_tpu.compression import is_available
     from redpanda_tpu.models import Record, RecordBatch
     from redpanda_tpu.models.record import Compression
+
+    # same degrade-don't-fail posture as the engine's output recompressor
+    # (batch_codec.build_output_batch): without the zstandard package both
+    # sides of the comparison compress with gzip, keeping vs_baseline fair
+    out_codec = (
+        Compression.zstd if is_available(Compression.zstd) else Compression.gzip
+    )
 
     def tick():
         n_batches = 0
@@ -190,7 +224,7 @@ def run_cpu_baseline(req) -> float:
                     out = RecordBatch.build(
                         recs,
                         base_offset=0,
-                        compression=Compression.zstd,
+                        compression=out_codec,
                         first_timestamp=batch.header.first_timestamp,
                     )
                     assert out.header.crc
@@ -312,14 +346,21 @@ def main():
     if not tpu_ok:
         _pin_cpu()
     req = _build_workload()
-    value, stages = _run_engine_mode(req, None)  # product path: probed pick
-    dev_rate, dev_stages = _run_engine_mode(req, "columnar_device")
-    host_col_rate, host_col_stages = _run_engine_mode(req, "columnar_host")
-    baseline = run_cpu_baseline(req)
     from redpanda_tpu.coproc import TpuEngine
 
-    columnar_probe = TpuEngine._columnar_probe
-    columnar_backend = TpuEngine._columnar_backend
+    value, stages, shard_stages, probe = _run_engine_mode(req, None)  # product
+    dev_rate, dev_stages, _, _ = _run_engine_mode(req, "columnar_device")
+    host_col_rate, host_col_stages, _, _ = _run_engine_mode(req, "columnar_host")
+    # pool-off ablation: the acceptance bar is "no regression when the pool
+    # is off", so the same product path runs again with ONE worker (inline).
+    # Reset the sticky backend probe first — the ablation engine must
+    # re-measure device-vs-host itself, not inherit the headline's pick.
+    TpuEngine.reset_columnar_probe()
+    w1_rate, w1_stages, _, w1_probe = _run_engine_mode(req, None, host_workers=1)
+    baseline = run_cpu_baseline(req)
+
+    columnar_probe = probe["columnar_probe"]
+    columnar_backend = probe["columnar_backend"]
     import jax
 
     extras = {}
@@ -363,6 +404,24 @@ def main():
                 "group_ticks_per_launch": GROUP,
                 "launch_depth": DEPTH,
                 "engine_mode": "columnar",
+                # host-stage shard pool (coproc/host_pool.py): headline pool
+                # size, the per-shard stage splits of the last launch, and
+                # the workers=1 inline ablation proving the pool-off path
+                # holds the pre-pool rate
+                "host_workers": HOST_WORKERS,
+                # the engine's one-shot parallel-capacity probe: when
+                # parallel_ok is false this box has no real concurrency
+                # (advertised CPUs backed by ~1 core of quota) and the
+                # pool self-demoted to the inline path for the headline
+                "host_pool_probe": probe["host_pool_probe"],
+                "shard_stages": shard_stages,
+                "host_workers1_ablation": {
+                    "record_batches_per_sec": round(w1_rate, 1),
+                    "stages": w1_stages,
+                    # re-probed after reset_columnar_probe(): proves the
+                    # ablation measured its own backend pick
+                    "columnar_backend": w1_probe["columnar_backend"],
+                },
                 # where the predicate ran in the headline: the engine's own
                 # measured probe decides (device vs numpy over the SAME
                 # extracted columns) — probe timings on record

@@ -387,6 +387,15 @@ class Application:
         registry.gauge(
             "readers_cache_misses", lambda: rc.misses, "Read cursor misses"
         )
+        if self.coproc is not None:
+            eng = self.coproc.engine
+            # pool size is static per process; the busy-worker gauge
+            # (coproc_host_pool_busy_workers) lives in observability.probes
+            registry.gauge(
+                "coproc_host_workers",
+                lambda: float(eng._host_workers),
+                "Configured host-stage worker pool size (0 = inline)",
+            )
         from redpanda_tpu.observability import tracer
 
         registry.gauge(

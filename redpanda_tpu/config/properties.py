@@ -8,6 +8,7 @@ property set), application.cc:312-362 (YAML hydration to every shard).
 from __future__ import annotations
 
 import json
+import os
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -130,6 +131,16 @@ PROPERTIES: list[Property] = [
     Property("coproc_max_batch_size", "Max read per ntp per tick", 32 * 1024, int, _positive),
     Property("coproc_max_inflight_bytes", "Read semaphore budget", 10 * 1024 * 1024, int, _positive),
     Property("coproc_offset_flush_interval_ms", "Offset snapshot cadence", 300_000, int, _positive),
+    Property(
+        "coproc_host_workers",
+        "Host-stage worker pool size for the transform engine (0 = inline single-thread path)",
+        min(4, os.cpu_count() or 1), int, _non_negative,
+    ),
+    Property(
+        "coproc_host_pool_probe",
+        "Measure real parallel capacity before sharding host stages (quota-limited boxes advertise CPUs they don't have); false trusts coproc_host_workers as-is",
+        True, bool,
+    ),
     # --- tiered storage (cloud_storage_* group)
     Property("cloud_storage_enabled", "Enable tiered storage", False, bool),
     Property("cloud_storage_bucket", "S3 bucket", ""),

@@ -36,7 +36,12 @@ class CoprocApi:
         max_batch = _knob("coproc_max_batch_size", 32 * 1024)
         inflight_bytes = _knob("coproc_max_inflight_bytes", 10 * 1024 * 1024)
         flush_ms = _knob("coproc_offset_flush_interval_ms", 300_000)
-        self.engine = TpuEngine()
+        # None -> the engine resolves min(4, cores); the property default
+        # matches, so an unset config and a default config agree
+        self.engine = TpuEngine(
+            host_workers=_knob("coproc_host_workers", None),
+            host_pool_probe=_knob("coproc_host_pool_probe", True),
+        )
         self.pacemaker = Pacemaker(
             broker, self.engine,
             max_batch_size=max_batch,

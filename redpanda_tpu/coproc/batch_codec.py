@@ -82,6 +82,36 @@ def explode_and_find(batches: list[RecordBatch], paths: list[str]):
     return ex, types, vs, ve
 
 
+def merge_exploded(parts: list[ExplodedBatches]) -> ExplodedBatches:
+    """Concatenate per-shard explode results into one launch-wide table.
+
+    Shards are contiguous batch slices in input order (host_pool
+    .partition_counts), so the merge is pure concatenation with rebasing:
+    value offsets shift by the preceding shards' joined length, per-batch
+    record ranges by their record count. The result is byte- and
+    index-identical to exploding the whole batch list inline — the
+    downstream stages (_pack_staged, _mat_host, frame_ranges) cannot tell
+    the difference, which is what the workers=0 parity tests assert.
+    """
+    if len(parts) == 1:
+        return parts[0]
+    if not parts:
+        return ExplodedBatches(b"", np.zeros(0, np.int64), np.zeros(0, np.int32), [])
+    joined = b"".join(p.joined for p in parts)
+    offs, sizes, ranges = [], [], []
+    byte_base = 0
+    rec_base = 0
+    for p in parts:
+        offs.append(p.offsets + byte_base)
+        sizes.append(p.sizes)
+        ranges.extend((s + rec_base, e + rec_base) for s, e in p.ranges)
+        byte_base += len(p.joined)
+        rec_base += len(p.sizes)
+    return ExplodedBatches(
+        joined, np.concatenate(offs), np.concatenate(sizes), ranges
+    )
+
+
 def explode_batches(batches: list[RecordBatch]) -> ExplodedBatches:
     lib = _native()
     payloads, counts, p_off, p_len, ranges, joined, n = _gather_payloads(batches)
@@ -170,8 +200,13 @@ def frame_ranges(
     crossing (rp_frame_many): [(payload, kept)] per range. The per-batch
     ctypes call overhead dominated rebuild at 32-record batches; this is
     the same loop, moved below the language boundary."""
+    if not ranges:
+        # explicit on BOTH paths: the native branch previously fell through
+        # to the Python list comprehension when ranges was empty, silently
+        # taking the fallback path despite has_frame_many being true
+        return []
     lib = _native()
-    if lib is not None and getattr(lib, "has_frame_many", False) and ranges:
+    if lib is not None and getattr(lib, "has_frame_many", False):
         starts = np.fromiter((s for s, _ in ranges), np.int64, len(ranges))
         ends = np.fromiter((e for _, e in ranges), np.int64, len(ranges))
         dst, off, ln, kept = lib.frame_many(rows, lens, keep, starts, ends)

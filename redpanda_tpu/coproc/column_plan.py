@@ -29,6 +29,7 @@ axis), and `assemble_rows` materializes projection outputs.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
@@ -115,6 +116,10 @@ class ColumnarPlan:
     r_out: int
     passthrough: bool  # no projection: output = input value bytes
     _fn_cache: dict = dc_field(default_factory=dict)
+    # compile_device may be reached from host-pool shard workers and
+    # concurrent submitters; first-touch jit is seconds, so a racy
+    # check-then-compile would trace the same predicate N times
+    _fn_lock: threading.Lock = dc_field(default_factory=threading.Lock)
 
     mode = "columnar"
 
@@ -174,8 +179,16 @@ class ColumnarPlan:
         exists -> (u8 [n]). Rows shard over `mesh`'s 'p' axis when given.
         """
         key = id(mesh) if mesh is not None else None
-        if key in self._fn_cache:
-            return self._fn_cache[key]
+        fn = self._fn_cache.get(key)
+        if fn is not None:
+            return fn
+        with self._fn_lock:
+            fn = self._fn_cache.get(key)
+            if fn is not None:
+                return fn
+            return self._compile_device_locked(key, mesh)
+
+    def _compile_device_locked(self, key, mesh):
         import jax
         import jax.numpy as jnp
 

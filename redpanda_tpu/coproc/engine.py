@@ -58,7 +58,7 @@ from redpanda_tpu.ops.pipeline import IN_META, make_packed_pipeline, unpack_resu
 
 logger = logging.getLogger("rptpu.coproc.engine")
 from redpanda_tpu.ops.transforms import TransformSpec
-from redpanda_tpu.coproc import batch_codec
+from redpanda_tpu.coproc import batch_codec, host_pool
 from redpanda_tpu.coproc.column_plan import ColumnarPlan, HostPlan, PayloadPlan, plan_spec
 
 
@@ -127,6 +127,47 @@ def _bucket_rows(n: int) -> int:
     return b
 
 
+class _MaskSlot:
+    """One shard's predicate mask in flight (host-evaluated or device).
+
+    Field names deliberately mirror _Launch's mask fields
+    (``_mask_dev``/``_mask_np``/``_mask_event``/``trace_id``/``_enq_t``):
+    the harvester loop serves either shape without caring which it got.
+    """
+
+    __slots__ = ("n", "_mask_dev", "_mask_np", "_mask_event",
+                 "trace_id", "_enq_t")
+
+    def __init__(self, n: int):
+        self.n = n
+        self._mask_dev = None
+        self._mask_np = None
+        self._mask_event: threading.Event | None = None
+        self.trace_id: int | None = None
+        self._enq_t = 0.0
+
+
+class _HostShard:
+    """One contiguous record-range shard of a launch's host stages.
+
+    Everything here is produced by exactly one pool worker and read only
+    after the fan-in barrier (pool.run returns) — shard workers never
+    touch each other's state (pandalint SHD6xx enforces the discipline).
+    """
+
+    __slots__ = ("n", "ranges", "exploded", "proj_data", "proj_ok",
+                 "mask", "stages")
+
+    def __init__(self):
+        self.n = 0
+        self.ranges: list[tuple[int, int]] = []
+        self.exploded = None
+        self.proj_data = None
+        self.proj_ok = None
+        self.mask: _MaskSlot | None = None
+        self.stages: dict[str, float] = {}
+
+
 class _Launch:
     """One device launch for one script, possibly spanning many requests.
 
@@ -138,12 +179,17 @@ class _Launch:
       host-assembled projection columns (or packed input values for
       passthrough specs).
     - host: computed synchronously from the exploded inputs at harvest.
+
+    When the engine's host-stage pool sharded the launch (``_shards`` set),
+    the columnar harvest side assembles and frames per shard instead of
+    launch-wide; the framed list is the in-order concatenation of the
+    shards' framed lists, byte-identical to the inline path.
     """
 
     __slots__ = ("script_id", "policy", "mode", "r_out", "ranges", "fits",
                  "engine", "n", "_packed_dev", "_mask_dev", "_mask_np",
                  "_mask_event", "_proj_data", "_proj_ok", "_plan",
-                 "_exploded", "_mat", "_framed", "_lock",
+                 "_exploded", "_mat", "_framed", "_lock", "_shards",
                  "trace_id", "_enq_t")
 
     def __init__(self, script_id: int, policy: ErrorPolicy):
@@ -168,6 +214,7 @@ class _Launch:
         self._mat = None
         self._framed = None
         self._lock = threading.Lock()
+        self._shards: list[_HostShard] | None = None
 
 
     def _mat_payload(self):
@@ -185,6 +232,35 @@ class _Launch:
         n = len(self.fits)
         return out[:n], out_len[:n], keep[:n] & self.fits
 
+    def _resolve_keep(self, slot, n: int) -> np.ndarray:
+        """Resolve a keep mask from a mask holder — the launch itself or a
+        per-shard _MaskSlot (same field shape by design): no predicate,
+        host-evaluated bits, or device fetch via the async-harvest event.
+        The D2H discipline is subtle, so exactly ONE copy of it exists."""
+        if slot._mask_dev is None and slot._mask_np is None:
+            return np.ones(n, dtype=bool)  # no predicate: keep all present
+        if slot._mask_dev is None:
+            # host-evaluated mask (columnar_host ablation): already on host
+            keep = np.unpackbits(slot._mask_np)[:n].astype(bool)
+            slot._mask_np = None
+            return keep
+        t0 = time.perf_counter()
+        if slot._mask_event is not None:
+            # harvester thread pays the link round trip concurrently
+            # with the caller's host work; worst case we fetch ourselves.
+            # Keep OUR fetch in a local — the harvester may still write
+            # _mask_np (even None, on its own failure) after a timeout.
+            slot._mask_event.wait(timeout=30.0)
+            bits = slot._mask_np
+            if bits is None:
+                bits = np.asarray(slot._mask_dev)
+        else:
+            bits = np.asarray(slot._mask_dev)
+        self._stat("t_fetch", t0)
+        slot._mask_dev = None
+        slot._mask_np = None
+        return np.unpackbits(bits)[:n].astype(bool)
+
     def _mat_columnar(self):
         n = self.n
         if n == 0:
@@ -193,29 +269,7 @@ class _Launch:
                 np.zeros(0, np.int32),
                 np.zeros(0, bool),
             )
-        if self._mask_dev is None and self._mask_np is None:
-            keep = np.ones(n, dtype=bool)  # no predicate: keep all present
-        elif self._mask_dev is None:
-            # host-evaluated mask (columnar_host ablation): already on host
-            keep = np.unpackbits(self._mask_np)[:n].astype(bool)
-            self._mask_np = None
-        else:
-            t0 = time.perf_counter()
-            if self._mask_event is not None:
-                # harvester thread pays the link round trip concurrently
-                # with the caller's host work; worst case we fetch ourselves.
-                # Keep OUR fetch in a local — the harvester may still write
-                # _mask_np (even None, on its own failure) after a timeout.
-                self._mask_event.wait(timeout=30.0)
-                bits = self._mask_np
-                if bits is None:
-                    bits = np.asarray(self._mask_dev)
-            else:
-                bits = np.asarray(self._mask_dev)
-            self._stat("t_fetch", t0)
-            self._mask_dev = None
-            self._mask_np = None
-            keep = np.unpackbits(bits)[:n].astype(bool)
+        keep = self._resolve_keep(self, n)
         keep &= self._proj_ok
         t0 = time.perf_counter()
         plan: ColumnarPlan = self._plan
@@ -284,13 +338,67 @@ class _Launch:
         threads (the pacemaker harvests via run_in_executor)."""
         with self._lock:
             if self._framed is None:
-                out, out_len, keep = self._materialize_locked()
-                t0 = time.perf_counter()
-                self._framed = batch_codec.frame_ranges(
-                    out, out_len, keep, self.ranges
-                )
-                self._stat("t_rebuild", t0)
+                if self._shards is not None:
+                    self._framed = self._framed_sharded()
+                else:
+                    out, out_len, keep = self._materialize_locked()
+                    t0 = time.perf_counter()
+                    self._framed = batch_codec.frame_ranges(
+                        out, out_len, keep, self.ranges
+                    )
+                    self._stat("t_rebuild", t0)
             return self._framed
+
+    def _shard_keep(self, shard: _HostShard) -> np.ndarray:
+        """Resolve one shard's keep mask via the shared _resolve_keep."""
+        if shard.n == 0:
+            return np.zeros(0, dtype=bool)
+        if shard.mask is None:
+            return np.ones(shard.n, dtype=bool) & shard.proj_ok
+        return self._resolve_keep(shard.mask, shard.n) & shard.proj_ok
+
+    def _frame_shard(self, shard: _HostShard, keep: np.ndarray):
+        """Assemble + frame ONE shard's record range (pool worker body —
+        touches only its own shard, see SHD6xx)."""
+        plan: ColumnarPlan = self._plan
+        t0 = time.perf_counter()
+        if shard.n == 0:
+            rows = np.zeros((0, max(self.r_out, 1)), np.uint8)
+            lens = np.zeros(0, np.int32)
+        elif plan.passthrough:
+            ex = shard.exploded
+            stride = max(int(ex.sizes.max()), 1)
+            rows, lens = _pack_values(ex, stride)
+        else:
+            rows, lens = plan.assemble_rows(shard.proj_data, shard.n)
+        # t_shard_* keys: concurrent per-shard CPU-seconds, kept apart from
+        # the launch-wall t_assemble/t_rebuild of the inline path (the
+        # fan-out's wall time is t_sharded_frame)
+        self._stat("t_shard_assemble", t0)
+        t0 = time.perf_counter()
+        framed = batch_codec.frame_ranges(rows, lens, keep, shard.ranges)
+        self._stat("t_shard_rebuild", t0)
+        return framed
+
+    def _framed_sharded(self) -> list[tuple[bytes, int]]:
+        """Sharded harvest: per-shard masks resolved in shard order, then
+        assembly + framing fan out over the host pool; the concatenated
+        framed lists are byte-identical to the launch-wide path because
+        shards are contiguous record ranges in input order."""
+        shards = self._shards
+        keeps = [self._shard_keep(shard) for shard in shards]
+        thunks = [
+            (lambda s=shard, k=keep: self._frame_shard(s, k))
+            for shard, keep in zip(shards, keeps)
+        ]
+        pool = self.engine._host_pool if self.engine is not None else None
+        t0 = time.perf_counter()
+        parts = pool.run(thunks) if pool is not None else [t() for t in thunks]
+        self._stat("t_sharded_frame", t0)
+        for shard in shards:
+            shard.proj_data = None
+            shard.exploded = None
+        return [item for part in parts for item in part]
 
     def _materialize_locked(self):
         """(out, out_len, keep) host arrays; fetch happens at most once.
@@ -334,6 +442,11 @@ def _pack_values(ex, stride: int):
 
 # Per-slot dispositions inside a Ticket.
 _UNKNOWN, _EMPTY, _DEREGISTERED, _LAUNCHED = range(4)
+
+# Sharding threshold: below this many records the pool's fan-out/merge
+# overhead (thread handoff, per-shard native-call fixed costs) eats the
+# win, so small launches keep the inline path.
+_SHARD_MIN_ROWS = 2048
 
 # Columnar backend probe: don't pin the process-wide device-vs-host choice
 # on a batch too small to represent steady state, and bound the device leg
@@ -453,6 +566,8 @@ class TpuEngine:
         output_codec: Compression = Compression.zstd,
         mesh=None,
         force_mode: str | None = None,
+        host_workers: int | None = None,
+        host_pool_probe: bool = True,
     ):
         self._handles: dict[int, ScriptHandle] = {}
         self._row_stride = row_stride
@@ -460,6 +575,28 @@ class TpuEngine:
         self._output_codec = output_codec
         self._mesh = mesh
         self._force_mode = force_mode
+        # host-stage worker pool (coproc/host_pool.py): None = config
+        # default min(4, cores); 0 or 1 = the inline single-thread path
+        if host_workers is None:
+            host_workers = host_pool.default_host_workers()
+        self._host_workers = max(0, int(host_workers))
+        self._host_pool = (
+            host_pool.HostStagePool(self._host_workers)
+            if self._host_workers >= 2
+            else None
+        )
+        # Pool on/off is a MEASURED per-process decision, exactly like the
+        # columnar device-vs-host probe: the first shardable launch times
+        # its own explode stage inline vs sharded and pins the winner
+        # (quota-limited boxes advertise CPUs that thrash instead of
+        # scale). host_pool_probe=False pins "sharded" unmeasured — bench
+        # scaling runs and parity tests need the fan-out deterministically.
+        self._pool_decision: str | None = None if host_pool_probe else "sharded"
+        self._pool_decision_lock = threading.Lock()
+        self._host_pool_probe: dict | None = None
+        # per-shard stage splits of the most recent sharded launch (bench
+        # artifact + debugging aid; overwritten per launch under the lock)
+        self.last_launch_shards: list[dict] | None = None
         self._pipelines: dict[int, tuple] = {}  # payload: script_id -> (fn, r_out)
         self._plans: dict[int, object] = {}  # script_id -> execution plan
         self._stats: dict[str, float] = defaultdict(float)
@@ -621,9 +758,31 @@ class TpuEngine:
 
     # ------------------------------------------------------------ metrics
     def stats(self) -> dict:
-        """Accumulated per-stage wall seconds and link bytes."""
+        """Accumulated per-stage wall seconds and link bytes, plus the
+        pool size and (once probed) the columnar-backend probe record.
+        Numeric stage keys are floats; ``columnar_backend``/``columnar_probe``
+        are a string and a dict — consumers formatting stages should key on
+        the ``t_``/``n_``/``bytes_`` prefixes."""
         with self._stats_lock:
-            return dict(self._stats)
+            out = dict(self._stats)
+        out["host_workers"] = float(self._host_workers)
+        if self._host_pool_probe is not None:
+            out["host_pool_probe"] = dict(self._host_pool_probe)
+        if TpuEngine._columnar_probe is not None:
+            out["columnar_backend"] = TpuEngine._columnar_backend
+            out["columnar_probe"] = dict(TpuEngine._columnar_probe)
+        return out
+
+    @classmethod
+    def reset_columnar_probe(cls) -> None:
+        """Forget the process-wide columnar backend probe so the next
+        columnar launch re-probes. The probed pick is deliberately sticky
+        (link physics don't change per engine), but bench ablations and
+        tests that construct engines under a different ``force_mode`` or a
+        different link must be able to re-measure instead of inheriting a
+        stale decision."""
+        cls._columnar_backend = None
+        cls._columnar_probe = None
 
     def reset_stats(self) -> None:
         with self._stats_lock:
@@ -714,8 +873,10 @@ class TpuEngine:
         launch.engine = self
         launch.mode = plan.mode
         launch._plan = plan
-        t0 = time.perf_counter()
         all_batches = [b for _, _, item in entries for b in item.batches]
+        if self._dispatch_sharded(launch, plan, all_batches):
+            return
+        t0 = time.perf_counter()
         cache = None
         if plan.mode == "columnar":
             # FUSED fast path: framing parse + k-path JSON walk in one
@@ -746,6 +907,258 @@ class TpuEngine:
             self._dispatch_columnar(launch, plan, exploded, n, cache)
         else:  # host: materialized lazily at harvest
             launch._exploded = exploded
+
+    # ------------------------------------------------------ pool calibration
+    def _measure_pool_ratio(self, plan, all_batches, counts) -> tuple[float, float]:
+        """(t_inline, t_sharded) for this launch's REAL explode stage, each
+        best-of-2. Measuring the true workload, not a synthetic spin: on
+        burstable virtualized hosts a millisecond-scale synthetic probe can
+        show phantom 2-3x thread scaling while sustained parsing thrashes."""
+        pool = self._host_pool
+        parts = host_pool.partition_counts(counts, pool.workers)
+        paths = plan.flat_paths() if plan.mode == "columnar" else None
+
+        def explode(batches):
+            if paths:
+                got = batch_codec.explode_and_find(batches, paths)
+                if got is not None:
+                    return got
+            return batch_codec.explode_batches(batches)
+
+        t_inline = t_sharded = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            explode(all_batches)
+            t_inline = min(t_inline, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            pool.run([
+                (lambda s=s, e=e: explode(all_batches[s:e])) for s, e in parts
+            ])
+            t_sharded = min(t_sharded, time.perf_counter() - t0)
+        return t_inline, t_sharded
+
+    def _calibrate_host_pool(self, plan, all_batches, counts) -> None:
+        """One-shot, process-sticky pool on/off decision off the first
+        shardable launch (the same measure-first posture as
+        _probe_columnar_backend: never assume the cores are real). The
+        ~4 extra explode passes cost one launch a few ms, once."""
+        try:
+            t_inline, t_sharded = self._measure_pool_ratio(
+                plan, all_batches, counts
+            )
+        except Exception:
+            logger.exception("host pool calibration failed; keeping inline path")
+            self._pool_decision = "inline"
+        else:
+            ratio = t_inline / t_sharded if t_sharded > 0 else 0.0
+            self._pool_decision = (
+                "sharded" if ratio >= host_pool.PROBE_MARGIN else "inline"
+            )
+            self._host_pool_probe = {
+                "t_inline_ms": round(t_inline * 1e3, 3),
+                "t_sharded_ms": round(t_sharded * 1e3, 3),
+                "speedup": round(ratio, 3),
+                "workers": self._host_workers,
+                "chosen": self._pool_decision,
+            }
+            logger.info("host pool calibration: %s", self._host_pool_probe)
+        if self._pool_decision == "inline":
+            self._host_pool.shutdown()  # threads idle forever otherwise
+
+    # ------------------------------------------------------ sharded dispatch
+    def _dispatch_sharded(self, launch: _Launch, plan, all_batches) -> bool:
+        """Shard the launch's host stages over the worker pool.
+
+        Returns False when this launch should take the inline path: no
+        pool, too small, SPMD mesh, or a columnar plan whose device-vs-host
+        probe has not run yet (the first columnar launch probes inline and
+        pins the backend; every later launch shards).
+        """
+        pool = self._host_pool
+        if pool is None or len(all_batches) < 2:
+            return False
+        counts = [b.header.record_count for b in all_batches]
+        if sum(counts) < _SHARD_MIN_ROWS:
+            return False
+        parts = host_pool.partition_counts(counts, pool.workers)
+        if len(parts) < 2:
+            # skewed batches can collapse to a single shard; never CALIBRATE
+            # on such a launch either — a 1-thunk pool.run executes on the
+            # caller thread, so t_sharded ~= t_inline and the pool would be
+            # demoted process-wide off a meaningless measurement
+            return False
+        if self._pool_decision is None:
+            # double-checked: concurrent first submits (two script fibers
+            # on the coproc-tick executor) must not calibrate against each
+            # other's measurement load — the contention would depress the
+            # sharded ratio below PROBE_MARGIN on boxes where it truly wins
+            with self._pool_decision_lock:
+                if self._pool_decision is None:
+                    self._calibrate_host_pool(plan, all_batches, counts)
+        if self._pool_decision != "sharded":
+            return False  # calibration: no real win on this box
+        use_host = None
+        if plan.mode == "columnar" and plan.dev_cols:
+            if self._mesh is not None:
+                return False  # SPMD predicate stays one launch over the mesh
+            if self._force_mode == "columnar_host":
+                use_host = True
+            elif self._force_mode == "columnar_device":
+                use_host = False
+            elif TpuEngine._columnar_backend is not None:
+                use_host = TpuEngine._columnar_backend == "host"
+            else:
+                return False
+        if plan.mode == "columnar":
+            if use_host is False:
+                # compile in THIS thread before fan-out: plan._fn_cache is
+                # a plain dict and first-touch jit takes seconds — shard
+                # workers must find the function already cached
+                plan.compile_device(None)
+            paths = plan.flat_paths()
+            t0 = time.perf_counter()
+            shards = pool.run([
+                (
+                    lambda i=i, s=s, e=e: self._run_columnar_shard(
+                        i, launch, plan, all_batches[s:e], paths, use_host
+                    )
+                )
+                for i, (s, e) in enumerate(parts)
+            ])
+            self._stat_add("t_sharded_dispatch", time.perf_counter() - t0)
+            launch._shards = shards
+            launch.r_out = plan.r_out
+            n = 0
+            ranges: list[tuple[int, int]] = []
+            for shard in shards:
+                ranges.extend((a + n, b + n) for a, b in shard.ranges)
+                n += shard.n
+            launch.ranges = ranges
+            launch.n = n
+        else:
+            # payload/host plans: only explode is per-record host work at
+            # dispatch; shard it and merge back into one launch-wide table
+            # (merge_exploded rebases offsets/ranges) so the existing
+            # device staging / host materialize paths run unchanged.
+            t0 = time.perf_counter()
+            exploded = batch_codec.merge_exploded(
+                pool.run([
+                    (lambda s=s, e=e: batch_codec.explode_batches(all_batches[s:e]))
+                    for s, e in parts
+                ])
+            )
+            self._stat_add("t_explode", time.perf_counter() - t0)
+            launch.ranges = exploded.ranges
+            n = len(exploded.sizes)
+            launch.n = n
+            if plan.mode == "payload":
+                self._dispatch_payload(launch, exploded, n)
+            else:
+                launch._exploded = exploded
+        self._stat_add("n_records", n)
+        self._stat_add("n_launches", 1)
+        self._stat_add("n_sharded_launches", 1)
+        with self._stats_lock:  # HdrHist isn't thread-safe
+            probes.coproc_launch_rows_hist.record(n)
+            if plan.mode == "columnar":
+                for shard in launch._shards:
+                    probes.coproc_shard_rows_hist.record(shard.n)
+                self.last_launch_shards = [
+                    {"rows": shard.n, **shard.stages} for shard in launch._shards
+                ]
+            else:
+                for s, e in parts:
+                    probes.coproc_shard_rows_hist.record(sum(counts[s:e]))
+        return True
+
+    def _run_columnar_shard(
+        self, idx: int, launch: _Launch, plan: ColumnarPlan, batches, paths,
+        use_host,
+    ) -> _HostShard:
+        """One shard's dispatch-side host stages, on a pool worker: explode
+        + find, predicate column extraction, predicate dispatch (the shard's
+        own device launch or numpy eval — issued as soon as THIS shard's
+        columns land, overlapping later shards' extraction), projection
+        extraction. Touches only its own shard (SHD6xx)."""
+        shard = _HostShard()
+        t_shard0 = time.perf_counter()
+
+        def stage(key: str, t0: float) -> None:
+            dt = time.perf_counter() - t0
+            # shards run concurrently: summing their durations into the
+            # launch-wall t_* keys would inflate those ~workers-fold, so
+            # per-shard time lands under t_shard_* (CPU-seconds across
+            # workers); the fan-out's wall time is t_sharded_dispatch
+            self._stat_add("t_shard_" + key[2:], dt)
+            shard.stages[key] = round(shard.stages.get(key, 0.0) + dt, 6)
+
+        t0 = time.perf_counter()
+        cache = None
+        fused = batch_codec.explode_and_find(batches, paths) if paths else None
+        if fused is not None:
+            ex, types, vs, ve = fused
+            cache = plan.make_cache_from_tables(ex, paths, types, vs, ve)
+            stage("t_explode_find", t0)
+        else:
+            ex = batch_codec.explode_batches(batches)
+            stage("t_explode", t0)
+        shard.exploded = ex
+        shard.ranges = ex.ranges
+        n = len(ex.sizes)
+        shard.n = n
+        if n == 0:
+            shard.proj_ok = np.zeros(0, dtype=bool)
+            return shard
+        if cache is None:
+            t0 = time.perf_counter()
+            cache = plan.build_find_cache(ex.joined, ex.offsets, ex.sizes)
+            stage("t_find", t0)
+        if plan.dev_cols:
+            t0 = time.perf_counter()
+            n_pad = _bucket_rows(n)
+            cols = plan.extract_device_inputs(
+                ex.joined, ex.offsets, ex.sizes, n_pad, cache
+            )
+            stage("t_extract_pred", t0)
+            slot = _MaskSlot(n)
+            slot.trace_id = launch.trace_id
+            t0 = time.perf_counter()
+            if use_host:
+                slot._mask_np = plan.eval_host_mask(cols)
+                stage("t_dispatch", t0)
+            else:
+                fn = plan.compile_device(None)
+                mask = fn(*cols)
+                mask.copy_to_host_async()
+                stage("t_dispatch", t0)
+                self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
+                self._stat_add("bytes_d2h", n_pad // 8)
+                slot._mask_dev = mask
+                slot._mask_event = threading.Event()
+                self._ensure_harvester()
+                slot._enq_t = time.perf_counter()
+                self._harvest_q.put(slot)
+            shard.mask = slot
+        t0 = time.perf_counter()
+        if plan.passthrough:
+            shard.proj_ok = np.ones(n, dtype=bool)
+        else:
+            data, ok = plan.extract_projection(
+                ex.joined, ex.offsets, ex.sizes, cache
+            )
+            shard.proj_data = data
+            shard.proj_ok = ok
+            shard.exploded = None  # framing reads proj_data, not raw records
+        stage("t_extract_proj", t0)
+        tracer.record(
+            "coproc.shard",
+            (time.perf_counter() - t_shard0) * 1e6,
+            launch.trace_id,
+            start_perf=t_shard0,
+            shard=idx,
+            rows=n,
+        )
+        return shard
 
     def _dispatch_payload(self, launch: _Launch, exploded, n: int) -> None:
         import jax

@@ -19,7 +19,9 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 from redpanda_tpu.coproc.engine import (
     ProcessBatchItem,
@@ -118,10 +120,11 @@ class ScriptContext:
             # ~10s after the first deploy when submit ran on-loop).
             loop = asyncio.get_running_loop()
             req = ProcessBatchRequest(items, trace_id=tick_span.trace_id)
+            ex = pm.engine_executor
             with tracer.span("coproc.submit.wait"):
-                ticket = await loop.run_in_executor(None, pm.engine.submit, req)
+                ticket = await loop.run_in_executor(ex, pm.engine.submit, req)
             with tracer.span("coproc.harvest.wait"):
-                reply = await loop.run_in_executor(None, ticket.result)
+                reply = await loop.run_in_executor(ex, ticket.result)
             if self.script_id in reply.deregistered:
                 logger.warning("script %s deregistered by engine policy", self.name)
                 pm.detach_script(self.name)
@@ -200,6 +203,24 @@ class Pacemaker:
         self._scripts: dict[str, ScriptContext] = {}
         self._flush_task: asyncio.Task | None = None
         self._materialized_locks: dict[NTP, asyncio.Lock] = {}
+        # Dedicated executor for engine submit/harvest: these block for a
+        # whole launch (sharded host stages + a device round trip), and on
+        # the loop's DEFAULT executor they would starve every
+        # asyncio.to_thread user in the broker (storage/archival blocking
+        # I/O shares that pool). Lazily created; sized like the default
+        # executor it replaced — a harvest can block up to the 30s mask
+        # timeout, so a small fixed cap would head-of-line block every
+        # other script's tick behind a few wedged fetches.
+        self._engine_executor: ThreadPoolExecutor | None = None
+
+    @property
+    def engine_executor(self) -> ThreadPoolExecutor:
+        if self._engine_executor is None:
+            self._engine_executor = ThreadPoolExecutor(
+                max_workers=min(32, (os.cpu_count() or 1) + 4),
+                thread_name_prefix="rptpu-coproc-tick",
+            )
+        return self._engine_executor
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> "Pacemaker":
@@ -219,6 +240,11 @@ class Pacemaker:
             await ctx.stop()
         self._save_offsets()
         self._scripts.clear()
+        if self._engine_executor is not None:
+            # fibers are stopped, nothing new can be submitted; don't block
+            # broker shutdown on a straggling harvest
+            self._engine_executor.shutdown(wait=False)
+            self._engine_executor = None
 
     # ------------------------------------------------------------ scripts
     async def add_source(self, name: str, script_id: int, input_topics: tuple[str, ...]) -> None:

@@ -58,6 +58,38 @@ coproc_launch_rows_hist = registry.histogram(
     "coproc_launch_rows",
     "Records fused into one device launch (bucket size after shape rounding)",
 )
+coproc_shard_rows_hist = registry.histogram(
+    "coproc_shard_rows",
+    "Records per host-stage shard (coproc_host_workers fan-out)",
+)
+
+# ------------------------------------------------------ host-stage pool
+# Busy-worker gauge for the coproc host-stage pool (coproc/host_pool.py).
+# The counter lives HERE, not on the pool: the gauge must be registered
+# exactly once per process while pools are per-engine, and probes already
+# owns the process-wide registry. inc/dec under a lock — += on an int is
+# a read-modify-write and worker threads race it.
+_host_pool_busy = 0
+_host_pool_lock = threading.Lock()
+
+
+def host_pool_task_started() -> None:
+    global _host_pool_busy
+    with _host_pool_lock:
+        _host_pool_busy += 1
+
+
+def host_pool_task_finished() -> None:
+    global _host_pool_busy
+    with _host_pool_lock:
+        _host_pool_busy -= 1
+
+
+coproc_host_pool_busy = registry.gauge(
+    "coproc_host_pool_busy_workers",
+    lambda: float(_host_pool_busy),
+    "Host-stage pool workers currently running a shard task",
+)
 
 _coproc_stage: dict[str, Histogram] = {}
 _coproc_stage_lock = threading.Lock()
@@ -95,8 +127,12 @@ __all__ = [
     "Histogram",
     "coproc_d2h_bytes",
     "coproc_h2d_bytes",
+    "coproc_host_pool_busy",
     "coproc_launch_rows_hist",
+    "coproc_shard_rows_hist",
     "coproc_stage_hist",
+    "host_pool_task_finished",
+    "host_pool_task_started",
     "kafka_fetch_hist",
     "kafka_produce_hist",
     "observe_us",

@@ -143,7 +143,55 @@ class ProcCluster:
         for n in self.nodes:
             n.start()
         await asyncio.gather(*(n.wait_ready() for n in self.nodes))
+        await self.wait_for_settled_writes()
         return self
+
+    async def wait_for_settled_writes(self, timeout: float = 45.0) -> None:
+        """Process-level analogue of raft_stability.wait_for_stable_leader:
+        /v1/status/ready says a broker is UP, not that the cluster has a
+        controller leader that will survive the startup-election wave (the
+        documented "no controller leader" chaos flake). A canary topic is
+        created and produced to with acks=-1 TWICE, the attempts separated
+        by one election-timeout margin — both writes replicating through
+        the same settled leadership is the black-box signal the wait-for-
+        settled contract asks for. Brokers run raft_election_timeout_ms=500
+        (BrokerProc.start)."""
+        from redpanda_tpu.kafka.client import KafkaClient
+
+        deadline = time.monotonic() + timeout
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            c = None
+            try:
+                c = await KafkaClient(self.bootstrap()).connect()
+                try:
+                    await c.create_topic(
+                        "chaos-canary", partitions=1, replication=3
+                    )
+                except Exception:
+                    # already created by an earlier attempt — produce is
+                    # the signal we're actually after. auto_create=False:
+                    # metadata auto-creation would build the canary at
+                    # default_topic_replication (1 unless the cluster
+                    # overrides it) and a single-replica acks=-1 write
+                    # settles nothing
+                    await c.refresh_metadata(
+                        ["chaos-canary"], auto_create=False
+                    )
+                await c.produce("chaos-canary", 0, [b"settle-1"], acks=-1)
+                await asyncio.sleep(0.75)  # 1.5x election timeout in-term
+                await c.produce("chaos-canary", 0, [b"settle-2"], acks=-1)
+                await c.close()
+                return
+            except Exception as e:  # noqa: BLE001 — retried until deadline
+                last = e
+                if c is not None:
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.5)
+        raise TimeoutError(f"cluster writes never settled: {last!r}")
 
     async def stop(self) -> None:
         for n in self.nodes:

@@ -1,0 +1,32 @@
+"""Fixture: cross-shard mutation from host-pool worker bodies (SHD6xx)."""
+import threading
+
+_lock = threading.Lock()
+
+
+def run_one_shard(self, launch, shard, idx):
+    launch._shards[idx + 1].n = 0
+    shards = launch._shards
+    shards[idx - 1] = shard
+    launch.n = shard.n
+    self.partition_map[idx] = shard
+    self.harvest_q.queue.append(shard)
+    shard.rows = 4  # not flagged: the worker's own shard
+    local = object()
+    local.anything = 1  # not flagged: worker-local object
+    with self._stats_lock:
+        self.last_launch_shards = [shard]  # not flagged: owner's lock held
+    return shard
+
+
+def dispatch_sharded(self, launch, parts):
+    # not flagged: *_sharded names the submitter-thread coordinator, which
+    # owns the fan-in merge
+    launch.n = sum(parts)
+    return launch
+
+
+def drain(self):
+    items = list(self.work_q.queue)  # reads are SHD-silent; writes are not
+    self.work_q.queue.clear()
+    return items

@@ -97,6 +97,31 @@ def test_engine_sync_scoped_to_coproc(tmp_path):
     assert any(f.rule.startswith("ENG") for f in report.findings)
 
 
+def test_cross_shard_rules_exact_lines():
+    got = _active(_lint(os.path.join(FIXTURES, "cross_shard.py")))
+    assert got == [
+        ("SHD601", 8),
+        ("SHD601", 10),
+        ("SHD602", 11),
+        ("SHD602", 12),
+        ("SHD603", 13),
+        ("SHD603", 31),  # queue internals: flagged in any function in scope
+    ]
+
+
+def test_cross_shard_scoped_to_coproc(tmp_path):
+    """cross-shard reasons about the coproc pool's *_shard naming
+    convention; it must not fire on shard-named functions elsewhere."""
+    cfg = Config()
+    for sub, expect in (("raft", False), ("coproc", True)):
+        pkg = tmp_path / "redpanda_tpu" / sub
+        pkg.mkdir(parents=True)
+        dst = pkg / "xs.py"
+        shutil.copyfile(os.path.join(FIXTURES, "cross_shard.py"), dst)
+        report = LintEngine(cfg).lint_file(str(dst), f"redpanda_tpu/{sub}/xs.py")
+        assert any(f.rule.startswith("SHD") for f in report.findings) is expect, sub
+
+
 def test_iobuf_rules_exact_lines():
     got = _active(_lint(os.path.join(FIXTURES, "copy_loop.py")))
     assert got == [

@@ -133,13 +133,17 @@ class RaftGroupFixture:
         await wait_until(lambda: self.leader() is not None, timeout, msg="no leader elected")
         return self.leader()
 
-    async def wait_for_stable_leader(self, timeout: float = 16.0) -> "RaftNode":
-        """Deflake: see raft_stability.wait_for_stable_leader."""
+    async def wait_for_stable_leader(
+        self, timeout: float = 16.0, margin: float = 1.0
+    ) -> "RaftNode":
+        """Deflake: see raft_stability.wait_for_stable_leader (margin =
+        how many election timeouts the leader must survive in-term)."""
         return await wait_for_stable_leader(
             self.leader,
             lambda n: n.consensus() if n.gm is not None else None,
             FAST["election_timeout_ms"] / 1000.0,
             timeout,
+            margin=margin,
         )
 
 

@@ -133,6 +133,74 @@ def bench_explode_find(secs: float) -> dict:
     return out
 
 
+def bench_host_pool_scaling(secs: float) -> dict:
+    """Host-stage pool scaling: the same columnar launch at workers 1/2/4.
+
+    force_mode='columnar_host' keeps the whole run on host stages (explode
+    +find, extraction, numpy predicate, framing) — exactly the work the
+    pool shards — so the w4/w1 ratio is the pool's speedup, not device
+    noise. workers=1 is the inline path (the pool only exists at >= 2).
+    Rates are best-of-rounds (min-of-blocks posture: shared-machine load
+    spikes can only slow a round down)."""
+    from redpanda_tpu.coproc import TpuEngine, ProcessBatchRequest
+    from redpanda_tpu.coproc.engine import ProcessBatchItem
+    from redpanda_tpu.models import NTP
+    from redpanda_tpu.models.record import Record, RecordBatch
+    from redpanda_tpu.ops.exprs import field
+    from redpanda_tpu.ops.transforms import Int, Str, map_project, where
+
+    rng = np.random.default_rng(3)
+    spec = where(field("level") == "error") | map_project(Int("code"), Str("msg", 64))
+    batches = []
+    for _ in range(64):
+        recs = [
+            Record(
+                offset_delta=i,
+                value=json.dumps({
+                    "level": ["error", "info"][i % 2], "code": i,
+                    "msg": "x" * int(rng.integers(40, 90)),
+                }).encode(),
+            )
+            for i in range(64)
+        ]
+        batches.append(RecordBatch.build(recs, base_offset=0))
+    req = ProcessBatchRequest(
+        [ProcessBatchItem(1, NTP.kafka("bench", 0), batches)]
+    )
+    n_recs = 64 * 64
+    out = {}
+    for workers in (1, 2, 4):
+        engine = TpuEngine(
+            row_stride=256,
+            compress_threshold=10**9,
+            force_mode="columnar_host",
+            host_workers=workers,
+            host_pool_probe=False,  # this bench IS the capacity measurement
+        )
+        codes = engine.enable_coprocessors([(1, spec.to_json(), ("bench",))])
+        assert codes == [0]
+        engine.process_batch(req)  # warmup
+        best = 0.0
+        t_end = time.perf_counter() + secs
+        while time.perf_counter() < t_end:
+            t0 = time.perf_counter()
+            engine.process_batch(req)
+            best = max(best, n_recs / (time.perf_counter() - t0))
+        out[f"host_pool_w{workers}_recs_per_s"] = round(best, 1)
+    w1 = out["host_pool_w1_recs_per_s"]
+    out["host_pool_speedup_best"] = round(
+        max(out["host_pool_w2_recs_per_s"], out["host_pool_w4_recs_per_s"]) / w1, 3
+    )
+    # context for sub-1x results: synthetic thread-scaling on this box
+    # (quota-limited hosts advertise CPUs they don't have; the product
+    # engine calibrates on its real explode stage and self-demotes there)
+    from redpanda_tpu.coproc import host_pool
+
+    probe = host_pool.measure_parallel_capacity()
+    out["host_pool_synthetic_thread_speedup"] = probe["speedup"]
+    return out
+
+
 def bench_compaction_index(secs: float) -> dict:
     """Key-index build rate (compaction_idx_bench shape)."""
     from redpanda_tpu.storage.compaction import KeyLatestIndex
@@ -308,6 +376,7 @@ BENCHES = {
     "zstd_stream": bench_zstd_stream,
     "batch_codec": bench_batch_codec,
     "explode_find": bench_explode_find,
+    "host_pool_scaling": bench_host_pool_scaling,
     "compaction_index": bench_compaction_index,
     "allocation": bench_allocation,
     "rpc_echo": bench_rpc_echo,
@@ -331,6 +400,13 @@ def main(argv=None) -> int:
         help="fail (exit 1) if the disabled-tracer overhead exceeds PCT "
         "percent; implies the tracer_overhead bench",
     )
+    p.add_argument(
+        "--assert-pool-speedup",
+        type=float,
+        metavar="RATIO",
+        help="fail (exit 1) if the host-stage pool's best speedup over "
+        "workers=1 falls below RATIO (e.g. 1.2); implies host_pool_scaling",
+    )
     args = p.parse_args(argv)
     names = [n.strip() for n in args.only.split(",")] if args.only else list(BENCHES)
     unknown = [n for n in names if n not in BENCHES]
@@ -338,6 +414,8 @@ def main(argv=None) -> int:
         p.error(f"unknown bench(es) {unknown}; choose from {sorted(BENCHES)}")
     if args.assert_tracer_overhead is not None and "tracer_overhead" not in names:
         names.append("tracer_overhead")
+    if args.assert_pool_speedup is not None and "host_pool_scaling" not in names:
+        names.append("host_pool_scaling")
     snap_before = None
     if args.metrics_snapshot:
         from redpanda_tpu.metrics import registry
@@ -361,6 +439,15 @@ def main(argv=None) -> int:
             print(
                 f"tracer overhead {pct}% exceeds budget "
                 f"{args.assert_tracer_overhead}%",
+                file=sys.stderr,
+            )
+            return 1
+    if args.assert_pool_speedup is not None:
+        ratio = out.get("host_pool_speedup_best", 0.0)
+        if ratio < args.assert_pool_speedup:
+            print(
+                f"host pool speedup {ratio}x below floor "
+                f"{args.assert_pool_speedup}x",
                 file=sys.stderr,
             )
             return 1

@@ -12,6 +12,7 @@ from tools.pandalint.checkers.hotpath import (
 from tools.pandalint.checkers.tasks import TaskHygieneChecker
 from tools.pandalint.checkers.iobuf import IobufCopyChecker
 from tools.pandalint.checkers.enginesync import EngineSyncChecker
+from tools.pandalint.checkers.crossshard import CrossShardChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     ReactorChecker,
@@ -21,6 +22,7 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     TaskHygieneChecker,
     IobufCopyChecker,
     EngineSyncChecker,
+    CrossShardChecker,
 )
 
 

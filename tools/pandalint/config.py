@@ -34,6 +34,11 @@ DEFAULT_SCOPES: dict[str, tuple[str, ...]] = {
     # async-dispatch contract; np.asarray on host data is perfectly normal
     # elsewhere in the package, so this checker does NOT run package-wide.
     "engine-sync": ("redpanda_tpu/coproc",),
+    # Cross-shard isolation reasons about the host-stage pool's worker
+    # naming convention (*_shard vs *_sharded), which only the coproc data
+    # path follows; SHD603's queue-internals rule is cheap but the naming
+    # heuristic would be noise elsewhere.
+    "cross-shard": ("redpanda_tpu/coproc",),
 }
 
 DEFAULT_PACKAGE_ROOT = "redpanda_tpu"
