@@ -184,8 +184,18 @@ def _run_engine_mode(
         "columnar_backend": stats.get("columnar_backend"),
         "columnar_probe": stats.get("columnar_probe"),
         "host_pool_probe": stats.get("host_pool_probe"),
+        # fault-domain health of the run: a BENCH number produced while the
+        # breaker was open (or launches fell back to host) is an artifact
+        # of a degraded link, and must say so on its face
+        "breaker": stats.get("breaker"),
+        "fallback_rows": stats.get("n_fallback_rows", 0.0),
+        "device_retries": stats.get("n_retries", 0.0),
     }
-    return rate, _fmt_stages(stats), engine.last_launch_shards, probe
+    shards = engine.last_launch_shards
+    # a live harvester pins the engine (jit executables, staged arrays)
+    # for the rest of the multi-mode bench process
+    engine.shutdown()
+    return rate, _fmt_stages(stats), shards, probe
 
 
 def run_cpu_baseline(req) -> float:

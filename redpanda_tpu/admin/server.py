@@ -8,7 +8,11 @@ Parity with redpanda/admin_server.cc:
 - POST /v1/raft/{group}/transfer_leadership             (:301)
 - POST /v1/partitions/kafka/{t}/{p}/transfer_leadership (:486)
 - GET/POST/DELETE /v1/security/users   (:401-483 SCRAM CRUD)
-- GET  /v1/failure-probes, PUT /v1/failure-probes/{m}/{p}/{type} (:948)
+- GET  /v1/failure-probes, PUT /v1/failure-probes/{m}/{p}/{type} (:948;
+  types exception|delay|wedge|terminate, DELETE disarms — rpk debug
+  failpoints)
+- GET  /v1/coproc/status               (engine breaker + fault-domain stats;
+  rpk debug coproc)
 - GET  /metrics                        (:148-151 prometheus)
 - GET  /v1/trace/recent, /v1/trace/slow (pandaprobe span traces; no
   reference analogue — seastar requests never leave their shard, ours
@@ -124,6 +128,7 @@ class AdminServer:
             web.get("/v1/failure-probes", self._list_probes),
             web.put("/v1/failure-probes/{module}/{probe}/{type}", self._set_probe),
             web.delete("/v1/failure-probes/{module}/{probe}", self._unset_probe),
+            web.get("/v1/coproc/status", self._coproc_status),
             web.get("/metrics", self._metrics),
             web.get("/v1/trace/recent", self._trace_recent),
             web.get("/v1/trace/slow", self._trace_slow),
@@ -428,7 +433,11 @@ class AdminServer:
 
     async def _list_probes(self, req: web.Request) -> web.Response:
         return web.json_response(
-            {"enabled": honey_badger.enabled, "modules": honey_badger.modules()}
+            {
+                "enabled": honey_badger.enabled,
+                "modules": honey_badger.modules(),
+                "armed": honey_badger.armed(),
+            }
         )
 
     async def _set_probe(self, req: web.Request) -> web.Response:
@@ -448,6 +457,8 @@ class AdminServer:
             honey_badger.set_exception(module, probe)
         elif typ == "delay":
             honey_badger.set_delay(module, probe)
+        elif typ == "wedge":
+            honey_badger.set_wedge(module, probe)
         elif typ == "terminate":
             honey_badger.set_termination(module, probe)
         else:
@@ -455,8 +466,42 @@ class AdminServer:
         return web.json_response({"armed": f"{module}.{probe}", "type": typ})
 
     async def _unset_probe(self, req: web.Request) -> web.Response:
-        honey_badger.unset(req.match_info["module"], req.match_info["probe"])
-        return web.json_response({"disarmed": f"{req.match_info['module']}.{req.match_info['probe']}"})
+        module = req.match_info["module"]
+        probe = req.match_info["probe"]
+        # same posture as arming: a typo'd disarm answered 200 would leave
+        # the real probe silently armed and the operator believing the
+        # broker healthy
+        known = honey_badger.modules()
+        if module not in known or probe not in known[module]:
+            return web.json_response(
+                {"error": f"unknown probe {module}.{probe}", "modules": known},
+                status=404,
+            )
+        honey_badger.unset(module, probe)
+        if not honey_badger.armed():
+            # last probe disarmed: drop the registry back to its zero-cost
+            # disabled state, or every probe site keeps paying the enabled
+            # check + injection lookup until process restart
+            honey_badger.disable()
+        return web.json_response({"disarmed": f"{module}.{probe}"})
+
+    # ------------------------------------------------------------ coproc
+    async def _coproc_status(self, req: web.Request) -> web.Response:
+        """Engine fault/breaker/stage state for `rpk debug coproc` — the
+        operator's one-stop view of whether the device path is healthy or
+        the engine is running demoted on the host fallback."""
+        api = getattr(self.broker, "coproc_api", None)
+        if api is None:
+            return web.json_response(
+                {"enabled": False, "hint": "coproc_enable is false"}
+            )
+        stats = api.engine.stats()
+        return web.json_response({
+            "enabled": True,
+            "scripts": api.active_scripts(),
+            "breaker": stats.pop("breaker", None),
+            "stats": stats,
+        })
 
     # ------------------------------------------------------------ metrics
     async def _metrics(self, req: web.Request) -> web.Response:

@@ -312,8 +312,9 @@ async def cmd_config(args) -> int:
 
 # ================================================================ debug / generate / tune
 async def cmd_debug(args) -> int:
-    """debug diagnostics: bundle (tar.gz of admin state) or trace (render
-    the broker's recent pandaprobe spans)."""
+    """debug diagnostics: bundle (tar.gz of admin state), trace (render
+    the broker's recent pandaprobe spans), coproc (engine breaker +
+    fault-domain stats), failpoints (honey-badger arm/disarm)."""
     import io
     import tarfile
     import time
@@ -357,6 +358,66 @@ async def cmd_debug(args) -> int:
         print(render_report(body, max_traces=args.limit))
         return 0
 
+    if args.debug_cmd == "coproc":
+        status, body = await _admin_request(args, "GET", "/v1/coproc/status")
+        if status != 200:
+            print(f"admin api returned {status}: {body}")
+            return 1
+        if args.json:
+            print(json.dumps(body, indent=2, sort_keys=True))
+            return 0
+        if not body.get("enabled"):
+            print("coproc disabled (set coproc_enable: true)")
+            return 0
+        b = body.get("breaker") or {}
+        print(
+            f"breaker: {b.get('state', '?'):<10} trips={b.get('trips', 0)} "
+            f"consecutive_failures={b.get('consecutive_failures', 0)}"
+            f"/{b.get('threshold', '?')} cooldown={b.get('cooldown_ms', '?')}ms"
+        )
+        print(f"scripts: {', '.join(body.get('scripts') or []) or '(none)'}")
+        stats = body.get("stats") or {}
+        shown = {
+            k: v for k, v in sorted(stats.items())
+            if k.startswith(("t_", "n_", "bytes_")) or k == "host_workers"
+        }
+        for k, v in shown.items():
+            v = round(v, 6) if isinstance(v, float) else v
+            print(f"  {k:<28}{v}")
+        for k in ("columnar_backend", "host_pool_probe", "columnar_probe"):
+            if stats.get(k) is not None:
+                print(f"  {k:<28}{stats[k]}")
+        return 0
+
+    if args.debug_cmd == "failpoints":
+        if args.fp_cmd == "list":
+            status, body = await _admin_request(args, "GET", "/v1/failure-probes")
+            if status != 200:
+                print(f"admin api returned {status}: {body}")
+                return 1
+            armed = body.get("armed") or {}
+            print(f"honey badger enabled: {body.get('enabled', False)}")
+            for module, probes_ in sorted((body.get("modules") or {}).items()):
+                for probe in probes_:
+                    effect = armed.get(module, {}).get(probe, "-")
+                    print(f"  {module + '.' + probe:<40}{effect}")
+            return 0
+        if args.fp_cmd == "arm":
+            status, body = await _admin_request(
+                args, "PUT",
+                f"/v1/failure-probes/{args.module}/{args.probe}/{args.type}",
+            )
+        else:  # disarm
+            status, body = await _admin_request(
+                args, "DELETE",
+                f"/v1/failure-probes/{args.module}/{args.probe}",
+            )
+        if status != 200:
+            print(f"admin api returned {status}: {body}")
+            return 1
+        print(json.dumps(body))
+        return 0
+
     bundle: dict[str, object] = {}
     for name, path in [
         ("config.json", "/v1/config"),
@@ -364,6 +425,8 @@ async def cmd_debug(args) -> int:
         ("partitions.json", "/v1/partitions"),
         ("metrics.txt", "/metrics"),
         ("traces.json", "/v1/trace/recent"),
+        ("coproc.json", "/v1/coproc/status"),
+        ("failpoints.json", "/v1/failure-probes"),
     ]:
         status, body = await _admin_request(args, "GET", path)
         bundle[name] = body if status == 200 else {"error": status}
@@ -558,6 +621,24 @@ def build_parser() -> argparse.ArgumentParser:
     dt.add_argument("--slow", action="store_true", help="slow-request log only")
     dt.add_argument("--limit", type=int, default=10, help="traces/spans to fetch")
     dt.add_argument("--json", action="store_true", help="raw JSON, no rendering")
+    dc = dsub.add_parser(
+        "coproc", help="engine breaker + fault-domain + stage stats"
+    )
+    dc.add_argument("--json", action="store_true", help="raw JSON, no rendering")
+    dfp = dsub.add_parser(
+        "failpoints", help="list/arm/disarm honey-badger failure probes"
+    )
+    fpsub = dfp.add_subparsers(dest="fp_cmd", required=True)
+    fpsub.add_parser("list")
+    fpa = fpsub.add_parser("arm")
+    fpa.add_argument("module")
+    fpa.add_argument("probe")
+    fpa.add_argument(
+        "type", choices=["exception", "delay", "wedge", "terminate"],
+    )
+    fpd = fpsub.add_parser("disarm")
+    fpd.add_argument("module")
+    fpd.add_argument("probe")
 
     gp = sub.add_parser("generate", help="monitoring + deployment configs")
     gsub = gp.add_subparsers(dest="generate_cmd", required=True)

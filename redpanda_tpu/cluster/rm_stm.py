@@ -159,7 +159,7 @@ class RmStm:
                 to_append.append(b)
             if not to_append:
                 return E.none, None
-            res = await self.partition.replicate(to_append, level)
+            res = await self.partition.replicate(to_append, level)  # pandalint: disable=LCK702 -- idempotency stm: sequence-check + replicate + note_appended must be one atom or dedup state races the log
             base = res.base_offset
             for b in to_append:
                 self._note_appended(b, base)

@@ -141,6 +141,32 @@ PROPERTIES: list[Property] = [
         "Measure real parallel capacity before sharding host stages (quota-limited boxes advertise CPUs they don't have); false trusts coproc_host_workers as-is",
         True, bool,
     ),
+    # --- coproc fault domains (coproc/faults.py)
+    Property(
+        "coproc_device_deadline_ms",
+        "Per-attempt deadline on every device interaction (dispatch, mask fetch, harvest); a wedged fetch is abandoned after this",
+        30_000, int, _positive,
+    ),
+    Property(
+        "coproc_launch_retries",
+        "Bounded retries per device interaction before the launch fails closed onto the pure-host path",
+        2, int, _non_negative,
+    ),
+    Property(
+        "coproc_retry_backoff_ms",
+        "Base exponential backoff between device retries (jittered 50-100%)",
+        50, int, _positive,
+    ),
+    Property(
+        "coproc_breaker_threshold",
+        "Consecutive device failures that trip the engine's circuit breaker to open (host execution)",
+        5, int, _positive,
+    ),
+    Property(
+        "coproc_breaker_cooldown_ms",
+        "Open-breaker cooldown before one half-open probe launch may re-admit the device",
+        30_000, int, _positive,
+    ),
     # --- tiered storage (cloud_storage_* group)
     Property("cloud_storage_enabled", "Enable tiered storage", False, bool),
     Property("cloud_storage_bucket", "S3 bucket", ""),

@@ -41,6 +41,11 @@ class CoprocApi:
         self.engine = TpuEngine(
             host_workers=_knob("coproc_host_workers", None),
             host_pool_probe=_knob("coproc_host_pool_probe", True),
+            device_deadline_ms=_knob("coproc_device_deadline_ms", None),
+            launch_retries=_knob("coproc_launch_retries", None),
+            retry_backoff_ms=_knob("coproc_retry_backoff_ms", None),
+            breaker_threshold=_knob("coproc_breaker_threshold", None),
+            breaker_cooldown_ms=_knob("coproc_breaker_cooldown_ms", None),
         )
         self.pacemaker = Pacemaker(
             broker, self.engine,
@@ -49,6 +54,12 @@ class CoprocApi:
             # most max_batch_size bytes (configuration.h:57-61 semantics)
             max_inflight_reads=max(1, inflight_bytes // max(max_batch, 1)),
             offset_flush_interval_s=flush_ms / 1000.0,
+            # the tick backstop sits ABOVE the engine's own retry envelope
+            # (a few device legs per tick, each up to one full envelope) —
+            # it only fires when the in-engine machinery itself is wedged
+            tick_deadline_s=max(
+                60.0, 4 * self.engine._fault_policy.envelope_s()
+            ),
         )
         self._listener_task: asyncio.Task | None = None
         self._listen_offset = 0
@@ -95,6 +106,10 @@ class CoprocApi:
                 pass
             self._listener_task = None
         await self.pacemaker.stop()
+        # stop the engine's background machinery LAST: the pacemaker's
+        # final ticks may still be harvesting (engine.shutdown joins the
+        # harvester off-loop; it can block up to a drain, so thread it)
+        await asyncio.to_thread(self.engine.shutdown)
 
     # ------------------------------------------------------------ deploy surface
     async def deploy(self, name: str, spec_json: str, input_topics: list[str]) -> None:

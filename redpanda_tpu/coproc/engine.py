@@ -58,7 +58,7 @@ from redpanda_tpu.ops.pipeline import IN_META, make_packed_pipeline, unpack_resu
 
 logger = logging.getLogger("rptpu.coproc.engine")
 from redpanda_tpu.ops.transforms import TransformSpec
-from redpanda_tpu.coproc import batch_codec, host_pool
+from redpanda_tpu.coproc import batch_codec, faults, host_pool
 from redpanda_tpu.coproc.column_plan import ColumnarPlan, HostPlan, PayloadPlan, plan_spec
 
 
@@ -127,6 +127,12 @@ def _bucket_rows(n: int) -> int:
     return b
 
 
+# serializes _mask_state transitions between the harvester, timed-out
+# callers claiming their still-queued mask, and sharded-launch abandonment
+# (transitions are rare and O(1); one process-wide lock is plenty)
+_mask_claim_lock = threading.Lock()
+
+
 class _MaskSlot:
     """One shard's predicate mask in flight (host-evaluated or device).
 
@@ -136,7 +142,7 @@ class _MaskSlot:
     """
 
     __slots__ = ("n", "_mask_dev", "_mask_np", "_mask_event",
-                 "trace_id", "_enq_t")
+                 "trace_id", "_enq_t", "_cols", "_mask_state")
 
     def __init__(self, n: int):
         self.n = n
@@ -145,6 +151,19 @@ class _MaskSlot:
         self._mask_event: threading.Event | None = None
         self.trace_id: int | None = None
         self._enq_t = 0.0
+        # extracted predicate columns, retained while a device mask is in
+        # flight: the exact numpy fallback re-evaluates over these if the
+        # D2H fetch dies (faults.MASK_FETCH domain)
+        self._cols = None
+        # claim protocol (guarded by _mask_claim_lock): "idle" -> "queued"
+        # on enqueue; the harvester CASes queued -> "harvesting" on
+        # dequeue; a caller that timed out while its mask was still QUEUED
+        # (harvester busy on an earlier wedged mask) CASes queued ->
+        # "claimed" and fetches itself; a degraded sharded launch marks
+        # its orphans "abandoned". The harvester skips claimed/abandoned
+        # without a fetch or a breaker verdict — one mask, one envelope,
+        # one verdict, no matter how deep the harvest queue is.
+        self._mask_state = "idle"
 
 
 class _HostShard:
@@ -190,7 +209,8 @@ class _Launch:
                  "engine", "n", "_packed_dev", "_mask_dev", "_mask_np",
                  "_mask_event", "_proj_data", "_proj_ok", "_plan",
                  "_exploded", "_mat", "_framed", "_lock", "_shards",
-                 "trace_id", "_enq_t")
+                 "trace_id", "_enq_t", "_cols", "_staged_np",
+                 "_mask_state", "_pending_slots")
 
     def __init__(self, script_id: int, policy: ErrorPolicy):
         self.script_id = script_id
@@ -215,6 +235,18 @@ class _Launch:
         self._framed = None
         self._lock = threading.Lock()
         self._shards: list[_HostShard] | None = None
+        # fault-domain fallbacks: predicate columns / staged payload rows
+        # retained until their device result lands, so an exhausted device
+        # retry can re-execute the stage host-side with exact output
+        self._cols = None
+        # see _MaskSlot._mask_state: same claim protocol, same harvester
+        self._mask_state = "idle"
+        # per-shard _MaskSlots this launch has enqueued to the harvester
+        # (appended under self._lock by shard workers): a sharded launch
+        # that degrades to the inline path abandons these so orphan masks
+        # cost no envelopes and feed no stale verdicts to the breaker
+        self._pending_slots: list[_MaskSlot] = []
+        self._staged_np = None
 
 
     def _mat_payload(self):
@@ -225,12 +257,48 @@ class _Launch:
                 np.zeros(0, bool),
             )
         t0 = time.perf_counter()
-        packed = np.asarray(self._packed_dev)
+        dev = self._packed_dev
+        eng = self.engine
+        if isinstance(dev, np.ndarray) or eng is None:
+            # host-fallback result (already materialized) / bare test launch
+            packed = np.asarray(dev)
+        else:
+            def leg():
+                faults.inject(faults.HARVEST)
+                return np.asarray(dev)
+
+            packed = eng._try_device_leg(faults.HARVEST, leg)
+            if packed is None:
+                packed = self._payload_host_fallback()
+            else:
+                eng._breaker.record_success()
         self._stat("t_fetch", t0)
         self._packed_dev = None
+        self._staged_np = None
         out, out_len, keep = unpack_result(packed, self.r_out)
         n = len(self.fits)
         return out[:n], out_len[:n], keep[:n] & self.fits
+
+    def _payload_host_fallback(self) -> np.ndarray:
+        """Fail closed per-launch: re-run the packed pipeline on the CPU
+        backend over the retained staged rows — the same program over the
+        same bytes, so output is exact; only the executor changed. Raises
+        when nothing was retained (the launch then follows ErrorPolicy,
+        exactly like any unrecoverable script failure)."""
+        import jax
+
+        staged = self._staged_np
+        eng = self.engine
+        if staged is None or eng is None:
+            raise RuntimeError(
+                "payload host fallback impossible: staged rows not retained"
+            )
+        fn, _ = eng._pipelines[self.script_id]
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            packed = np.asarray(fn(jax.device_put(staged, cpu)))
+        eng._count_fallback(self.n)
+        return packed
 
     def _resolve_keep(self, slot, n: int) -> np.ndarray:
         """Resolve a keep mask from a mask holder — the launch itself or a
@@ -245,21 +313,96 @@ class _Launch:
             slot._mask_np = None
             return keep
         t0 = time.perf_counter()
+        eng = self.engine
+        # wait out the harvester's WHOLE retry envelope, not one attempt's
+        # deadline: timing out mid-envelope would start a duplicate
+        # concurrent fetch of the same array and double-count the failure
+        wait_s = (
+            eng._fault_policy.envelope_s() + 1.0 if eng is not None else 30.0
+        )
         if slot._mask_event is not None:
             # harvester thread pays the link round trip concurrently
             # with the caller's host work; worst case we fetch ourselves.
             # Keep OUR fetch in a local — the harvester may still write
             # _mask_np (even None, on its own failure) after a timeout.
-            slot._mask_event.wait(timeout=30.0)
+            finished = slot._mask_event.wait(timeout=wait_s)
             bits = slot._mask_np
             if bits is None:
-                bits = np.asarray(slot._mask_dev)
+                if finished:
+                    # the harvester ran the FULL retry envelope on this
+                    # mask and definitively failed (its breaker verdict is
+                    # already recorded): re-running the same doomed fetch
+                    # here would double-count the failure and double the
+                    # dead-link wait — go straight to the exact fallback
+                    bits = self._mask_host_fallback(slot)
+                else:
+                    # mask still QUEUED? The single harvester is busy on
+                    # earlier (wedged) masks. Claim it — the harvester
+                    # will skip the claimed slot, so this stays ONE fetch
+                    # envelope and ONE breaker verdict per mask at any
+                    # queue depth.
+                    with _mask_claim_lock:
+                        claimed = slot._mask_state == "queued"
+                        if claimed:
+                            slot._mask_state = "claimed"
+                    if claimed:
+                        bits = self._fetch_mask_bits(slot)
+                    else:
+                        # the harvester is ACTIVELY harvesting this mask:
+                        # one more envelope bounds its verdict
+                        finished = slot._mask_event.wait(timeout=wait_s)
+                        bits = slot._mask_np
+                        if bits is None:
+                            # verdict recorded -> exact fallback; still
+                            # nothing -> the thread itself is stuck, pay
+                            # the fetch ourselves (genuinely exceptional)
+                            bits = (
+                                self._mask_host_fallback(slot)
+                                if finished
+                                else self._fetch_mask_bits(slot)
+                            )
         else:
-            bits = np.asarray(slot._mask_dev)
+            bits = self._fetch_mask_bits(slot)
         self._stat("t_fetch", t0)
         slot._mask_dev = None
         slot._mask_np = None
+        slot._cols = None
         return np.unpackbits(bits)[:n].astype(bool)
+
+    def _fetch_mask_bits(self, slot) -> np.ndarray:
+        """Deadline-bounded, retried D2H mask fetch with the EXACT numpy
+        fallback: on exhausted retries the predicate re-evaluates over the
+        retained extracted columns (faults.MASK_FETCH domain), so a dead
+        link changes where the bits come from, never what they are."""
+        eng = self.engine
+        dev = slot._mask_dev
+        if eng is None:  # bare launch in tests: old synchronous behavior
+            return np.asarray(dev)
+
+        def leg():
+            faults.inject(faults.MASK_FETCH)
+            return np.asarray(dev)
+
+        bits = eng._try_device_leg(faults.MASK_FETCH, leg)
+        if bits is None:
+            bits = self._mask_host_fallback(slot)
+        else:
+            eng._breaker.record_success()
+        return bits
+
+    def _mask_host_fallback(self, slot) -> np.ndarray:
+        """Exact numpy re-evaluation of the predicate over the retained
+        extracted columns (same expression tree, same column bytes).
+        Raises when nothing was retained — the launch then follows the
+        script's ErrorPolicy like any unrecoverable failure."""
+        cols = slot._cols
+        if cols is None:
+            raise RuntimeError(
+                "mask host fallback impossible: predicate columns not retained"
+            )
+        bits = self._plan.eval_host_mask(cols)
+        self.engine._count_fallback(slot.n)
+        return bits
 
     def _mat_columnar(self):
         n = self.n
@@ -305,11 +448,15 @@ class _Launch:
                 val = ex.joined[o : o + int(ex.sizes[i])]
                 try:
                     outs.append(plan.fn(val))
-                except Exception:
+                except Exception as exc:
                     if self.policy == ErrorPolicy.deregister:
                         # propagate: Ticket._rebuild applies the policy and
                         # unloads the script (wasm_event.h Deregister)
                         raise
+                    # user-code boundary: a script TypeError is a script
+                    # failure, not an engine bug — never re-raise, but
+                    # count it (skip_on_failure drops silently otherwise)
+                    faults.note_failure("host_plan", exc)
                     outs.append(None)
             keep = np.array([o is not None for o in outs], dtype=bool)
             stride = max((len(o) for o in outs if o is not None), default=1)
@@ -425,7 +572,10 @@ def _pack_values(ex, stride: int):
     """Pack exploded record values into [n, stride] rows + lens."""
     try:
         from redpanda_tpu.native import lib
-    except Exception:
+    except Exception as exc:
+        # expected degradation: no native build on this box — the Python
+        # packer is exact, only slower; counted so the demotion is visible
+        faults.note_failure("native_lib", exc)
         lib = None
     sizes = np.minimum(ex.sizes, stride).astype(np.int32)
     if lib is not None:
@@ -438,6 +588,13 @@ def _pack_values(ex, stride: int):
         ]
         rows, _ = pack_rows(vals, stride)
     return rows, sizes
+
+
+def _explode_shard(batches):
+    """One payload/host-plan explode shard on a pool worker (the
+    shard_worker fault domain covers every dispatch-side worker body)."""
+    faults.inject(faults.SHARD_WORKER)
+    return batch_codec.explode_batches(batches)
 
 
 # Per-slot dispositions inside a Ticket.
@@ -499,7 +656,11 @@ class Ticket:
                     reply.items.append(
                         ProcessBatchReplyItem(item.script_id, item.ntp, out_batches)
                     )
-                except Exception:
+                except Exception as exc:
+                    # classified, then the script's ErrorPolicy decides —
+                    # this is the policy boundary (deregister re-raises ride
+                    # through here), so programming errors must not bypass it
+                    faults.note_failure("rebuild", exc)
                     failed_scripts.add(launch.script_id)
                     if launch.policy == ErrorPolicy.deregister:
                         self._engine.disable_coprocessors([launch.script_id])
@@ -568,8 +729,41 @@ class TpuEngine:
         force_mode: str | None = None,
         host_workers: int | None = None,
         host_pool_probe: bool = True,
+        device_deadline_ms: int | None = None,
+        launch_retries: int | None = None,
+        retry_backoff_ms: int | None = None,
+        breaker_threshold: int | None = None,
+        breaker_cooldown_ms: int | None = None,
     ):
         self._handles: dict[int, ScriptHandle] = {}
+        # fault domains: every device interaction runs under this envelope
+        # (per-attempt deadline, bounded retry + backoff), and the breaker
+        # demotes the whole engine to host execution after consecutive
+        # failures (coproc/faults.py; config coproc_device_deadline_ms etc.)
+        self._fault_policy = faults.FaultPolicy(
+            deadline_s=(
+                device_deadline_ms if device_deadline_ms is not None else 30_000
+            ) / 1000.0,
+            retries=launch_retries if launch_retries is not None else 2,
+            backoff_s=(
+                retry_backoff_ms if retry_backoff_ms is not None else 50
+            ) / 1000.0,
+        )
+        self._breaker = faults.CircuitBreaker(
+            threshold=breaker_threshold if breaker_threshold is not None else 5,
+            cooldown_s=(
+                breaker_cooldown_ms if breaker_cooldown_ms is not None else 30_000
+            ) / 1000.0,
+            # a legitimate half-open probe runs a full retry envelope; the
+            # stale-probe release must outwait it or a slow probe gets a
+            # second probe stacked onto the same struggling device
+            probe_timeout_s=max(
+                (breaker_cooldown_ms if breaker_cooldown_ms is not None else 30_000)
+                / 1000.0,
+                2.0 * self._fault_policy.envelope_s(),
+            ),
+        )
+        probes.register_breaker(self._breaker)
         self._row_stride = row_stride
         self._compress_threshold = compress_threshold
         self._output_codec = output_codec
@@ -619,18 +813,67 @@ class TpuEngine:
                 self._harvester.start()
             return self._harvester
 
+    def shutdown(self) -> None:
+        """Stop the engine's background machinery: the mask-harvester
+        thread (sentinel + join) and the host-stage pool. In-flight
+        launches drain first (the sentinel queues behind them). A daemon
+        harvester pins the whole engine — plans, jit executables, staged
+        arrays — for the life of the process otherwise, which long-lived
+        embedders (and test suites creating many engines) cannot afford.
+        The engine must not process batches after shutdown."""
+        with self._stats_lock:
+            t, self._harvester = self._harvester, None
+        if t is not None and t.is_alive():
+            self._harvest_q.put(None)
+            t.join(timeout=60.0)
+        if self._host_pool is not None:
+            self._host_pool.shutdown()
+
     def _harvest_loop(self) -> None:
         while True:
             launch = self._harvest_q.get()
+            if launch is None:  # shutdown sentinel
+                return
+            with _mask_claim_lock:
+                if launch._mask_state in ("claimed", "abandoned"):
+                    # claimed: its caller gave up waiting and is fetching
+                    # the mask itself; abandoned: a degraded sharded launch
+                    # orphaned it. Either way a fetch here would be a
+                    # duplicate envelope and a stale breaker verdict.
+                    continue
+                launch._mask_state = "harvesting"
             t_get = time.perf_counter()
+            dev = launch._mask_dev
             try:
-                if launch._mask_dev is not None:
-                    launch._mask_np = np.asarray(launch._mask_dev)  # pandalint: disable=ENG502 -- dedicated harvester thread; paying the D2H sync off the event loop is its entire job
-            except Exception:
+                if dev is not None:
+                    def leg(dev=dev):
+                        faults.inject(faults.HARVEST)
+                        # the fetch worker pays the D2H sync; this thread
+                        # only coordinates, so a wedged link can no longer
+                        # freeze every later launch's mask behind it
+                        return np.asarray(dev)
+
+                    launch._mask_np = faults.retry_call(
+                        leg, self._fault_policy, faults.HARVEST,
+                        count=self._stat_add,
+                    )
+                    self._breaker.record_success()
+            except Exception as exc:
                 launch._mask_np = None  # materialize() falls back
+                # classified, never fatal: this daemon serves every launch
+                # and _resolve_keep owns the per-launch fallback decision.
+                # The verdict lands BEFORE the event below: a caller woken
+                # by the event must observe the breaker state this failure
+                # produced, not a stale snapshot. A PROGRAMMING error is
+                # counted but gives no breaker verdict — a bug in our code
+                # must not quietly demote the engine to host forever (and
+                # re-raising would kill the daemon every launch depends on).
+                faults.note_failure(faults.HARVEST, exc)
+                if not isinstance(exc, faults.PROGRAMMING_ERRORS):
+                    self._breaker.record_failure()
             finally:
                 t_done = time.perf_counter()
-                # device-time span: the asarray completes the async D2H, so
+                # device-time span: the fetch completes the async D2H, so
                 # its wall time is the post-block_until_ready device leg;
                 # queue_us is how long the launch waited for this thread.
                 tracer.record(
@@ -675,7 +918,10 @@ class TpuEngine:
                         spec, self._row_stride
                     )
                 self._plans[script_id] = plan
-            except Exception:
+            except Exception as exc:
+                # bad spec from the wire, not a broker fault: refuse the
+                # registration and account the rejection
+                faults.note_failure("enable", exc)
                 out.append(EnableResponseCode.internal_error)
                 continue
             self._handles[script_id] = ScriptHandle(
@@ -728,11 +974,13 @@ class TpuEngine:
             return EnableResponseCode.script_contains_no_topics
         try:
             fn = compile_transform(source)
-        except SandboxViolation:
+        except SandboxViolation as exc:
+            faults.note_failure(faults.SANDBOX_COMPILE, exc)
             return EnableResponseCode.internal_error
-        except Exception:
+        except Exception as exc:
             # any other compile-time blowup is a bad script, not a broker
             # fault — refuse registration rather than poison the caller
+            faults.note_failure(faults.SANDBOX_COMPILE, exc)
             logger.exception("sandboxed script %d failed to compile", script_id)
             return EnableResponseCode.internal_error
         return self.enable_py_transform(script_id, fn, topics, policy)
@@ -766,6 +1014,7 @@ class TpuEngine:
         with self._stats_lock:
             out = dict(self._stats)
         out["host_workers"] = float(self._host_workers)
+        out["breaker"] = self._breaker.snapshot()
         if self._host_pool_probe is not None:
             out["host_pool_probe"] = dict(self._host_pool_probe)
         if TpuEngine._columnar_probe is not None:
@@ -804,6 +1053,43 @@ class TpuEngine:
                 probes.coproc_h2d_bytes.inc(v)
             elif key == "bytes_d2h":
                 probes.coproc_d2h_bytes.inc(v)
+
+    def _count_fallback(self, n: int) -> None:
+        """Account records whose stages re-executed on the pure-host
+        fallback (exhausted device retries or an open breaker)."""
+        self._stat_add("n_fallback_rows", float(n))
+        probes.coproc_fallback_rows.inc(n)
+
+    def _abandon_pending_masks(self, launch: _Launch) -> None:
+        """Mark a degraded sharded launch's still-queued shard masks
+        abandoned (the harvester skips them: no fetch, no verdict). A mask
+        already being harvested keeps its in-flight verdict — that device
+        interaction genuinely happened."""
+        with launch._lock:
+            slots, launch._pending_slots = launch._pending_slots, []
+        with _mask_claim_lock:
+            for slot in slots:
+                if slot._mask_state == "queued":
+                    slot._mask_state = "abandoned"
+
+    def _try_device_leg(self, domain: str, leg):
+        """One device leg under the engine's fault envelope: per-attempt
+        deadline + bounded retry (faults.retry_call), classified failure
+        accounting, and a breaker failure verdict on exhaustion. Returns
+        the leg's value, or None after exhausted retries — the call site
+        supplies its exact host fallback and, where the leg's success IS
+        the device verdict (harvest/fetch legs), records the success.
+        Every leg returns an array, so None is an unambiguous sentinel.
+        This is THE shape of a fault-tolerant device interaction; keeping
+        it in one place keeps the breaker verdicts exhaustive."""
+        try:
+            return faults.retry_call(
+                leg, self._fault_policy, domain, count=self._stat_add
+            )
+        except Exception as exc:
+            faults.note_failure(domain, exc, reraise_programming=True)
+            self._breaker.record_failure()
+            return None
 
     def heartbeat(self) -> int:
         """Returns the number of registered scripts (liveness probe)."""
@@ -858,6 +1144,12 @@ class TpuEngine:
                     ridx += len(item.batches)
                     ticket._slots[slot_idx] = (_LAUNCHED, item, launch, rng)
             except Exception as exc:
+                # classified: a dispatch blow-up emptying a launch's output
+                # must never be invisible (a swallowed AttributeError here
+                # once surfaced only as empty replies); programming errors
+                # re-raise — the tick fails loudly and retries, instead of
+                # the script silently dropping every record
+                faults.note_failure("dispatch", exc, reraise_programming=True)
                 if handle.policy == ErrorPolicy.deregister:
                     self.disable_coprocessors([script_id])
                     for ticket, slot_idx, item in entries:
@@ -1009,6 +1301,17 @@ class TpuEngine:
                 use_host = TpuEngine._columnar_backend == "host"
             else:
                 return False
+        breaker_demoted_rows = 0
+        if plan.mode == "columnar" and plan.dev_cols and use_host is False:
+            if not self._breaker.allow_device():
+                # open breaker demotes the whole sharded launch to the
+                # exact numpy predicate (identical bits per shard). Rows
+                # are COUNTED only after the fan-out commits: a shard
+                # fault degrades this launch to the inline path, which
+                # counts its own demotion — counting here too would
+                # double n_fallback_rows for the same records.
+                use_host = True
+                breaker_demoted_rows = sum(counts)
         if plan.mode == "columnar":
             if use_host is False:
                 # compile in THIS thread before fan-out: plan._fn_cache is
@@ -1017,15 +1320,32 @@ class TpuEngine:
                 plan.compile_device(None)
             paths = plan.flat_paths()
             t0 = time.perf_counter()
-            shards = pool.run([
-                (
-                    lambda i=i, s=s, e=e: self._run_columnar_shard(
-                        i, launch, plan, all_batches[s:e], paths, use_host
+            try:
+                shards = pool.run([
+                    (
+                        lambda i=i, s=s, e=e: self._run_columnar_shard(
+                            i, launch, plan, all_batches[s:e], paths, use_host
+                        )
                     )
+                    for i, (s, e) in enumerate(parts)
+                ])
+            except Exception as exc:
+                # fail closed per-launch: a faulted shard worker degrades
+                # this launch to the inline path, which re-executes every
+                # stage launch-wide from the original batches (exact output,
+                # nothing lost or duplicated — nothing was emitted yet).
+                # Sibling shards may have already enqueued device masks:
+                # abandon them, or each orphan costs the harvester a full
+                # envelope and feeds the breaker verdicts for a launch
+                # that no longer exists.
+                faults.note_failure(
+                    faults.SHARD_WORKER, exc, reraise_programming=True
                 )
-                for i, (s, e) in enumerate(parts)
-            ])
+                self._abandon_pending_masks(launch)
+                return False
             self._stat_add("t_sharded_dispatch", time.perf_counter() - t0)
+            if breaker_demoted_rows:
+                self._count_fallback(breaker_demoted_rows)
             launch._shards = shards
             launch.r_out = plan.r_out
             n = 0
@@ -1041,12 +1361,18 @@ class TpuEngine:
             # (merge_exploded rebases offsets/ranges) so the existing
             # device staging / host materialize paths run unchanged.
             t0 = time.perf_counter()
-            exploded = batch_codec.merge_exploded(
-                pool.run([
-                    (lambda s=s, e=e: batch_codec.explode_batches(all_batches[s:e]))
-                    for s, e in parts
-                ])
-            )
+            try:
+                exploded = batch_codec.merge_exploded(
+                    pool.run([
+                        (lambda s=s, e=e: _explode_shard(all_batches[s:e]))
+                        for s, e in parts
+                    ])
+                )
+            except Exception as exc:
+                faults.note_failure(
+                    faults.SHARD_WORKER, exc, reraise_programming=True
+                )
+                return False  # degrade this launch to the inline path
             self._stat_add("t_explode", time.perf_counter() - t0)
             launch.ranges = exploded.ranges
             n = len(exploded.sizes)
@@ -1082,6 +1408,10 @@ class TpuEngine:
         extraction. Touches only its own shard (SHD6xx)."""
         shard = _HostShard()
         t_shard0 = time.perf_counter()
+        # shard-worker fault domain: a fault here (injected or real) fails
+        # the fan-out, and _dispatch_sharded degrades the LAUNCH to the
+        # inline path — stages re-execute launch-wide with exact output
+        faults.inject(faults.SHARD_WORKER)
 
         def stage(key: str, t0: float) -> None:
             dt = time.perf_counter() - t0
@@ -1127,17 +1457,33 @@ class TpuEngine:
                 slot._mask_np = plan.eval_host_mask(cols)
                 stage("t_dispatch", t0)
             else:
-                fn = plan.compile_device(None)
-                mask = fn(*cols)
-                mask.copy_to_host_async()
-                stage("t_dispatch", t0)
-                self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
-                self._stat_add("bytes_d2h", n_pad // 8)
-                slot._mask_dev = mask
-                slot._mask_event = threading.Event()
-                self._ensure_harvester()
-                slot._enq_t = time.perf_counter()
-                self._harvest_q.put(slot)
+                def leg():
+                    faults.inject(faults.DEVICE_DISPATCH)
+                    fn = plan.compile_device(None)
+                    mask = fn(*cols)
+                    mask.copy_to_host_async()
+                    return mask
+
+                mask = self._try_device_leg(faults.DEVICE_DISPATCH, leg)
+                if mask is None:
+                    # this shard's exact host fallback; sibling shards keep
+                    # their own device launches
+                    slot._mask_np = plan.eval_host_mask(cols)
+                    stage("t_dispatch", t0)
+                    self._count_fallback(n)
+                else:
+                    stage("t_dispatch", t0)
+                    self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
+                    self._stat_add("bytes_d2h", n_pad // 8)
+                    slot._mask_dev = mask
+                    slot._cols = cols
+                    slot._mask_event = threading.Event()
+                    slot._mask_state = "queued"
+                    with launch._lock:
+                        launch._pending_slots.append(slot)
+                    self._ensure_harvester()
+                    slot._enq_t = time.perf_counter()
+                    self._harvest_q.put(slot)
             shard.mask = slot
         t0 = time.perf_counter()
         if plan.passthrough:
@@ -1172,10 +1518,27 @@ class TpuEngine:
         n_pad = _bucket_rows(n)
         staged = self._pack_staged(exploded, n_pad)
         self._stat_add("t_pack", time.perf_counter() - t0)
+        # retained until the packed result lands: the host fallback re-runs
+        # the pipeline on the CPU backend over exactly these rows
+        launch._staged_np = staged
         t0 = time.perf_counter()
-        dev = jax.device_put(staged)
-        packed = fn(dev)
-        packed.copy_to_host_async()
+        if not self._breaker.allow_device():
+            launch._packed_dev = launch._payload_host_fallback()
+            self._stat_add("t_dispatch", time.perf_counter() - t0)
+            return
+
+        def leg():
+            faults.inject(faults.DEVICE_DISPATCH)
+            dev = jax.device_put(staged)
+            packed = fn(dev)
+            packed.copy_to_host_async()
+            return packed
+
+        packed = self._try_device_leg(faults.DEVICE_DISPATCH, leg)
+        if packed is None:
+            launch._packed_dev = launch._payload_host_fallback()
+            self._stat_add("t_dispatch", time.perf_counter() - t0)
+            return
         self._stat_add("t_dispatch", time.perf_counter() - t0)
         self._stat_add("bytes_h2d", staged.nbytes)
         self._stat_add("bytes_d2h", n_pad * (r_out + 8))
@@ -1217,24 +1580,44 @@ class TpuEngine:
                         use_host = True
                 else:
                     use_host = TpuEngine._columnar_backend == "host"
+            breaker_demoted = False
+            if not use_host and not self._breaker.allow_device():
+                # open breaker: the whole launch stays on the exact numpy
+                # predicate over the same columns — identical bits, no
+                # device touch until the half-open probe re-admits it
+                use_host = breaker_demoted = True
             t0 = time.perf_counter()
             if use_host:
                 # measured-host predicate: SAME extracted columns, numpy —
                 # what the probe (or the bench ablation) picked on this link
                 launch._mask_np = plan.eval_host_mask(cols)
                 self._stat_add("t_dispatch", time.perf_counter() - t0)
+                if breaker_demoted:
+                    self._count_fallback(n)
             else:
-                fn = plan.compile_device(self._mesh)
-                mask = fn(*cols)
-                mask.copy_to_host_async()
-                self._stat_add("t_dispatch", time.perf_counter() - t0)
-                self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
-                self._stat_add("bytes_d2h", n_pad // 8)
-                launch._mask_dev = mask
-                launch._mask_event = threading.Event()
-                self._ensure_harvester()
-                launch._enq_t = time.perf_counter()
-                self._harvest_q.put(launch)
+                def leg():
+                    faults.inject(faults.DEVICE_DISPATCH)
+                    fn = plan.compile_device(self._mesh)
+                    mask = fn(*cols)
+                    mask.copy_to_host_async()
+                    return mask
+
+                mask = self._try_device_leg(faults.DEVICE_DISPATCH, leg)
+                if mask is None:
+                    launch._mask_np = plan.eval_host_mask(cols)
+                    self._stat_add("t_dispatch", time.perf_counter() - t0)
+                    self._count_fallback(n)
+                else:
+                    self._stat_add("t_dispatch", time.perf_counter() - t0)
+                    self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
+                    self._stat_add("bytes_d2h", n_pad // 8)
+                    launch._mask_dev = mask
+                    launch._cols = cols
+                    launch._mask_event = threading.Event()
+                    launch._mask_state = "queued"
+                    self._ensure_harvester()
+                    launch._enq_t = time.perf_counter()
+                    self._harvest_q.put(launch)
         # Projection extraction overlaps the device launch.
         t0 = time.perf_counter()
         if plan.passthrough:
@@ -1251,38 +1634,31 @@ class TpuEngine:
     def _probe_columnar_backend(self, plan, cols) -> None:
         """One-time process-wide probe: run the SAME predicate over the SAME
         columns on the device (compile + fetch warmup, then a timed
-        launch+fetch) and in numpy; keep the faster. The device leg runs in
-        a daemon thread with a deadline because a wedged device link HANGS
-        inside the fetch rather than raising — on timeout (or no device /
-        compile error) the probe falls back to host and the stuck thread is
-        abandoned (one thread per process worst case)."""
+        launch+fetch) and in numpy; keep the faster. The device leg runs on
+        the shared abandonable fetch pool (coproc/faults.py) with a deadline
+        because a wedged device link HANGS inside the fetch rather than
+        raising — on timeout (or no device / compile error) the probe falls
+        back to host. A wedged worker is abandoned; one that merely finishes
+        LATE discards its stale timing and rejoins the pool, so repeated
+        probes cannot grow threads."""
         import time as _t
 
         t0 = _t.perf_counter()
         plan.eval_host_mask(cols)
         t_host = _t.perf_counter() - t0
 
-        result_q: "queue.Queue[float]" = queue.Queue()
+        def _device_leg() -> float:
+            fn = plan.compile_device(None)
+            np.asarray(fn(*cols))  # compile + first-launch warmup
+            t1 = _t.perf_counter()
+            np.asarray(fn(*cols))  # steady-state launch + fetch
+            return _t.perf_counter() - t1
 
-        def _device_leg() -> None:
-            try:
-                fn = plan.compile_device(None)
-                np.asarray(fn(*cols))  # compile + first-launch warmup
-                t1 = _t.perf_counter()
-                np.asarray(fn(*cols))  # steady-state launch + fetch
-                result_q.put(_t.perf_counter() - t1)
-            except Exception:
-                result_q.put(float("inf"))
-
-        # a plain DAEMON thread, not an executor: concurrent.futures joins
-        # its workers at interpreter exit, so a wedged device fetch would
-        # hang process shutdown — a daemon thread is truly abandonable
-        threading.Thread(
-            target=_device_leg, name="rptpu-columnar-probe", daemon=True
-        ).start()
         try:
-            t_dev = result_q.get(timeout=_PROBE_DEVICE_TIMEOUT_S)
-        except queue.Empty:  # wedged link: the thread is abandoned
+            t_dev = faults.fetch_with_deadline(
+                _device_leg, _PROBE_DEVICE_TIMEOUT_S
+            )
+        except Exception:  # wedged (deadline) / no device / compile error
             t_dev = float("inf")
         TpuEngine._columnar_backend = (
             "device" if t_dev * _PROBE_DEVICE_MARGIN < t_host else "host"

@@ -23,6 +23,7 @@ import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from redpanda_tpu.coproc import faults
 from redpanda_tpu.coproc.engine import (
     ProcessBatchItem,
     ProcessBatchRequest,
@@ -71,20 +72,41 @@ class ScriptContext:
 
     async def _loop(self) -> None:
         """do_execute (script_context.cc:66): run ticks until cancelled;
-        jittered idle sleep when no input advanced."""
+        jittered idle sleep when no input advanced, exponential backoff on
+        consecutive tick failures (a dead engine must not busy-spin reads).
+
+        The retry posture IS the loop: a failed/timed-out tick advanced no
+        offsets and wrote nothing, so the next tick re-reads the same
+        records — bounded only by backoff, never by a give-up that would
+        strand input."""
         pm = self.pacemaker
+        failures = 0
         while True:
             try:
                 moved = await self.tick()
+                failures = 0
             except asyncio.CancelledError:
                 raise
             except _StopScript:
                 return
-            except Exception:
-                logger.exception("script %s tick failed", self.name)
+            except Exception as exc:
+                failures += 1
+                faults.note_failure("pacemaker_tick", exc)
+                if failures == 1:
+                    logger.exception("script %s tick failed", self.name)
+                else:
+                    logger.debug(
+                        "script %s tick failed again (%d consecutive): %r",
+                        self.name, failures, exc,
+                    )
                 moved = False
             if not moved:
-                await asyncio.sleep(pm.idle_sleep_s)
+                delay = pm.idle_sleep_s
+                if failures:
+                    delay = min(
+                        pm.idle_sleep_s * (2 ** min(failures, 7)), 5.0
+                    )
+                await asyncio.sleep(delay)
 
     async def tick(self) -> bool:
         """One read → transform → write round; True if any offset moved.
@@ -121,10 +143,22 @@ class ScriptContext:
             loop = asyncio.get_running_loop()
             req = ProcessBatchRequest(items, trace_id=tick_span.trace_id)
             ex = pm.engine_executor
+            # tick deadline: the engine's internal deadlines bound every
+            # device leg, so these only fire when that machinery is itself
+            # wedged. A timed-out executor call is ABANDONED, not retried
+            # in place: its ticket is never harvested, so nothing is
+            # written (no duplicates), and the un-advanced offsets make the
+            # next tick re-read the same records (no loss).
             with tracer.span("coproc.submit.wait"):
-                ticket = await loop.run_in_executor(ex, pm.engine.submit, req)
+                ticket = await asyncio.wait_for(
+                    loop.run_in_executor(ex, pm.engine.submit, req),
+                    timeout=pm.tick_deadline_s,
+                )
             with tracer.span("coproc.harvest.wait"):
-                reply = await loop.run_in_executor(ex, ticket.result)
+                reply = await asyncio.wait_for(
+                    loop.run_in_executor(ex, ticket.result),
+                    timeout=pm.tick_deadline_s,
+                )
             if self.script_id in reply.deregistered:
                 logger.warning("script %s deregistered by engine policy", self.name)
                 pm.detach_script(self.name)
@@ -193,10 +227,12 @@ class Pacemaker:
         max_inflight_reads: int = 8,
         offset_flush_interval_s: float = 5.0,
         idle_sleep_s: float = 0.05,
+        tick_deadline_s: float = 120.0,
     ) -> None:
         self.broker = broker
         self.engine = engine
         self.max_batch_size = max_batch_size
+        self.tick_deadline_s = tick_deadline_s
         self.read_sem = asyncio.Semaphore(max_inflight_reads)
         self.offset_flush_interval_s = offset_flush_interval_s
         self.idle_sleep_s = idle_sleep_s
@@ -296,7 +332,7 @@ class Pacemaker:
                             OP_CREATE_NON_REPLICABLE,
                         )
 
-                        await dispatcher.topic_op(
+                        await dispatcher.topic_op(  # pandalint: disable=LCK702 -- create-once-per-mntp mutex: a serialized tick beats duplicate create ops racing the controller
                             OP_CREATE_NON_REPLICABLE,
                             {"source": source.topic, "name": mntp.topic,
                              "ns": mntp.ns},

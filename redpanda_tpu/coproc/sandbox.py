@@ -200,6 +200,11 @@ def compile_transform(source: str):
 
     Each call runs under a line-budget trace; the returned callable raises
     SandboxBudgetExceeded when a record overruns EXEC_LINE_BUDGET."""
+    from redpanda_tpu.coproc import faults
+
+    # fault domain: a poisoned compile must refuse registration, not take
+    # the broker down — the chaos suite drives this via the armed probe
+    faults.inject(faults.SANDBOX_COMPILE)
     tree = validate_source(source)
     code = compile(tree, "<coproc-sandbox>", "exec")
     glb: dict = {"__builtins__": {}}

@@ -1,26 +1,40 @@
 """Failure-injection registry ("honey badger").
 
 Parity with finjector/hbadger.h:23-60: subsystems register named probes;
-tests (or the admin API) arm a probe on a module with one of three effects —
-raise an exception, delay, or terminate (here: raise SystemExit, since we
-have no per-shard process to kill). The reference compiles probes out of
-release builds (hbadger.h:30-37); here arming is a no-op unless
-``honey_badger.enable()`` was called, so production paths stay branch-cheap.
+tests (or the admin API) arm a probe on a module with one of four effects —
+raise an exception, delay, wedge (block at the site until disarmed or
+``wedge_max_s``, simulating a hung device fetch / dead link), or terminate
+(here: raise SystemExit, since we have no per-shard process to kill). The
+reference compiles probes out of release builds (hbadger.h:30-37); here
+arming is a no-op unless ``honey_badger.enable()`` was called, so
+production paths stay branch-cheap (the breaker_overhead microbench gates
+the disabled check at <1% of the coproc launch path).
 
-Per-RPC-method probes are generated alongside services (tools/rpcgen.py:
-159-165 renders a failure_probes struct per service); rpc.service mirrors
-that by registering ``<service>.<method>`` probes automatically.
+Admin wiring: ``GET /v1/failure-probes`` lists registered modules/probes
+and what is currently armed; ``PUT /v1/failure-probes/{module}/{probe}/
+{exception|delay|wedge|terminate}`` arms (enabling the registry first) and
+``DELETE /v1/failure-probes/{module}/{probe}`` disarms — surfaced by
+``rpk debug failpoints``. The coproc fault domains (device dispatch, mask
+fetch, harvest, shard worker, sandbox compile) register in
+coproc/faults.py; per-RPC-method probes are generated alongside services
+(tools/rpcgen.py:159-165 renders a failure_probes struct per service) and
+rpc.service mirrors that by registering ``<service>.<method>`` probes
+automatically; the transport layer registers ``rpc.send``.
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import defaultdict
 from dataclasses import dataclass, field
 
 EXCEPTION = "exception"
 DELAY = "delay"
 TERMINATE = "terminate"
+WEDGE = "wedge"
+
+EFFECTS = (EXCEPTION, DELAY, WEDGE, TERMINATE)
 
 
 class ProbeTriggered(Exception):
@@ -38,6 +52,11 @@ class HoneyBadger:
         self._enabled = False
         self._modules: dict[str, _Module] = defaultdict(_Module)
         self.delay_ms = 50
+        # A wedge simulates an indefinite hang, but an orphaned wedge (the
+        # operator forgot to disarm) must not hold a broker thread forever:
+        # the site blocks until the probe is disarmed OR this cap elapses.
+        # Tests lower it to keep deadline-abandonment runs fast.
+        self.wedge_max_s = 2.0
 
     def enable(self) -> None:
         self._enabled = True
@@ -57,6 +76,14 @@ class HoneyBadger:
     def modules(self) -> dict[str, list[str]]:
         return {name: sorted(m.probes) for name, m in self._modules.items()}
 
+    def armed(self) -> dict[str, dict[str, str]]:
+        """module -> {probe: effect} for every currently-armed probe."""
+        return {
+            name: dict(m.armed)
+            for name, m in self._modules.items()
+            if m.armed
+        }
+
     def set_exception(self, module: str, probe: str) -> None:
         self._arm(module, probe, EXCEPTION)
 
@@ -66,13 +93,26 @@ class HoneyBadger:
     def set_termination(self, module: str, probe: str) -> None:
         self._arm(module, probe, TERMINATE)
 
+    def set_wedge(self, module: str, probe: str) -> None:
+        self._arm(module, probe, WEDGE)
+
     def unset(self, module: str, probe: str) -> None:
-        self._modules[module].armed.pop(probe, None)
+        # plain lookup, not the defaultdict: disarming a typo'd name must
+        # not conjure a phantom module entry into modules()/armed()
+        m = self._modules.get(module)
+        if m is not None:
+            m.armed.pop(probe, None)
 
     def _arm(self, module: str, probe: str, effect: str) -> None:
         if not self._enabled:
             return
         self._modules[module].armed[probe] = effect
+
+    def _wedged(self, module: str, probe: str) -> bool:
+        return (
+            self._enabled
+            and self._modules[module].armed.get(probe) == WEDGE
+        )
 
     async def maybe_inject(self, module: str, probe: str) -> None:
         """Await point placed at each probe site."""
@@ -85,11 +125,15 @@ class HoneyBadger:
             await asyncio.sleep(self.delay_ms / 1000)
         elif effect == EXCEPTION:
             raise ProbeTriggered(f"{module}.{probe}")
+        elif effect == WEDGE:
+            deadline = time.monotonic() + self.wedge_max_s
+            while time.monotonic() < deadline and self._wedged(module, probe):
+                await asyncio.sleep(0.01)
         elif effect == TERMINATE:
             raise SystemExit(f"honey badger terminate: {module}.{probe}")
 
     def inject_sync(self, module: str, probe: str) -> None:
-        """Synchronous probe site (storage paths)."""
+        """Synchronous probe site (storage paths, coproc device legs)."""
         if not self._enabled:
             return
         effect = self._modules[module].armed.get(probe)
@@ -101,9 +145,14 @@ class HoneyBadger:
             # deliberate BLOCKING sleep: a delay fault at a sync site must
             # actually delay (stalling the loop is the injected fault —
             # this only ever runs with the badger explicitly enabled)
-            import time
-
             time.sleep(self.delay_ms / 1000)
+        elif effect == WEDGE:
+            # block like a hung device fetch until disarmed (or the cap):
+            # this is what the engine's per-attempt deadlines must cut
+            # through by abandoning the wedged worker
+            deadline = time.monotonic() + self.wedge_max_s
+            while time.monotonic() < deadline and self._wedged(module, probe):
+                time.sleep(0.01)
 
 
 honey_badger = HoneyBadger()

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 
 from redpanda_tpu.metrics import Counter, Histogram, registry
 
@@ -61,6 +62,72 @@ coproc_launch_rows_hist = registry.histogram(
 coproc_shard_rows_hist = registry.histogram(
     "coproc_shard_rows",
     "Records per host-stage shard (coproc_host_workers fan-out)",
+)
+
+# -------------------------------------------------------- coproc fault domains
+# Classified failure counter, one series per (fault domain, exception kind):
+# every formerly-silent except block in the engine reports here, so no
+# degradation path is invisible on /metrics. Locked check-then-create for
+# the same reason as coproc_stage_hist.
+_failure_counters: dict[tuple[str, str], Counter] = {}
+_failure_lock = threading.Lock()
+
+
+def coproc_failure_counter(domain: str, kind: str) -> Counter:
+    key = (domain, kind)
+    c = _failure_counters.get(key)
+    if c is None:
+        with _failure_lock:
+            c = _failure_counters.get(key)
+            if c is None:
+                c = registry.counter(
+                    "coproc_failures_total",
+                    "Classified coproc failures by fault domain",
+                    domain=domain,
+                    kind=kind,
+                )
+                _failure_counters[key] = c
+    return c
+
+
+coproc_breaker_trips = registry.counter(
+    "coproc_breaker_trips_total",
+    "Device circuit breaker transitions to open",
+)
+coproc_retries_total = registry.counter(
+    "coproc_device_retries_total",
+    "Device interaction retry attempts (deadline/launch failures)",
+)
+coproc_fallback_rows = registry.counter(
+    "coproc_fallback_rows_total",
+    "Records whose transform stages re-executed on the pure-host fallback",
+)
+
+# Breaker-state gauge: breakers are per-engine while the registry is
+# process-wide, so the gauge follows the most recently constructed engine's
+# breaker (the broker has exactly one; bench/test engines hand over on
+# construction). Weakref: a dead bench engine must not pin its breaker.
+_breaker_ref: "weakref.ref | None" = None
+
+
+def register_breaker(breaker) -> None:
+    global _breaker_ref
+    _breaker_ref = weakref.ref(breaker)
+
+
+def _breaker_state_value() -> float:
+    b = _breaker_ref() if _breaker_ref is not None else None
+    if b is None:
+        return -1.0
+    from redpanda_tpu.coproc.faults import STATE_NUM
+
+    return STATE_NUM.get(b.state, -1.0)
+
+
+coproc_breaker_state = registry.gauge(
+    "coproc_breaker_state",
+    _breaker_state_value,
+    "Device circuit breaker state (0 closed, 1 open, 2 half_open, -1 none)",
 )
 
 # ------------------------------------------------------ host-stage pool
@@ -125,12 +192,18 @@ def observe_us(hist: Histogram, t0: float) -> None:
 __all__ = [
     "Counter",
     "Histogram",
+    "coproc_breaker_state",
+    "coproc_breaker_trips",
     "coproc_d2h_bytes",
+    "coproc_failure_counter",
+    "coproc_fallback_rows",
     "coproc_h2d_bytes",
     "coproc_host_pool_busy",
     "coproc_launch_rows_hist",
+    "coproc_retries_total",
     "coproc_shard_rows_hist",
     "coproc_stage_hist",
+    "register_breaker",
     "host_pool_task_finished",
     "host_pool_task_started",
     "kafka_fetch_hist",

@@ -17,12 +17,18 @@ import itertools
 import logging
 import time
 
+from redpanda_tpu.finjector import honey_badger
 from redpanda_tpu.hashing.jump import jump_consistent_hash
 from redpanda_tpu.observability import probes
 from redpanda_tpu.observability.trace import tracer
 from redpanda_tpu.rpc import wire
 
 logger = logging.getLogger("rpc.transport")
+
+# transport-level failure probe: one site below every per-method probe
+# (rpc.service registers <service>.<method>), so chaos runs can fault the
+# WIRE itself — exception/delay/wedge on any outbound send
+honey_badger.register_probe("rpc", "send")
 
 
 class RpcError(Exception):
@@ -87,6 +93,10 @@ class Transport:
         self._writer = None
 
     async def send(self, method_id: int, payload: bytes, timeout: float | None = None) -> bytes:
+        if honey_badger.enabled:  # keep the disabled hot path to one check,
+            # not a coroutine allocation per outbound RPC (hbadger.h:30-37
+            # compiles probes out of release builds; this is our analogue)
+            await honey_badger.maybe_inject("rpc", "send")
         if self._writer is None:
             raise TransportClosed("not connected")
         corr = next(self._corr)

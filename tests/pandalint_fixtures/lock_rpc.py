@@ -1,0 +1,33 @@
+"""Fixture: network RPC awaited while holding an asyncio.Lock (LCK70x)."""
+import asyncio
+
+_lock = asyncio.Lock()
+
+
+async def bad_send(self, transport, payload):
+    async with _lock:
+        await transport.send(1, payload)
+        await self.connections.get(3).send_request(2, payload)
+        await peer.invoke_on(0, "method", payload)
+
+
+async def bad_dispatch(self, dispatcher):
+    async with self._materialized_lock:
+        await dispatcher.topic_op(7, {"name": "t"})
+    with self._mutex:
+        await self.partition.replicate([1], 2)
+
+
+async def ok_paths(self, transport, payload):
+    async with _lock:
+        total = sum(payload)  # pure computation under the lock: fine
+        await asyncio.sleep(0)  # an await, but not an RPC
+    await transport.send(1, payload)  # RPC, but the lock was dropped
+
+    async def helper():
+        # nested def: its body runs later, in its own (unlocked) context
+        await transport.send(2, payload)
+
+    async with self._sem:  # a semaphore is not a lock to this checker
+        await transport.send(3, payload)
+    return total

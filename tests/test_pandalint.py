@@ -122,6 +122,31 @@ def test_cross_shard_scoped_to_coproc(tmp_path):
         assert any(f.rule.startswith("SHD") for f in report.findings) is expect, sub
 
 
+def test_lock_rpc_rules_exact_lines():
+    got = _active(_lint(os.path.join(FIXTURES, "lock_rpc.py")))
+    assert got == [
+        ("LCK701", 9),
+        ("LCK701", 10),
+        ("LCK701", 11),
+        ("LCK702", 16),
+        ("LCK702", 18),
+    ]
+
+
+def test_lock_rpc_scope_is_package_wide(tmp_path):
+    """Locks and RPC can meet anywhere in the broker; a violation injected
+    in ANY subtree must fail the gate (default scope = whole package)."""
+    for sub in ("raft", "cluster", "kafka"):
+        pkg = tmp_path / "redpanda_tpu" / sub
+        pkg.mkdir(parents=True)
+        dst = pkg / "lr.py"
+        shutil.copyfile(os.path.join(FIXTURES, "lock_rpc.py"), dst)
+        report = LintEngine(Config()).lint_file(
+            str(dst), f"redpanda_tpu/{sub}/lr.py"
+        )
+        assert any(f.rule.startswith("LCK") for f in report.findings), sub
+
+
 def test_iobuf_rules_exact_lines():
     got = _active(_lint(os.path.join(FIXTURES, "copy_loop.py")))
     assert got == [

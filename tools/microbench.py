@@ -333,6 +333,135 @@ def _bench_tracer_overhead_disabled(secs: float) -> dict:
     }
 
 
+def bench_breaker_overhead(secs: float) -> dict:
+    """Cost of the fault machinery on the UNFAULTED coproc launch path.
+
+    A healthy launch pays, per device leg: one closed-breaker
+    ``allow_device()`` (a lock + two compares), one disabled honey-badger
+    ``inject()`` (an attribute check), one ``record_success()``, and the
+    ``retry_call`` envelope around the leg. The headline
+    ``breaker_overhead_pct`` is DERIVED the same way as the tracer bench
+    (wall-clock A/B cannot resolve sub-1% on a shared box): min-of-blocks
+    per-call cost of the checks alone, times a deliberately conservative
+    per-launch check count, over the min-of-blocks cost of a real
+    columnar launch. The checks are strictly additive straight-line code,
+    so the quotient IS their share of the hot path.
+
+    The abandonable-fetch envelope (``fetch_envelope_us``) is reported
+    separately and informationally: it prices the thread handoff a
+    DEADLINE-BEARING device leg pays, which is per-launch, bounded, and a
+    deliberate trade for wedge immunity — not part of the closed-breaker
+    + disabled-badger budget the <1% gate covers."""
+    import json as _json
+
+    from redpanda_tpu.coproc import TpuEngine, ProcessBatchRequest, faults
+    from redpanda_tpu.coproc.engine import ProcessBatchItem
+    from redpanda_tpu.finjector import honey_badger
+    from redpanda_tpu.models import NTP, Record, RecordBatch
+    from redpanda_tpu.ops.exprs import field
+    from redpanda_tpu.ops.transforms import Int, Str, map_project, where
+
+    # disable() also CLEARS every armed probe, so snapshot the armed map
+    # and re-arm on the way out — an in-process caller mid-fault-campaign
+    # must get its badger back exactly as it was
+    was_enabled = honey_badger.enabled
+    was_armed = honey_badger.armed()
+    honey_badger.disable()
+    try:
+        # a real launch as the denominator: columnar host predicate over
+        # 512 records — device-free, so the op is deterministic on any box
+        engine = TpuEngine(
+            row_stride=256, compress_threshold=10**9,
+            force_mode="columnar_host", host_workers=0,
+        )
+        spec = where(field("level") == "error") | map_project(
+            Int("code"), Str("msg", 16)
+        )
+        engine.enable_coprocessors([(1, spec.to_json(), ("orders",))])
+        recs = [
+            Record(
+                offset_delta=i, timestamp_delta=i,
+                value=_json.dumps(
+                    {"level": ["error", "info"][i % 2], "code": i,
+                     "msg": f"m{i}"},
+                    separators=(",", ":"),
+                ).encode(),
+            )
+            for i in range(512)
+        ]
+        batch = RecordBatch.build(recs, base_offset=0, first_timestamp=1000)
+        req = ProcessBatchRequest(
+            [ProcessBatchItem(1, NTP.kafka("orders", 0), [batch])]
+        )
+
+        def op():
+            engine.process_batch(req)
+
+        def timed_block(fn, k: int) -> float:
+            t0 = time.perf_counter()
+            for _ in range(k):
+                fn()
+            return time.perf_counter() - t0
+
+        op()  # warmup (plan compile, caches)
+        per_op = min(timed_block(op, 2) / 2 for _ in range(3))
+        k = max(2, int(0.01 / per_op))
+        rounds = max(12, int(secs / (k * per_op)))
+        best_op = min(timed_block(op, k) / k for _ in range(rounds))
+
+        breaker = engine._breaker
+        assert breaker.state == faults.STATE_CLOSED
+        check_ns = float("inf")
+        inject_ns = float("inf")
+        success_ns = float("inf")
+        n_raw = 5000
+        for _ in range(10):
+            t0 = time.perf_counter()
+            for _ in range(n_raw):
+                breaker.allow_device()
+            check_ns = min(check_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+            t0 = time.perf_counter()
+            for _ in range(n_raw):
+                faults.inject(faults.DEVICE_DISPATCH)
+            inject_ns = min(inject_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+            t0 = time.perf_counter()
+            for _ in range(n_raw):
+                breaker.record_success()
+            success_ns = min(
+                success_ns, (time.perf_counter() - t0) / n_raw * 1e9
+            )
+        # informational: the deadline envelope's thread handoff per leg
+        envelope_s = float("inf")
+        for _ in range(30):
+            t0 = time.perf_counter()
+            faults.fetch_with_deadline(lambda: None, 30.0)
+            envelope_s = min(envelope_s, time.perf_counter() - t0)
+        # conservative per-launch budget: dispatch + mask fetch + harvest
+        # each pay one inject; one allow_device; two breaker verdicts
+        checks_per_launch = 3 * inject_ns + check_ns + 2 * success_ns
+        pct = checks_per_launch / (best_op * 1e9) * 100.0 if best_op else 0.0
+        return {
+            "breaker_check_ns": round(check_ns, 1),
+            "badger_disabled_check_ns": round(inject_ns, 1),
+            "breaker_record_success_ns": round(success_ns, 1),
+            "breaker_launch_cost_us": round(best_op * 1e6, 1),
+            "fetch_envelope_us": round(envelope_s * 1e6, 1),
+            "breaker_overhead_pct": round(pct, 3),
+        }
+    finally:
+        if was_enabled:
+            honey_badger.enable()
+            arm = {
+                "exception": honey_badger.set_exception,
+                "delay": honey_badger.set_delay,
+                "wedge": honey_badger.set_wedge,
+                "terminate": honey_badger.set_termination,
+            }
+            for module, probes_armed in was_armed.items():
+                for probe, effect in probes_armed.items():
+                    arm[effect](module, probe)
+
+
 def bench_rpc_echo(secs: float) -> dict:
     """Loopback RPC round trips (rpc_bench shape) over the real stack."""
     from redpanda_tpu import rpc
@@ -381,11 +510,16 @@ BENCHES = {
     "allocation": bench_allocation,
     "rpc_echo": bench_rpc_echo,
     "tracer_overhead": bench_tracer_overhead,
+    "breaker_overhead": bench_breaker_overhead,
 }
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "benches", nargs="*", metavar="BENCH",
+        help="bench names to run (default: all; same set as --only)",
+    )
     p.add_argument("--secs", type=float, default=0.5, help="time budget per bench")
     p.add_argument("--only", help="comma-separated bench names")
     p.add_argument(
@@ -407,8 +541,20 @@ def main(argv=None) -> int:
         help="fail (exit 1) if the host-stage pool's best speedup over "
         "workers=1 falls below RATIO (e.g. 1.2); implies host_pool_scaling",
     )
+    p.add_argument(
+        "--assert-breaker-overhead",
+        type=float,
+        metavar="PCT",
+        help="fail (exit 1) if the closed-breaker + disabled-honey-badger "
+        "share of the launch path exceeds PCT percent; implies the "
+        "breaker_overhead bench",
+    )
     args = p.parse_args(argv)
-    names = [n.strip() for n in args.only.split(",")] if args.only else list(BENCHES)
+    names = list(args.benches)
+    if args.only:
+        names.extend(n.strip() for n in args.only.split(","))
+    if not names:
+        names = list(BENCHES)
     unknown = [n for n in names if n not in BENCHES]
     if unknown:
         p.error(f"unknown bench(es) {unknown}; choose from {sorted(BENCHES)}")
@@ -416,6 +562,8 @@ def main(argv=None) -> int:
         names.append("tracer_overhead")
     if args.assert_pool_speedup is not None and "host_pool_scaling" not in names:
         names.append("host_pool_scaling")
+    if args.assert_breaker_overhead is not None and "breaker_overhead" not in names:
+        names.append("breaker_overhead")
     snap_before = None
     if args.metrics_snapshot:
         from redpanda_tpu.metrics import registry
@@ -448,6 +596,15 @@ def main(argv=None) -> int:
             print(
                 f"host pool speedup {ratio}x below floor "
                 f"{args.assert_pool_speedup}x",
+                file=sys.stderr,
+            )
+            return 1
+    if args.assert_breaker_overhead is not None:
+        pct = out.get("breaker_overhead_pct", 0.0)
+        if pct > args.assert_breaker_overhead:
+            print(
+                f"breaker overhead {pct}% exceeds budget "
+                f"{args.assert_breaker_overhead}%",
                 file=sys.stderr,
             )
             return 1

@@ -13,6 +13,7 @@ from tools.pandalint.checkers.tasks import TaskHygieneChecker
 from tools.pandalint.checkers.iobuf import IobufCopyChecker
 from tools.pandalint.checkers.enginesync import EngineSyncChecker
 from tools.pandalint.checkers.crossshard import CrossShardChecker
+from tools.pandalint.checkers.locks import LockRpcChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     ReactorChecker,
@@ -23,6 +24,7 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     IobufCopyChecker,
     EngineSyncChecker,
     CrossShardChecker,
+    LockRpcChecker,
 )
 
 

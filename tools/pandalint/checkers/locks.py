@@ -1,0 +1,104 @@
+"""Lock discipline: no network RPC awaited while holding an asyncio.Lock.
+
+An ``asyncio.Lock`` is cheap to hold across pure computation, but awaiting
+a network round trip inside one couples every waiter to the peer's latency
+tail: a slow or dead peer turns a microsecond critical section into a
+seconds-long convoy, and with the coproc tick deadline / raft election
+timers above it, into timeouts and re-elections. The reference avoids the
+shape structurally (seastar's ``with_semaphore`` bodies are local;
+cross-core work goes through ``submit_to`` WITHOUT holding the unit) —
+here the contract is convention, enforced by this checker.
+
+Remedies: copy what you need under the lock, drop it, then call; or make
+the RPC idempotent and tolerate the duplicate; or — when serializing the
+RPC is genuinely the point (create-once mutexes, state-machine ordering) —
+suppress with a reason, which doubles as documentation of why that convoy
+is acceptable.
+
+Heuristic scope (no type inference): inside an ``async with`` whose
+context expression mentions lock/mutex, an awaited call whose method name
+is a known RPC entry point:
+
+- LCK701 — transport-level sends: ``.send(...)``, ``.send_request(...)``,
+  ``.invoke_on(...)`` (rpc/transport.py and invoke_on-style peer calls).
+- LCK702 — dispatch-layer RPC: ``.topic_op(...)``, ``.replicate(...)``,
+  ``.pull_initial(...)`` (controller dispatch / raft replication fan-out).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.checkers.base import (
+    Checker,
+    FileContext,
+    RawFinding,
+    dotted,
+)
+
+_SEND_METHODS = {"send", "send_request", "invoke_on"}
+_DISPATCH_METHODS = {"topic_op", "replicate", "pull_initial"}
+
+
+def _holds_lock(node: ast.AST) -> bool:
+    if not isinstance(node, (ast.With, ast.AsyncWith)):
+        return False
+    for item in node.items:
+        ctx = item.context_expr
+        if isinstance(ctx, ast.Call):
+            ctx = ctx.func
+        name = dotted(ctx).lower()
+        if "lock" in name or "mutex" in name:
+            return True
+    return False
+
+
+def _method_name(call: ast.expr) -> str:
+    if isinstance(call, ast.Call) and isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return ""
+
+
+class LockRpcChecker(Checker):
+    name = "lock-rpc"
+    rules = {
+        "LCK701": "transport send/invoke_on awaited while holding an asyncio.Lock",
+        "LCK702": "dispatch-layer RPC (topic_op/replicate/...) awaited while holding an asyncio.Lock",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, ast.AsyncFunctionDef):
+                yield from self._walk(fn, fn.name, locked=False)
+
+    def _walk(
+        self, node: ast.AST, fn_name: str, locked: bool
+    ) -> Iterator[RawFinding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs run in their own (unlocked) context
+            child_locked = locked or _holds_lock(child)
+            if (
+                isinstance(child, ast.Await)
+                and child_locked
+                and isinstance(child.value, ast.Call)
+            ):
+                method = _method_name(child.value)
+                rule = (
+                    "LCK701" if method in _SEND_METHODS
+                    else "LCK702" if method in _DISPATCH_METHODS
+                    else None
+                )
+                if rule is not None:
+                    yield RawFinding(
+                        rule,
+                        child.lineno,
+                        child.col_offset,
+                        f"{fn_name}() awaits the network RPC .{method}() "
+                        f"while holding an asyncio.Lock; every waiter "
+                        f"inherits the peer's latency tail — drop the lock "
+                        f"before the call, or suppress with the reason the "
+                        f"serialization is intended",
+                    )
+            yield from self._walk(child, fn_name, child_locked)

@@ -39,6 +39,9 @@ DEFAULT_SCOPES: dict[str, tuple[str, ...]] = {
     # path follows; SHD603's queue-internals rule is cheap but the naming
     # heuristic would be noise elsewhere.
     "cross-shard": ("redpanda_tpu/coproc",),
+    # Locks + network RPC can meet anywhere in the broker (raft, cluster,
+    # coproc, kafka server), so the await-under-lock rule is package-wide.
+    "lock-rpc": (),
 }
 
 DEFAULT_PACKAGE_ROOT = "redpanda_tpu"
