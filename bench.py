@@ -154,6 +154,13 @@ def _fmt_stages(stats: dict) -> dict:
     return out
 
 
+def _harvest_mode(stats: dict) -> str:
+    """Which framing path a run took (gather = zero-copy from the joined
+    blob; padded = row-matrix). One helper so the detection rule can't
+    drift between the headline and the ablation blocks."""
+    return "gather" if stats.get("n_frame_gather", 0.0) else "padded"
+
+
 def _run_engine_mode(
     req, force_mode: str | None, host_workers: int = HOST_WORKERS
 ) -> tuple[float, dict, list | None, dict]:
@@ -184,6 +191,14 @@ def _run_engine_mode(
         "columnar_backend": stats.get("columnar_backend"),
         "columnar_probe": stats.get("columnar_probe"),
         "host_pool_probe": stats.get("host_pool_probe"),
+        # previous probe result when the periodic re-calibration
+        # (coproc_host_pool_recal_launches) has re-measured at least once
+        "host_pool_probe_prev": stats.get("host_pool_probe_prev"),
+        # zero-copy harvest: which framing path the run took (the
+        # projection headline mutates bytes, so it reports padded
+        # honestly) and the scratch arena's reuse accounting
+        "harvest_mode": _harvest_mode(stats),
+        "arena": stats.get("arena"),
         # fault-domain health of the run: a BENCH number produced while the
         # breaker was open (or launches fell back to host) is an artifact
         # of a degraded link, and must say so on its face
@@ -331,6 +346,41 @@ def run_config3_identity(engine_cls, force_mode=None) -> dict:
     return {"record_batches_per_sec": round(rate, 1)}
 
 
+def run_harvest_passthrough(req) -> dict:
+    """Zero-copy harvest ablation: the same 64-partition workload through a
+    PURE filter (passthrough plan — output bytes are the input values, the
+    shape the gather path exists for), gather on vs off. Stage keys carry
+    the per-path split; the microbench harvest_path gate asserts the
+    stage-time cut, this block puts both end-to-end rates on record."""
+    from redpanda_tpu.coproc import TpuEngine
+    from redpanda_tpu.ops.exprs import field
+    from redpanda_tpu.ops.transforms import where
+
+    spec = where(field("level") == "error")
+    out = {}
+    for key, gather in (("gather", True), ("padded_ablation", False)):
+        engine = TpuEngine(
+            row_stride=ROW_STRIDE,
+            force_mode="columnar_host",
+            host_workers=HOST_WORKERS,
+            gather_frame=gather,
+        )
+        codes = engine.enable_coprocessors([(1, spec.to_json(), ("bench",))])
+        assert codes[0] == 0
+        _run_engine_stream(engine, req, GROUP, GROUP, DEPTH)  # warmup
+        engine.reset_stats()
+        rate = _run_engine_stream(engine, req, 4 * GROUP, GROUP, DEPTH)
+        stats = engine.stats()
+        out[key] = {
+            "record_batches_per_sec": round(rate, 1),
+            "harvest_mode": _harvest_mode(stats),
+            "stages": _fmt_stages(stats),
+            "arena": stats.get("arena"),
+        }
+        engine.shutdown()
+    return out
+
+
 def run_link_profile() -> dict:
     """Quick device-link physics: sync RTT and H2D bandwidth (the numbers
     that justify columnar pushdown; full probe in tools/link_probe.py)."""
@@ -375,6 +425,7 @@ def main():
 
     extras = {}
     try:
+        extras["harvest_passthrough_64p"] = run_harvest_passthrough(req)
         extras["config1_crc_validate"] = run_config1_crc_validate()
         extras["config2_lz4_produce"] = run_config2_lz4_produce()
         extras["config3_identity_16p"] = run_config3_identity(TpuEngine)
@@ -424,6 +475,13 @@ def main():
                 # (advertised CPUs backed by ~1 core of quota) and the
                 # pool self-demoted to the inline path for the headline
                 "host_pool_probe": probe["host_pool_probe"],
+                "host_pool_probe_prev": probe["host_pool_probe_prev"],
+                # zero-copy harvest bookkeeping for the headline run (the
+                # projection headline assembles new bytes, so this is
+                # honestly "padded"; harvest_passthrough_64p carries the
+                # gather-vs-padded ablation)
+                "harvest_mode": probe["harvest_mode"],
+                "arena": probe["arena"],
                 "shard_stages": shard_stages,
                 "host_workers1_ablation": {
                     "record_batches_per_sec": round(w1_rate, 1),

@@ -309,6 +309,76 @@ int64_t rp_frame_many(const uint8_t* rows, size_t row_stride,
   return out - dst;
 }
 
+// One record framed into the output stream: {attrs=0, ts_delta=0,
+// offset_delta=seq, key=null, value=value[0:vlen], headers=0}. The ONE
+// framing layout shared by the gather path (values straight out of a
+// source blob) — byte-for-byte the layout rp_frame_records/rp_frame_many
+// emit from padded rows, which the gather parity tests pin down.
+static inline uint8_t* frame_one(uint8_t* out, const uint8_t* value,
+                                 int32_t vlen, int32_t seq) {
+  uint8_t body_buf[16];
+  uint8_t* b = body_buf;
+  *b++ = 0;                      // attributes
+  b = write_zigzag(b, 0);        // timestamp delta
+  b = write_zigzag(b, seq);      // offset delta
+  b = write_zigzag(b, -1);       // null key
+  b = write_zigzag(b, vlen);     // value length
+  size_t pre_len = (size_t)(b - body_buf);
+  int64_t body_len = (int64_t)pre_len + vlen + 1;  // +1 header count
+  out = write_zigzag(out, body_len);
+  std::memcpy(out, body_buf, pre_len);
+  out += pre_len;
+  std::memcpy(out, value, (size_t)vlen);
+  out += vlen;
+  out = write_zigzag(out, 0);    // header count
+  return out;
+}
+
+// ZERO-COPY framing: build a records payload for kept records straight
+// from a source blob via per-record (offset, len) columns — no padded
+// [n, stride] row matrix ever exists; the one memcpy per record IS the
+// framed output. lens[i] < 0 (null value) frames as an empty value,
+// matching the padded path's clamp. Caller sizes dst at
+// sum(max(lens,0)) + 16*n + 16; returns payload length, kept via
+// *kept_out.
+int64_t rp_frame_gather(const uint8_t* src, const int64_t* offsets,
+                        const int32_t* lens, const uint8_t* keep, int64_t n,
+                        uint8_t* dst, int32_t* kept_out) {
+  uint8_t* out = dst;
+  int32_t seq = 0;
+  for (int64_t i = 0; i < n; i++) {
+    if (!keep[i]) continue;
+    int32_t vlen = lens[i] < 0 ? 0 : lens[i];
+    out = frame_one(out, src + offsets[i], vlen, seq);
+    seq++;
+  }
+  *kept_out = seq;
+  return out - dst;
+}
+
+// Gather-frame MANY record ranges in one crossing (the launch-wide twin of
+// rp_frame_many for the zero-copy path): for each range r, kept records
+// [starts[r], ends[r]) frame contiguously into dst via rp_frame_gather
+// (one range = one rp_frame_gather call, so the two symbols cannot
+// diverge); out_off/out_len give the payload slice and out_kept the
+// surviving count per range. Returns total bytes written.
+int64_t rp_frame_many_gather(const uint8_t* src, const int64_t* offsets,
+                             const int32_t* lens, const uint8_t* keep,
+                             const int64_t* starts, const int64_t* ends,
+                             int64_t n_ranges, uint8_t* dst,
+                             int64_t* out_off, int64_t* out_len,
+                             int32_t* out_kept) {
+  int64_t total = 0;
+  for (int64_t r = 0; r < n_ranges; r++) {
+    int64_t s = starts[r];
+    out_off[r] = total;
+    out_len[r] = rp_frame_gather(src, offsets + s, lens + s, keep + s,
+                                 ends[r] - s, dst + total, out_kept + r);
+    total += out_len[r];
+  }
+  return total;
+}
+
 // ---------------------------------------------------------------- columnar
 // JSON field extraction for the columnar pushdown path (coproc engine v2).
 // The device link charges per byte (tools/link_probe.py: H2D ~15-70 MB/s,

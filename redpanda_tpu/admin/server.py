@@ -8,9 +8,9 @@ Parity with redpanda/admin_server.cc:
 - POST /v1/raft/{group}/transfer_leadership             (:301)
 - POST /v1/partitions/kafka/{t}/{p}/transfer_leadership (:486)
 - GET/POST/DELETE /v1/security/users   (:401-483 SCRAM CRUD)
-- GET  /v1/failure-probes, PUT /v1/failure-probes/{m}/{p}/{type} (:948;
-  types exception|delay|wedge|terminate, DELETE disarms — rpk debug
-  failpoints)
+- GET  /v1/failure-probes, PUT /v1/failure-probes/{m}/{p}/{type}[?count=N]
+  (:948; types exception|delay|wedge|terminate, count=N auto-disarms after
+  N injections, DELETE disarms — rpk debug failpoints)
 - GET  /v1/coproc/status               (engine breaker + fault-domain stats;
   rpk debug coproc)
 - GET  /metrics                        (:148-151 prometheus)
@@ -437,6 +437,8 @@ class AdminServer:
                 "enabled": honey_badger.enabled,
                 "modules": honey_badger.modules(),
                 "armed": honey_badger.armed(),
+                # remaining injections for count-limited (one-shot) probes
+                "counts": honey_badger.armed_counts(),
             }
         )
 
@@ -452,18 +454,33 @@ class AdminServer:
                 {"error": f"unknown probe {module}.{probe}", "modules": known},
                 status=404,
             )
+        count = None
+        if "count" in req.query:
+            try:
+                count = int(req.query["count"])
+                if count < 1:
+                    raise ValueError(count)
+            except ValueError:
+                return web.json_response(
+                    {"error": f"count must be a positive integer, got "
+                              f"{req.query['count']!r}"},
+                    status=400,
+                )
         honey_badger.enable()
         if typ == "exception":
-            honey_badger.set_exception(module, probe)
+            honey_badger.set_exception(module, probe, count)
         elif typ == "delay":
-            honey_badger.set_delay(module, probe)
+            honey_badger.set_delay(module, probe, count)
         elif typ == "wedge":
-            honey_badger.set_wedge(module, probe)
+            honey_badger.set_wedge(module, probe, count)
         elif typ == "terminate":
-            honey_badger.set_termination(module, probe)
+            honey_badger.set_termination(module, probe, count)
         else:
             return web.json_response({"error": f"unknown type {typ}"}, status=400)
-        return web.json_response({"armed": f"{module}.{probe}", "type": typ})
+        body = {"armed": f"{module}.{probe}", "type": typ}
+        if count is not None:
+            body["count"] = count
+        return web.json_response(body)
 
     async def _unset_probe(self, req: web.Request) -> web.Response:
         module = req.match_info["module"]

@@ -384,7 +384,10 @@ async def cmd_debug(args) -> int:
         for k, v in shown.items():
             v = round(v, 6) if isinstance(v, float) else v
             print(f"  {k:<28}{v}")
-        for k in ("columnar_backend", "host_pool_probe", "columnar_probe"):
+        for k in (
+            "columnar_backend", "host_pool_probe", "host_pool_probe_prev",
+            "host_pool_recal", "columnar_probe", "arena",
+        ):
             if stats.get(k) is not None:
                 print(f"  {k:<28}{stats[k]}")
         return 0
@@ -396,17 +399,21 @@ async def cmd_debug(args) -> int:
                 print(f"admin api returned {status}: {body}")
                 return 1
             armed = body.get("armed") or {}
+            counts = body.get("counts") or {}
             print(f"honey badger enabled: {body.get('enabled', False)}")
             for module, probes_ in sorted((body.get("modules") or {}).items()):
                 for probe in probes_:
                     effect = armed.get(module, {}).get(probe, "-")
+                    rem = counts.get(module, {}).get(probe)
+                    if rem is not None:
+                        effect = f"{effect} (x{rem} left)"
                     print(f"  {module + '.' + probe:<40}{effect}")
             return 0
         if args.fp_cmd == "arm":
-            status, body = await _admin_request(
-                args, "PUT",
-                f"/v1/failure-probes/{args.module}/{args.probe}/{args.type}",
-            )
+            path = f"/v1/failure-probes/{args.module}/{args.probe}/{args.type}"
+            if args.count is not None:
+                path += f"?count={args.count}"
+            status, body = await _admin_request(args, "PUT", path)
         else:  # disarm
             status, body = await _admin_request(
                 args, "DELETE",
@@ -635,6 +642,10 @@ def build_parser() -> argparse.ArgumentParser:
     fpa.add_argument("probe")
     fpa.add_argument(
         "type", choices=["exception", "delay", "wedge", "terminate"],
+    )
+    fpa.add_argument(
+        "--count", type=int, default=None,
+        help="auto-disarm after N injections (1 = one-shot)",
     )
     fpd = fpsub.add_parser("disarm")
     fpd.add_argument("module")

@@ -141,6 +141,16 @@ PROPERTIES: list[Property] = [
         "Measure real parallel capacity before sharding host stages (quota-limited boxes advertise CPUs they don't have); false trusts coproc_host_workers as-is",
         True, bool,
     ),
+    Property(
+        "coproc_host_pool_recal_launches",
+        "Re-run the inline-vs-sharded host-pool probe every N shardable launches (burstable hosts change capacity over time); 0 pins the first measurement forever",
+        512, int, _non_negative,
+    ),
+    Property(
+        "coproc_gather_frame",
+        "Zero-copy harvest: frame byte-identity transform output straight from the joined blob's (offset, len) columns instead of packing a padded row matrix",
+        True, bool,
+    ),
     # --- coproc fault domains (coproc/faults.py)
     Property(
         "coproc_device_deadline_ms",

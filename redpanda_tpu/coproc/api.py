@@ -41,6 +41,10 @@ class CoprocApi:
         self.engine = TpuEngine(
             host_workers=_knob("coproc_host_workers", None),
             host_pool_probe=_knob("coproc_host_pool_probe", True),
+            host_pool_recal_launches=_knob(
+                "coproc_host_pool_recal_launches", None
+            ),
+            gather_frame=_knob("coproc_gather_frame", True),
             device_deadline_ms=_knob("coproc_device_deadline_ms", None),
             launch_retries=_knob("coproc_launch_retries", None),
             retry_backoff_ms=_knob("coproc_retry_backoff_ms", None),

@@ -9,6 +9,7 @@ C per record, TPU per byte.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 
 import numpy as np
@@ -25,6 +26,67 @@ def _native():
         return lib
     except Exception:
         return None
+
+
+class Arena:
+    """Reusable scratch buffers for the harvest path's framing crossings.
+
+    Each launch used to allocate a fresh framing dst buffer (and offset
+    arrays) only to throw it away after ``.tobytes()`` sliced the payloads
+    out; at a steady tick cadence that is megabytes of allocator churn per
+    launch for buffers whose size barely changes. The arena keeps a small
+    free list instead: ``acquire`` hands back a previously released buffer
+    when one is big enough, ``release`` returns it. Thread-safe — sharded
+    harvests frame concurrently on pool workers.
+
+    The engine owns one arena per instance (``TpuEngine.reset_arenas()``
+    swaps in a fresh one for tests/bench so reuse accounting is
+    deterministic)."""
+
+    # bound the free list so a one-off giant launch cannot pin its buffers
+    # forever once traffic returns to normal size
+    MAX_FREE = 8
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._free: list[np.ndarray] = []
+        self._allocs = 0
+        self._reuses = 0
+        self._alloc_bytes = 0
+
+    def acquire(self, nbytes: int) -> np.ndarray:
+        """A uint8 1-D buffer of AT LEAST nbytes (callers track their own
+        logical lengths; the buffer may be bigger)."""
+        with self._lock:
+            best = None
+            for i, b in enumerate(self._free):
+                if b.nbytes >= nbytes and (
+                    best is None or b.nbytes < self._free[best].nbytes
+                ):
+                    best = i
+            if best is not None:
+                self._reuses += 1
+                return self._free.pop(best)
+            self._allocs += 1
+            self._alloc_bytes += max(nbytes, 1)
+        return np.empty(max(nbytes, 1), dtype=np.uint8)
+
+    def release(self, buf: np.ndarray | None) -> None:
+        if buf is None:
+            return
+        with self._lock:
+            if len(self._free) < self.MAX_FREE:
+                self._free.append(buf)
+            # else: drop — the launch that needed it can re-allocate
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "allocs": self._allocs,
+                "reuses": self._reuses,
+                "alloc_bytes": self._alloc_bytes,
+                "free_buffers": len(self._free),
+            }
 
 
 @dataclass
@@ -195,11 +257,13 @@ def frame_ranges(
     lens: np.ndarray,
     keep: np.ndarray,
     ranges: list[tuple[int, int]],
+    arena: Arena | None = None,
 ) -> list[tuple[bytes, int]]:
     """Frame every [start, end) record range of a LAUNCH in one native
     crossing (rp_frame_many): [(payload, kept)] per range. The per-batch
     ctypes call overhead dominated rebuild at 32-record batches; this is
-    the same loop, moved below the language boundary."""
+    the same loop, moved below the language boundary. ``arena`` (when
+    given) supplies the reusable framing dst buffer."""
     if not ranges:
         # explicit on BOTH paths: the native branch previously fell through
         # to the Python list comprehension when ranges was empty, silently
@@ -209,12 +273,94 @@ def frame_ranges(
     if lib is not None and getattr(lib, "has_frame_many", False):
         starts = np.fromiter((s for s, _ in ranges), np.int64, len(ranges))
         ends = np.fromiter((e for _, e in ranges), np.int64, len(ranges))
-        dst, off, ln, kept = lib.frame_many(rows, lens, keep, starts, ends)
-        return [
+        n, stride = rows.shape
+        scratch = arena.acquire(n * (stride + 16) + 16) if arena else None
+        dst, off, ln, kept = lib.frame_many(
+            rows, lens, keep, starts, ends, out=scratch
+        )
+        parts = [
             (dst[off[i] : off[i] + ln[i]].tobytes(), int(kept[i]))
             for i in range(len(ranges))
         ]
+        if arena is not None:
+            arena.release(dst)
+            if dst is not scratch:
+                # the binding replaced an undersized scratch; keep the old
+                # buffer too — it can still serve a smaller launch
+                arena.release(scratch)
+        return parts
     return [frame_records(rows[s:e], lens[s:e], keep[s:e]) for s, e in ranges]
+
+
+def _frame_gather_py(
+    src, offsets, lens, keep, start: int, end: int
+) -> tuple[bytes, int]:
+    """Python gather framing for one range — bit-identical to
+    rp_frame_gather (and to frame_records over packed rows, which the
+    parity tests assert)."""
+    out = bytearray()
+    seq = 0
+    for i in range(start, end):
+        if not keep[i]:
+            continue
+        o = int(offsets[i])
+        vlen = max(int(lens[i]), 0)
+        body = bytearray()
+        body += b"\x00"
+        body += encode_zigzag(0)
+        body += encode_zigzag(seq)
+        body += encode_zigzag(-1)
+        body += encode_zigzag(vlen)
+        body += src[o : o + vlen]
+        body += encode_zigzag(0)
+        out += encode_zigzag(len(body))
+        out += body
+        seq += 1
+    return bytes(out), seq
+
+
+def frame_ranges_gather(
+    src,
+    offsets: np.ndarray,
+    lens: np.ndarray,
+    keep: np.ndarray,
+    ranges: list[tuple[int, int]],
+    arena: Arena | None = None,
+) -> list[tuple[bytes, int]]:
+    """ZERO-COPY launch framing (rp_frame_many_gather): kept records frame
+    straight from ``src`` (the launch's joined blob) via per-record
+    (offset, len) columns — the padded row matrix the padded path builds
+    just to copy from never exists. Output is byte-identical to
+    ``frame_ranges`` over rows packed from the same (offset, len) table;
+    the engine picks this path only for byte-identity transforms
+    (columnar passthrough, host identity)."""
+    if not ranges:
+        return []
+    lib = _native()
+    if lib is not None and getattr(lib, "has_frame_many_gather", False):
+        starts = np.fromiter((s for s, _ in ranges), np.int64, len(ranges))
+        ends = np.fromiter((e for _, e in ranges), np.int64, len(ranges))
+        n = len(offsets)
+        scratch = (
+            arena.acquire(int(np.maximum(lens, 0).sum()) + 16 * n + 16)
+            if arena
+            else None
+        )
+        dst, off, ln, kept = lib.frame_many_gather(
+            src, offsets, lens, keep, starts, ends, out=scratch
+        )
+        parts = [
+            (dst[off[i] : off[i] + ln[i]].tobytes(), int(kept[i]))
+            for i in range(len(ranges))
+        ]
+        if arena is not None:
+            arena.release(dst)
+            if dst is not scratch:
+                arena.release(scratch)
+        return parts
+    return [
+        _frame_gather_py(src, offsets, lens, keep, s, e) for s, e in ranges
+    ]
 
 
 def build_output_batch(

@@ -123,6 +123,15 @@ class ColumnarPlan:
 
     mode = "columnar"
 
+    @property
+    def byte_identity(self) -> bool:
+        """True when the transform's output bytes ARE the input value bytes
+        (a pure filter: no projection mutates anything). The engine's
+        zero-copy harvest gathers framed output straight from the launch's
+        joined blob via (offset, len) — legal exactly when this holds; any
+        projection assembles new bytes and must keep the padded path."""
+        return self.passthrough
+
     def flat_paths(self) -> list[str]:
         """Distinct TOP-LEVEL (single-segment) paths the plan references;
         nested paths keep the per-path walker."""
@@ -373,6 +382,8 @@ class ColumnarPlan:
 class PayloadPlan:
     spec: TransformSpec
     mode = "payload"
+    # device-transformed rows: never a view into the input blob
+    byte_identity = False
 
 
 @dataclass
@@ -383,6 +394,13 @@ class HostPlan:
     kind: str  # identity | uppercase | python
     fn: object = None  # python escape hatch: callable(bytes) -> bytes | None
     mode = "host"
+
+    @property
+    def byte_identity(self) -> bool:
+        # identity emits the input value bytes untouched (its keep rule —
+        # drop empty values — needs only the sizes column); uppercase and
+        # python transforms mutate bytes
+        return self.kind == "identity"
 
 
 def plan_spec(spec: TransformSpec, py_fn=None):

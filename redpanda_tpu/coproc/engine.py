@@ -208,8 +208,8 @@ class _Launch:
     __slots__ = ("script_id", "policy", "mode", "r_out", "ranges", "fits",
                  "engine", "n", "_packed_dev", "_mask_dev", "_mask_np",
                  "_mask_event", "_proj_data", "_proj_ok", "_plan",
-                 "_exploded", "_mat", "_framed", "_lock", "_shards",
-                 "trace_id", "_enq_t", "_cols", "_staged_np",
+                 "_exploded", "_mat", "_gather_mat", "_framed", "_lock",
+                 "_shards", "trace_id", "_enq_t", "_cols", "_staged_np",
                  "_mask_state", "_pending_slots")
 
     def __init__(self, script_id: int, policy: ErrorPolicy):
@@ -232,6 +232,7 @@ class _Launch:
         self._plan = None
         self._exploded = None
         self._mat = None
+        self._gather_mat = None
         self._framed = None
         self._lock = threading.Lock()
         self._shards: list[_HostShard] | None = None
@@ -450,8 +451,8 @@ class _Launch:
                     outs.append(plan.fn(val))
                 except Exception as exc:
                     if self.policy == ErrorPolicy.deregister:
-                        # propagate: Ticket._rebuild applies the policy and
-                        # unloads the script (wasm_event.h Deregister)
+                        # propagate: Ticket._result_impl applies the policy
+                        # and unloads the script (wasm_event.h Deregister)
                         raise
                     # user-code boundary: a script TypeError is a script
                     # failure, not an engine bug — never re-raise, but
@@ -482,19 +483,76 @@ class _Launch:
         """Per-range (payload, kept), framed launch-wide in ONE native
         crossing the first time any ticket rebuilds. Locked: tickets of one
         submit_group share this launch and may harvest from different
-        threads (the pacemaker harvests via run_in_executor)."""
+        threads (the pacemaker harvests via run_in_executor).
+
+        Byte-identity transforms take the ZERO-COPY gather path: kept
+        records frame straight from the joined blob via the (offset, len)
+        columns the explode stage already produced — the padded row matrix
+        the padded path packs just to copy from never exists. Output is
+        bit-identical either way (the gather parity suite pins it)."""
         with self._lock:
             if self._framed is None:
                 if self._shards is not None:
                     self._framed = self._framed_sharded()
                 else:
-                    out, out_len, keep = self._materialize_locked()
-                    t0 = time.perf_counter()
-                    self._framed = batch_codec.frame_ranges(
-                        out, out_len, keep, self.ranges
-                    )
-                    self._stat("t_rebuild", t0)
+                    gv = self._gather_view()
+                    arena = self.engine._arena if self.engine is not None else None
+                    if gv is not None:
+                        ex, keep = gv
+                        t0 = time.perf_counter()
+                        self._framed = batch_codec.frame_ranges_gather(
+                            ex.joined, ex.offsets, ex.sizes, keep,
+                            self.ranges, arena=arena,
+                        )
+                        self._stat("t_frame_gather", t0)
+                        self._count_frame("n_frame_gather")
+                        self._exploded = None
+                        self._gather_mat = None
+                    else:
+                        out, out_len, keep = self._materialize_locked()
+                        t0 = time.perf_counter()
+                        self._framed = batch_codec.frame_ranges(
+                            out, out_len, keep, self.ranges, arena=arena
+                        )
+                        self._stat("t_rebuild", t0)
+                        self._count_frame("n_frame_padded")
             return self._framed
+
+    def _gather_view(self):
+        """(exploded, keep) when this launch's output bytes are an
+        (offset, len) view into the joined blob — byte-identity plans
+        (columnar passthrough, host identity) with the exploded table
+        still in hand; None sends the launch down the padded path.
+
+        The resolved view is CACHED (like _materialize_locked's _mat):
+        _resolve_keep consumes the mask slot, so an uncached re-entry
+        after a framing failure would read an empty slot as "no
+        predicate" and silently emit keep-all output on retry."""
+        if self._gather_mat is not None:
+            return self._gather_mat
+        eng = self.engine
+        if eng is None or not eng._gather_frame:
+            return None
+        plan = self._plan
+        if plan is None or not getattr(plan, "byte_identity", False):
+            return None
+        ex = self._exploded
+        if ex is None:
+            return None
+        if self.mode == "columnar":
+            keep = self._resolve_keep(self, self.n) & self._proj_ok
+        elif self.mode == "host":
+            # identity's normative keep rule: drop empty values (matches
+            # _mat_host's `ex.sizes > 0`)
+            keep = ex.sizes > 0
+        else:
+            return None
+        self._gather_mat = (ex, keep)
+        return self._gather_mat
+
+    def _count_frame(self, key: str) -> None:
+        if self.engine is not None:
+            self.engine._stat_add(key, 1.0)
 
     def _shard_keep(self, shard: _HostShard) -> np.ndarray:
         """Resolve one shard's keep mask via the shared _resolve_keep."""
@@ -506,14 +564,33 @@ class _Launch:
 
     def _frame_shard(self, shard: _HostShard, keep: np.ndarray):
         """Assemble + frame ONE shard's record range (pool worker body —
-        touches only its own shard, see SHD6xx)."""
+        touches only its own shard, see SHD6xx). Byte-identity plans
+        gather-frame straight from the shard's exploded table (same
+        zero-copy rule as the inline path)."""
         plan: ColumnarPlan = self._plan
+        eng = self.engine
+        arena = eng._arena if eng is not None else None
+        ex = shard.exploded
+        if (
+            eng is not None
+            and eng._gather_frame
+            and getattr(plan, "byte_identity", False)
+            and ex is not None
+            and shard.n > 0
+        ):
+            t0 = time.perf_counter()
+            framed = batch_codec.frame_ranges_gather(
+                ex.joined, ex.offsets, ex.sizes, keep, shard.ranges,
+                arena=arena,
+            )
+            self._stat("t_shard_frame_gather", t0)
+            self._count_frame("n_frame_gather")
+            return framed
         t0 = time.perf_counter()
         if shard.n == 0:
             rows = np.zeros((0, max(self.r_out, 1)), np.uint8)
             lens = np.zeros(0, np.int32)
         elif plan.passthrough:
-            ex = shard.exploded
             stride = max(int(ex.sizes.max()), 1)
             rows, lens = _pack_values(ex, stride)
         else:
@@ -523,8 +600,11 @@ class _Launch:
         # fan-out's wall time is t_sharded_frame)
         self._stat("t_shard_assemble", t0)
         t0 = time.perf_counter()
-        framed = batch_codec.frame_ranges(rows, lens, keep, shard.ranges)
+        framed = batch_codec.frame_ranges(
+            rows, lens, keep, shard.ranges, arena=arena
+        )
         self._stat("t_shard_rebuild", t0)
+        self._count_frame("n_frame_padded")
         return framed
 
     def _framed_sharded(self) -> list[tuple[bytes, int]]:
@@ -605,6 +685,10 @@ _UNKNOWN, _EMPTY, _DEREGISTERED, _LAUNCHED = range(4)
 # win, so small launches keep the inline path.
 _SHARD_MIN_ROWS = 2048
 
+# Harvest-side seal sharding threshold: below this many output batches the
+# pool's thread handoff costs more than the recompress+CRC it spreads.
+_SEAL_MIN_BATCHES = 8
+
 # Columnar backend probe: don't pin the process-wide device-vs-host choice
 # on a batch too small to represent steady state, and bound the device leg
 # (first TPU compile is ~20-40s; a wedged tunnel hangs forever).
@@ -639,7 +723,40 @@ class Ticket:
         reply = ProcessBatchReply()
         dereg: set[int] = set()
         failed_scripts: set[int] = set()
+        # Phase 1: frame every launch and collect the recompress+seal jobs
+        # REPLY-WIDE, so the seal can fan out over the host pool in one
+        # batch instead of serially per item — the harvest-side analogue
+        # of submit_group's launch fusion. Jobs are independent
+        # (build_output_batch is pure per batch) and merge in input order,
+        # so offsets/CRCs are bit-identical to the serial loop.
+        seal_jobs: list[tuple] = []  # (source batch, payload, kept)
+        slot_plans: list = []  # per slot: list[int] | Exception | None
+        framing_failed: set[int] = set()
         for disp, item, launch, rng in self._slots:
+            if disp != _LAUNCHED or launch.script_id in framing_failed:
+                # a later slot of a script whose framing already failed is
+                # resolved by phase 2's failed_scripts bookkeeping (the
+                # failing slot precedes it in slot order)
+                slot_plans.append(None)
+                continue
+            try:
+                framed = launch.framed()  # one crossing per launch
+                idxs = []
+                for batch, ridx in zip(item.batches, rng):
+                    payload, kept = framed[ridx]
+                    idxs.append(len(seal_jobs))
+                    seal_jobs.append((batch, payload, kept))
+                slot_plans.append(idxs)
+            except Exception as exc:
+                # held for phase 2: the script error policy is applied in
+                # slot order there, exactly like the old per-slot loop
+                slot_plans.append(exc)
+                framing_failed.add(launch.script_id)
+        sealed = self._engine._seal_jobs(seal_jobs)
+        # Phase 2: assemble the reply in slot order under the script's
+        # ErrorPolicy — this is the policy boundary (deregister failures
+        # ride through here), so programming errors must not bypass it.
+        for (disp, item, launch, rng), plan in zip(self._slots, slot_plans):
             if disp == _UNKNOWN or disp == _EMPTY:
                 reply.items.append(ProcessBatchReplyItem(item.script_id, item.ntp, []))
             elif disp == _DEREGISTERED:
@@ -651,48 +768,36 @@ class Ticket:
                             ProcessBatchReplyItem(item.script_id, item.ntp, [])
                         )
                     continue
-                try:
-                    out_batches = self._rebuild(item, launch, rng)
+                exc = plan if isinstance(plan, Exception) else next(
+                    (
+                        sealed[i]
+                        for i in plan
+                        if isinstance(sealed[i], BaseException)
+                    ),
+                    None,
+                )
+                if exc is None:
+                    out_batches = [
+                        sealed[i] for i in plan if sealed[i] is not None
+                    ]
                     reply.items.append(
                         ProcessBatchReplyItem(item.script_id, item.ntp, out_batches)
                     )
-                except Exception as exc:
-                    # classified, then the script's ErrorPolicy decides —
-                    # this is the policy boundary (deregister re-raises ride
-                    # through here), so programming errors must not bypass it
-                    faults.note_failure("rebuild", exc)
-                    failed_scripts.add(launch.script_id)
-                    if launch.policy == ErrorPolicy.deregister:
-                        self._engine.disable_coprocessors([launch.script_id])
-                        dereg.add(launch.script_id)
-                        reply.items = [
-                            ri for ri in reply.items if ri.script_id != launch.script_id
-                        ]
-                    else:
-                        reply.items.append(
-                            ProcessBatchReplyItem(item.script_id, item.ntp, [])
-                        )
+                    continue
+                faults.note_failure("rebuild", exc)
+                failed_scripts.add(launch.script_id)
+                if launch.policy == ErrorPolicy.deregister:
+                    self._engine.disable_coprocessors([launch.script_id])
+                    dereg.add(launch.script_id)
+                    reply.items = [
+                        ri for ri in reply.items if ri.script_id != launch.script_id
+                    ]
+                else:
+                    reply.items.append(
+                        ProcessBatchReplyItem(item.script_id, item.ntp, [])
+                    )
         reply.deregistered = sorted(dereg)
         return reply
-
-    def _rebuild(self, item: ProcessBatchItem, launch: _Launch, rng) -> list[RecordBatch]:
-        framed = launch.framed()  # one native crossing for the whole launch
-        e = self._engine
-        t0 = time.perf_counter()
-        item_out: list[RecordBatch] = []
-        for batch, ridx in zip(item.batches, rng):
-            payload, kept = framed[ridx]
-            rebuilt = batch_codec.build_output_batch(
-                batch,
-                payload,
-                kept,
-                compress_threshold=e._compress_threshold,
-                codec=e._output_codec,
-            )
-            if rebuilt is not None:
-                item_out.append(rebuilt)
-        e._stat_add("t_rebuild", time.perf_counter() - t0)
-        return item_out
 
 
 class TpuEngine:
@@ -729,6 +834,8 @@ class TpuEngine:
         force_mode: str | None = None,
         host_workers: int | None = None,
         host_pool_probe: bool = True,
+        host_pool_recal_launches: int | None = None,
+        gather_frame: bool = True,
         device_deadline_ms: int | None = None,
         launch_retries: int | None = None,
         retry_backoff_ms: int | None = None,
@@ -788,6 +895,24 @@ class TpuEngine:
         self._pool_decision: str | None = None if host_pool_probe else "sharded"
         self._pool_decision_lock = threading.Lock()
         self._host_pool_probe: dict | None = None
+        self._host_pool_probe_prev: dict | None = None
+        # Periodic re-calibration (config coproc_host_pool_recal_launches):
+        # burstable boxes gain/lose capacity over time, so a pinned on/off
+        # decision re-measures every N shardable launches. 0 pins forever;
+        # an explicit host_pool_probe=False pin is never re-measured.
+        self._probe_enabled = bool(host_pool_probe)
+        self._recal_interval = (
+            512
+            if host_pool_recal_launches is None
+            else max(0, int(host_pool_recal_launches))
+        )
+        self._launches_since_cal = 0
+        # Zero-copy harvest: byte-identity transforms gather-frame straight
+        # from the joined blob (gather_frame=False is the bench ablation /
+        # operator escape hatch), and framing scratch reuses across
+        # launches through the arena (reset_arenas() for tests).
+        self._gather_frame = bool(gather_frame)
+        self._arena = batch_codec.Arena()
         # per-shard stage splits of the most recent sharded launch (bench
         # artifact + debugging aid; overwritten per launch under the lock)
         self.last_launch_shards: list[dict] | None = None
@@ -1015,8 +1140,16 @@ class TpuEngine:
             out = dict(self._stats)
         out["host_workers"] = float(self._host_workers)
         out["breaker"] = self._breaker.snapshot()
+        out["arena"] = self._arena.stats()
         if self._host_pool_probe is not None:
             out["host_pool_probe"] = dict(self._host_pool_probe)
+        if self._host_pool_probe_prev is not None:
+            out["host_pool_probe_prev"] = dict(self._host_pool_probe_prev)
+        if self._host_pool is not None:
+            out["host_pool_recal"] = {
+                "interval": self._recal_interval if self._probe_enabled else 0,
+                "launches_since": self._launches_since_cal,
+            }
         if TpuEngine._columnar_probe is not None:
             out["columnar_backend"] = TpuEngine._columnar_backend
             out["columnar_probe"] = dict(TpuEngine._columnar_probe)
@@ -1032,6 +1165,14 @@ class TpuEngine:
         stale decision."""
         cls._columnar_backend = None
         cls._columnar_probe = None
+
+    def reset_arenas(self) -> None:
+        """Swap in a fresh harvest scratch arena. The arena is deliberately
+        long-lived (buffer reuse across launches is the point), but tests
+        and bench ablations need deterministic alloc/reuse accounting —
+        and an engine parked after a giant launch can use this to return
+        the held buffers to the allocator."""
+        self._arena = batch_codec.Arena()
 
     def reset_stats(self) -> None:
         with self._stats_lock:
@@ -1053,12 +1194,76 @@ class TpuEngine:
                 probes.coproc_h2d_bytes.inc(v)
             elif key == "bytes_d2h":
                 probes.coproc_d2h_bytes.inc(v)
+            elif key == "n_frame_gather":
+                probes.coproc_harvest_gather.inc(v)
+            elif key == "n_frame_padded":
+                probes.coproc_harvest_padded.inc(v)
 
     def _count_fallback(self, n: int) -> None:
         """Account records whose stages re-executed on the pure-host
         fallback (exhausted device retries or an open breaker)."""
         self._stat_add("n_fallback_rows", float(n))
         probes.coproc_fallback_rows.inc(n)
+
+    def _seal_jobs(self, jobs: list[tuple]) -> list:
+        """Recompress + seal framed payloads into output batches
+        (batch_codec.build_output_batch), sharded over the host pool when
+        the measured pool decision is on and the reply is big enough.
+        Jobs are independent (build_output_batch is pure per batch) and
+        chunks merge in input order, so offsets/CRCs are bit-identical to
+        the serial loop. A per-job failure comes back AS the exception
+        instance (the caller owns the script error policy); a pool
+        machinery failure degrades the whole list to the inline loop."""
+        if not jobs:
+            return []
+
+        def seal_one(src, payload, kept):
+            try:
+                return batch_codec.build_output_batch(
+                    src, payload, kept,
+                    compress_threshold=self._compress_threshold,
+                    codec=self._output_codec,
+                )
+            except Exception as exc:  # delivered to the policy boundary
+                return exc
+
+        pool = self._host_pool
+        if (
+            pool is not None
+            and self._pool_decision == "sharded"
+            and len(jobs) >= _SEAL_MIN_BATCHES
+        ):
+            # chunks balance by payload bytes: recompression cost tracks
+            # size, and one fat batch must not serialize a whole chunk
+            # behind it (+1 keeps zero-length payloads partitionable)
+            parts = host_pool.partition_counts(
+                [len(p) + 1 for _, p, _ in jobs], pool.workers
+            )
+            if len(parts) >= 2:
+                def run_chunk(s: int, e: int) -> list:
+                    t0 = time.perf_counter()
+                    out = [seal_one(*jobs[i]) for i in range(s, e)]
+                    # per-chunk CPU-seconds; the fan-out wall time is
+                    # t_sharded_seal (same split discipline as t_shard_*)
+                    self._stat_add("t_shard_seal", time.perf_counter() - t0)
+                    return out
+
+                t0 = time.perf_counter()
+                try:
+                    chunks = pool.run([
+                        (lambda s=s, e=e: run_chunk(s, e)) for s, e in parts
+                    ])
+                except Exception as exc:
+                    faults.note_failure(
+                        faults.SHARD_WORKER, exc, reraise_programming=True
+                    )
+                else:
+                    self._stat_add("t_sharded_seal", time.perf_counter() - t0)
+                    return [b for chunk in chunks for b in chunk]
+        t0 = time.perf_counter()
+        out = [seal_one(*j) for j in jobs]
+        self._stat_add("t_seal", time.perf_counter() - t0)
+        return out
 
     def _abandon_pending_masks(self, launch: _Launch) -> None:
         """Mark a degraded sharded launch's still-queued shard masks
@@ -1279,6 +1484,26 @@ class TpuEngine:
             # caller thread, so t_sharded ~= t_inline and the pool would be
             # demoted process-wide off a meaningless measurement
             return False
+        if (
+            self._probe_enabled
+            and self._recal_interval > 0
+            and self._pool_decision is not None
+        ):
+            # periodic re-calibration: after N shardable launches the
+            # pinned decision is archived and THIS launch re-measures —
+            # burstable hosts that gained (or lost) capacity re-pin.
+            # Counted under the decision lock: concurrent submitters race
+            # the += and the archive swap otherwise.
+            with self._pool_decision_lock:
+                if self._pool_decision is not None:
+                    self._launches_since_cal += 1
+                    if self._launches_since_cal >= self._recal_interval:
+                        if self._host_pool_probe is not None:
+                            self._host_pool_probe_prev = dict(
+                                self._host_pool_probe
+                            )
+                        self._pool_decision = None
+                        self._launches_since_cal = 0
         if self._pool_decision is None:
             # double-checked: concurrent first submits (two script fibers
             # on the coproc-tick executor) must not calibrate against each

@@ -11,10 +11,12 @@ production paths stay branch-cheap (the breaker_overhead microbench gates
 the disabled check at <1% of the coproc launch path).
 
 Admin wiring: ``GET /v1/failure-probes`` lists registered modules/probes
-and what is currently armed; ``PUT /v1/failure-probes/{module}/{probe}/
-{exception|delay|wedge|terminate}`` arms (enabling the registry first) and
-``DELETE /v1/failure-probes/{module}/{probe}`` disarms — surfaced by
-``rpk debug failpoints``. The coproc fault domains (device dispatch, mask
+and what is currently armed (plus remaining counts for count-limited
+probes); ``PUT /v1/failure-probes/{module}/{probe}/
+{exception|delay|wedge|terminate}[?count=N]`` arms (enabling the registry
+first; ``count=1`` = one-shot, auto-disarming after its first injection)
+and ``DELETE /v1/failure-probes/{module}/{probe}`` disarms — surfaced by
+``rpk debug failpoints arm [--count N]``. The coproc fault domains (device dispatch, mask
 fetch, harvest, shard worker, sandbox compile) register in
 coproc/faults.py; per-RPC-method probes are generated alongside services
 (tools/rpcgen.py:159-165 renders a failure_probes struct per service) and
@@ -25,6 +27,7 @@ automatically; the transport layer registers ``rpc.send``.
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
@@ -45,12 +48,21 @@ class ProbeTriggered(Exception):
 class _Module:
     probes: set = field(default_factory=set)
     armed: dict = field(default_factory=dict)  # probe -> effect
+    # probe -> remaining injections; absent = armed until disarmed. A probe
+    # armed with count=1 ("one-shot") auto-disarms after its first
+    # injection — deterministic single-fault tests without a disarm race.
+    counts: dict = field(default_factory=dict)
 
 
 class HoneyBadger:
     def __init__(self) -> None:
         self._enabled = False
         self._modules: dict[str, _Module] = defaultdict(_Module)
+        # serializes count-limited claims: probe sites fire concurrently
+        # (pool workers, harvester, RPC handlers), and "exactly N
+        # injections" needs an atomic select+decrement. Only taken when
+        # the registry is enabled — the disabled fast path stays lock-free.
+        self._claim_lock = threading.Lock()
         self.delay_ms = 50
         # A wedge simulates an indefinite hang, but an orphaned wedge (the
         # operator forgot to disarm) must not hold a broker thread forever:
@@ -65,6 +77,7 @@ class HoneyBadger:
         self._enabled = False
         for m in self._modules.values():
             m.armed.clear()
+            m.counts.clear()
 
     @property
     def enabled(self) -> bool:
@@ -84,17 +97,32 @@ class HoneyBadger:
             if m.armed
         }
 
-    def set_exception(self, module: str, probe: str) -> None:
-        self._arm(module, probe, EXCEPTION)
+    def armed_counts(self) -> dict[str, dict[str, int]]:
+        """module -> {probe: remaining injections} for count-limited
+        probes only (unlimited probes don't appear)."""
+        return {
+            name: dict(m.counts)
+            for name, m in self._modules.items()
+            if m.counts
+        }
 
-    def set_delay(self, module: str, probe: str) -> None:
-        self._arm(module, probe, DELAY)
+    def remaining(self, module: str, probe: str) -> int | None:
+        """Remaining injections for a count-limited probe; None when the
+        probe is unlimited or not armed."""
+        m = self._modules.get(module)
+        return None if m is None else m.counts.get(probe)
 
-    def set_termination(self, module: str, probe: str) -> None:
-        self._arm(module, probe, TERMINATE)
+    def set_exception(self, module: str, probe: str, count: int | None = None) -> None:
+        self._arm(module, probe, EXCEPTION, count)
 
-    def set_wedge(self, module: str, probe: str) -> None:
-        self._arm(module, probe, WEDGE)
+    def set_delay(self, module: str, probe: str, count: int | None = None) -> None:
+        self._arm(module, probe, DELAY, count)
+
+    def set_termination(self, module: str, probe: str, count: int | None = None) -> None:
+        self._arm(module, probe, TERMINATE, count)
+
+    def set_wedge(self, module: str, probe: str, count: int | None = None) -> None:
+        self._arm(module, probe, WEDGE, count)
 
     def unset(self, module: str, probe: str) -> None:
         # plain lookup, not the defaultdict: disarming a typo'd name must
@@ -102,11 +130,51 @@ class HoneyBadger:
         m = self._modules.get(module)
         if m is not None:
             m.armed.pop(probe, None)
+            m.counts.pop(probe, None)
 
-    def _arm(self, module: str, probe: str, effect: str) -> None:
+    def _arm(self, module: str, probe: str, effect: str, count: int | None = None) -> None:
         if not self._enabled:
             return
-        self._modules[module].armed[probe] = effect
+        m = self._modules[module]
+        m.armed[probe] = effect
+        if count is not None and int(count) > 0:
+            m.counts[probe] = int(count)
+        else:
+            # re-arming without a count clears a stale one-shot budget
+            m.counts.pop(probe, None)
+
+    def _claim(self, module: str, probe: str) -> tuple[str | None, bool]:
+        """Atomically select the effect for ONE injection, consuming a
+        count-limited budget (probe sites race from pool workers — an
+        unlocked check-then-consume would fire a count=1 probe twice).
+        Returns (effect, disarm_after): effect is None when nothing is
+        armed or the budget is spent; disarm_after=True means this was a
+        count-limited WEDGE's last injection — the wedge block polls the
+        armed state, so it stays armed through the block and the SITE
+        disarms it afterwards (counts pinned at 0 meanwhile, so a racing
+        claim sees the drained budget, not an unlimited wedge). Other
+        effects disarm right here at zero. The registry stays enabled
+        either way — the admin DELETE handler owns the
+        last-probe-disables-registry rule."""
+        with self._claim_lock:
+            m = self._modules.get(module)
+            effect = m.armed.get(probe) if m is not None else None
+            if effect is None:
+                return None, False
+            c = m.counts.get(probe)
+            if c is None:
+                return effect, False  # unlimited
+            if c <= 0:
+                return None, False  # drained wedge mid-block elsewhere
+            if c == 1:
+                if effect == WEDGE:
+                    m.counts[probe] = 0
+                    return effect, True
+                m.armed.pop(probe, None)
+                m.counts.pop(probe, None)
+                return effect, False
+            m.counts[probe] = c - 1
+            return effect, False
 
     def _wedged(self, module: str, probe: str) -> bool:
         return (
@@ -118,9 +186,7 @@ class HoneyBadger:
         """Await point placed at each probe site."""
         if not self._enabled:
             return
-        effect = self._modules[module].armed.get(probe)
-        if effect is None:
-            return
+        effect, disarm_after = self._claim(module, probe)
         if effect == DELAY:
             await asyncio.sleep(self.delay_ms / 1000)
         elif effect == EXCEPTION:
@@ -129,6 +195,8 @@ class HoneyBadger:
             deadline = time.monotonic() + self.wedge_max_s
             while time.monotonic() < deadline and self._wedged(module, probe):
                 await asyncio.sleep(0.01)
+            if disarm_after:
+                self.unset(module, probe)
         elif effect == TERMINATE:
             raise SystemExit(f"honey badger terminate: {module}.{probe}")
 
@@ -136,7 +204,7 @@ class HoneyBadger:
         """Synchronous probe site (storage paths, coproc device legs)."""
         if not self._enabled:
             return
-        effect = self._modules[module].armed.get(probe)
+        effect, disarm_after = self._claim(module, probe)
         if effect == EXCEPTION:
             raise ProbeTriggered(f"{module}.{probe}")
         if effect == TERMINATE:
@@ -153,6 +221,8 @@ class HoneyBadger:
             deadline = time.monotonic() + self.wedge_max_s
             while time.monotonic() < deadline and self._wedged(module, probe):
                 time.sleep(0.01)
+            if disarm_after:
+                self.unset(module, probe)
 
 
 honey_badger = HoneyBadger()

@@ -29,6 +29,38 @@ def _pack_paths(paths: list[str]):
     return blob, path_off, path_len, k
 
 
+def _gather_dst_cap(lens: np.ndarray, n: int) -> int:
+    """Worst-case framed payload size for n records with value lengths
+    `lens`: value bytes plus ≤16 bytes of varint framing per record (the
+    same margin rp_frame_records has always used)."""
+    return int(np.maximum(lens, 0).sum()) + 16 * n + 16
+
+
+def _take_scratch(out: np.ndarray | None, cap: int) -> np.ndarray:
+    """Use the caller's scratch buffer when it fits, else allocate. The
+    arena path hands the SAME buffer back launch after launch; a launch
+    bigger than everything before it simply allocates fresh."""
+    if (
+        out is not None
+        and out.dtype == np.uint8
+        and out.ndim == 1
+        and out.nbytes >= cap
+        and out.flags["C_CONTIGUOUS"]
+    ):
+        return out
+    return np.empty(max(cap, 1), dtype=np.uint8)
+
+
+def _check_gather_cols(src_arr, offsets, lens, n: int) -> None:
+    """Every (offset, len) span must lie inside src — the C gather memcpys
+    unchecked."""
+    if n and (
+        offsets.min() < 0
+        or int((offsets + np.maximum(lens, 0)).max()) > src_arr.nbytes
+    ):
+        raise ValueError("gather (offset, len) span outside the source blob")
+
+
 class _NativeLib:
     def __init__(self, dll: ctypes.CDLL):
         self._dll = dll
@@ -117,6 +149,21 @@ class _NativeLib:
             dll.rp_frame_many.restype = ctypes.c_int64
             dll.rp_frame_many.argtypes = [
                 ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
+        self.has_frame_many_gather = hasattr(dll, "rp_frame_many_gather")
+        if self.has_frame_many_gather:
+            dll.rp_frame_gather.restype = ctypes.c_int64
+            dll.rp_frame_gather.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+            dll.rp_frame_many_gather.restype = ctypes.c_int64
+            dll.rp_frame_many_gather.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p,
@@ -262,11 +309,13 @@ class _NativeLib:
         keep: np.ndarray,
         starts: np.ndarray,
         ends: np.ndarray,
+        out: np.ndarray | None = None,
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Frame many [start, end) record ranges in ONE crossing.
 
-        Returns (dst, payload_off[r], payload_len[r], kept[r]); a range's
-        payload is dst[off : off + len].tobytes()."""
+        `out` (optional, uint8 1-D) is reusable caller scratch — see
+        frame_many_gather. Returns (dst, payload_off[r], payload_len[r],
+        kept[r]); a range's payload is dst[off : off + len].tobytes()."""
         rows = np.ascontiguousarray(rows, dtype=np.uint8)
         lens = np.ascontiguousarray(lens, dtype=np.int32)
         keep = np.ascontiguousarray(keep, dtype=np.uint8)
@@ -285,13 +334,94 @@ class _NativeLib:
             or int((ends - starts).sum()) > n
         ):
             raise ValueError("frame_many ranges out of bounds or overlapping")
-        dst = np.empty(n * (stride + 16) + 16, dtype=np.uint8)
+        dst = _take_scratch(out, n * (stride + 16) + 16)
         out_off = np.empty(n_ranges, dtype=np.int64)
         out_len = np.empty(n_ranges, dtype=np.int64)
         out_kept = np.empty(n_ranges, dtype=np.int32)
         self._dll.rp_frame_many(
             rows.ctypes.data, stride, lens.ctypes.data, keep.ctypes.data,
             starts.ctypes.data, ends.ctypes.data, n_ranges, dst.ctypes.data,
+            out_off.ctypes.data, out_len.ctypes.data, out_kept.ctypes.data,
+        )
+        return dst, out_off, out_len, out_kept
+
+    def frame_gather(
+        self,
+        src,
+        offsets: np.ndarray,
+        lens: np.ndarray,
+        keep: np.ndarray,
+        out: np.ndarray | None = None,
+    ) -> tuple[bytes, int]:
+        """ZERO-COPY framing of one record range: kept records frame
+        straight from `src` via (offset, len) columns — no padded row
+        matrix. Returns (payload, kept_count)."""
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        lens = np.ascontiguousarray(lens, dtype=np.int32)
+        keep = np.ascontiguousarray(keep, dtype=np.uint8)
+        n = len(offsets)
+        src_arr = np.frombuffer(src, dtype=np.uint8)
+        _check_gather_cols(src_arr, offsets, lens, n)
+        cap = _gather_dst_cap(lens, n)
+        dst = _take_scratch(out, cap)
+        kept = ctypes.c_int32()
+        length = self._dll.rp_frame_gather(
+            src_arr.ctypes.data, offsets.ctypes.data, lens.ctypes.data,
+            keep.ctypes.data, n, dst.ctypes.data, ctypes.byref(kept),
+        )
+        return dst[:length].tobytes(), kept.value
+
+    def frame_many_gather(
+        self,
+        src,
+        offsets: np.ndarray,
+        lens: np.ndarray,
+        keep: np.ndarray,
+        starts: np.ndarray,
+        ends: np.ndarray,
+        out: np.ndarray | None = None,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Gather-frame many [start, end) record ranges in ONE crossing —
+        the zero-copy twin of frame_many: records frame straight from
+        `src` via per-record (offset, len) columns instead of a padded
+        row matrix. `out` (optional, uint8 1-D) is a caller-owned scratch
+        buffer (arena reuse across launches); it is grown-by-replacement
+        when too small, never written past the returned lengths.
+
+        Returns (dst, payload_off[r], payload_len[r], kept[r]); a range's
+        payload is dst[off : off + len].tobytes()."""
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        lens = np.ascontiguousarray(lens, dtype=np.int32)
+        keep = np.ascontiguousarray(keep, dtype=np.uint8)
+        starts = np.ascontiguousarray(starts, dtype=np.int64)
+        ends = np.ascontiguousarray(ends, dtype=np.int64)
+        n = len(offsets)
+        n_ranges = len(starts)
+        # same posture as frame_many: the C walk is unchecked, so malformed
+        # ranges or out-of-blob (offset, len) spans must be a ValueError
+        # here, not a heap read/write
+        if len(ends) != n_ranges:
+            raise ValueError("starts/ends length mismatch")
+        if n_ranges and (
+            (starts > ends).any()
+            or starts.min() < 0
+            or ends.max() > n
+            or int((ends - starts).sum()) > n
+        ):
+            raise ValueError(
+                "frame_many_gather ranges out of bounds or overlapping"
+            )
+        src_arr = np.frombuffer(src, dtype=np.uint8)
+        _check_gather_cols(src_arr, offsets, lens, n)
+        cap = _gather_dst_cap(lens, n)
+        dst = _take_scratch(out, cap)
+        out_off = np.empty(n_ranges, dtype=np.int64)
+        out_len = np.empty(n_ranges, dtype=np.int64)
+        out_kept = np.empty(n_ranges, dtype=np.int32)
+        self._dll.rp_frame_many_gather(
+            src_arr.ctypes.data, offsets.ctypes.data, lens.ctypes.data,
+            keep.ctypes.data, starts.ctypes.data, ends.ctypes.data,
+            n_ranges, dst.ctypes.data,
             out_off.ctypes.data, out_len.ctypes.data, out_kept.ctypes.data,
         )
         return dst, out_off, out_len, out_kept

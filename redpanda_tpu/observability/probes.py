@@ -63,6 +63,19 @@ coproc_shard_rows_hist = registry.histogram(
     "coproc_shard_rows",
     "Records per host-stage shard (coproc_host_workers fan-out)",
 )
+# Harvest framing path, per framing crossing (launch- or shard-level):
+# gather = zero-copy framing straight from the joined blob's (offset, len)
+# columns; padded = the row-matrix path (byte-mutating transforms).
+coproc_harvest_gather = registry.counter(
+    "coproc_harvest_path_total",
+    "Harvest framing crossings by path",
+    mode="gather",
+)
+coproc_harvest_padded = registry.counter(
+    "coproc_harvest_path_total",
+    "Harvest framing crossings by path",
+    mode="padded",
+)
 
 # -------------------------------------------------------- coproc fault domains
 # Classified failure counter, one series per (fault domain, exception kind):
@@ -198,6 +211,8 @@ __all__ = [
     "coproc_failure_counter",
     "coproc_fallback_rows",
     "coproc_h2d_bytes",
+    "coproc_harvest_gather",
+    "coproc_harvest_padded",
     "coproc_host_pool_busy",
     "coproc_launch_rows_hist",
     "coproc_retries_total",

@@ -1,0 +1,25 @@
+"""Fixture: disguised blocking sleeps reaching async bodies (SLP80x)."""
+import asyncio
+import time as t
+from time import sleep
+from time import sleep as snooze
+
+
+def _retry_backoff(n):
+    for i in range(n):
+        t.sleep(0.01)  # helper body: makes it a sleepy helper, not flagged here
+
+
+async def handler():
+    sleep(0.1)
+    snooze(0.2)
+    t.sleep(0.3)
+    _retry_backoff(3)
+    await asyncio.to_thread(_retry_backoff, 3)  # offloaded: clean
+    return 1
+
+
+def sync_caller():
+    # sync context: helpers may block freely
+    _retry_backoff(1)
+    sleep(0.1)

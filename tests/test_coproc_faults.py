@@ -652,6 +652,112 @@ def test_sandbox_compile_fault_refuses_registration():
     assert engine.heartbeat() == 0, "a poisoned compile must not register"
 
 
+# ------------------------------------------------------------ arm-once probes
+def test_one_shot_probe_auto_disarms_after_first_injection():
+    honey_badger.enable()
+    honey_badger.set_exception(faults.MODULE, faults.DEVICE_DISPATCH, count=1)
+    assert honey_badger.remaining(faults.MODULE, faults.DEVICE_DISPATCH) == 1
+    with pytest.raises(ProbeTriggered):
+        faults.inject(faults.DEVICE_DISPATCH)
+    # auto-disarmed: the second injection is a no-op, nothing stays armed
+    faults.inject(faults.DEVICE_DISPATCH)
+    assert honey_badger.armed() == {}
+    assert honey_badger.remaining(faults.MODULE, faults.DEVICE_DISPATCH) is None
+    # the REGISTRY stays enabled — other probes may be armed; the admin
+    # DELETE handler owns the last-probe-disables-registry rule
+    assert honey_badger.enabled
+
+
+def test_count_n_probe_fires_exactly_n_times():
+    honey_badger.enable()
+    honey_badger.set_exception(faults.MODULE, faults.MASK_FETCH, count=3)
+    for i in range(3):
+        assert honey_badger.remaining(faults.MODULE, faults.MASK_FETCH) == 3 - i
+        with pytest.raises(ProbeTriggered):
+            faults.inject(faults.MASK_FETCH)
+    faults.inject(faults.MASK_FETCH)  # budget spent: no raise
+
+
+def test_one_shot_wedge_blocks_once_then_disarms():
+    honey_badger.enable()
+    honey_badger.wedge_max_s = 0.05
+    honey_badger.set_wedge(faults.MODULE, faults.HARVEST, count=1)
+    t0 = time.perf_counter()
+    faults.inject(faults.HARVEST)  # wedges for the full cap, ONCE
+    assert time.perf_counter() - t0 >= 0.04
+    t0 = time.perf_counter()
+    faults.inject(faults.HARVEST)  # disarmed: immediate
+    assert time.perf_counter() - t0 < 0.04
+    assert honey_badger.armed() == {}
+
+
+def test_one_shot_async_probe_consumes():
+    import asyncio
+
+    honey_badger.enable()
+    honey_badger.set_exception("rpc", "send", count=1)
+
+    async def main():
+        with pytest.raises(ProbeTriggered):
+            await honey_badger.maybe_inject("rpc", "send")
+        await honey_badger.maybe_inject("rpc", "send")  # spent: no raise
+
+    asyncio.run(main())
+    assert honey_badger.armed() == {}
+
+
+def test_one_shot_claim_is_atomic_under_concurrency():
+    """Probe sites fire concurrently (pool workers, harvester): a count=N
+    budget must yield EXACTLY N injections no matter how many threads
+    race the claim."""
+    honey_badger.enable()
+    honey_badger.set_exception(faults.MODULE, faults.SHARD_WORKER, count=3)
+    fired = []
+    start = threading.Barrier(8)
+
+    def site():
+        start.wait()
+        for _ in range(4):
+            try:
+                faults.inject(faults.SHARD_WORKER)
+            except ProbeTriggered:
+                fired.append(1)
+
+    threads = [threading.Thread(target=site) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(fired) == 3, f"count=3 probe fired {len(fired)} times"
+    assert honey_badger.armed() == {}
+
+
+def test_rearm_without_count_clears_one_shot_budget():
+    honey_badger.enable()
+    honey_badger.set_exception(faults.MODULE, faults.HARVEST, count=1)
+    honey_badger.set_exception(faults.MODULE, faults.HARVEST)  # now unlimited
+    assert honey_badger.remaining(faults.MODULE, faults.HARVEST) is None
+    for _ in range(3):
+        with pytest.raises(ProbeTriggered):
+            faults.inject(faults.HARVEST)
+
+
+def test_one_shot_dispatch_fault_is_a_deterministic_single_retry():
+    """The arm-once use case end to end: ONE injected dispatch fault means
+    the engine retries exactly once, the retry hits a healthy device, and
+    output is exact — no disarm race deciding how many launches fault."""
+    baseline = _engine(force_mode="columnar_device").process_batch(_req())
+    engine = _engine(force_mode="columnar_device")
+    honey_badger.enable()
+    honey_badger.set_exception(faults.MODULE, faults.DEVICE_DISPATCH, count=1)
+    reply = engine.process_batch(_req())
+    assert _payloads(reply) == _payloads(baseline)
+    stats = engine.stats()
+    assert stats.get("n_retries", 0.0) == 1.0, stats
+    assert stats.get("n_fallback_rows", 0.0) == 0.0, stats
+    assert honey_badger.armed() == {}
+
+
 # ------------------------------------------------------------ admin round trip
 def test_admin_failure_probe_round_trip(tmp_path):
     import asyncio
@@ -695,6 +801,32 @@ def test_admin_failure_probe_round_trip(tmp_path):
                 }
                 with pytest.raises(ProbeTriggered):
                     faults.inject(faults.DEVICE_DISPATCH)
+                # count-limited arm: ?count=N rides the PUT, shows in the
+                # counts view, and auto-disarms after N injections
+                r = await s.put(
+                    f"{base}/v1/failure-probes/coproc/shard_worker/"
+                    f"exception?count=2"
+                )
+                assert r.status == 200
+                assert (await r.json())["count"] == 2
+                body = await (await s.get(f"{base}/v1/failure-probes")).json()
+                assert body["counts"]["coproc"]["shard_worker"] == 2
+                with pytest.raises(ProbeTriggered):
+                    faults.inject(faults.SHARD_WORKER)
+                body = await (await s.get(f"{base}/v1/failure-probes")).json()
+                assert body["counts"]["coproc"]["shard_worker"] == 1
+                with pytest.raises(ProbeTriggered):
+                    faults.inject(faults.SHARD_WORKER)
+                body = await (await s.get(f"{base}/v1/failure-probes")).json()
+                assert "shard_worker" not in body["armed"].get("coproc", {})
+                assert "shard_worker" not in body["counts"].get("coproc", {})
+                # malformed counts are a 400, not a silently-unlimited arm
+                for bad in ("0", "-1", "bogus"):
+                    r = await s.put(
+                        f"{base}/v1/failure-probes/coproc/shard_worker/"
+                        f"exception?count={bad}"
+                    )
+                    assert r.status == 400, bad
                 # unknown probe names 404 loudly (a typo'd campaign is dead)
                 r = await s.put(
                     f"{base}/v1/failure-probes/coproc/tpyo/exception"

@@ -1,0 +1,516 @@
+"""Zero-copy harvest parity (ISSUE 5).
+
+The gather path's correctness argument is "framing kept records straight
+from the joined blob via (offset, len) is byte-identical to packing a
+padded row matrix and framing from that" — pinned down from four sides:
+
+- codec level: frame_ranges_gather (native AND python fallback) vs
+  frame_ranges over rows packed from the same (offset, len) table, across
+  compressed/null-value/empty-value/zero-record batch scenarios;
+- engine level: gather-on vs gather-off engines produce bit-identical
+  replies for every plan kind (passthrough filter, identity, projection,
+  uppercase, payload) × pool on/off × native on/off, with the
+  byte-mutating plans proving they stay on the padded path;
+- the sharded recompress+seal merges in input order with offsets/CRCs
+  bit-identical to the serial loop, and sealed batches survive a CRC
+  round trip through a real storage append;
+- arena reuse accounting, reset_arenas(), and the periodic host-pool
+  re-calibration hook.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from redpanda_tpu.coproc import (
+    EnableResponseCode,
+    ProcessBatchRequest,
+    TpuEngine,
+)
+from redpanda_tpu.coproc import batch_codec
+from redpanda_tpu.coproc import engine as engine_mod
+from redpanda_tpu.coproc.column_plan import plan_spec
+from redpanda_tpu.coproc.engine import ProcessBatchItem
+from redpanda_tpu.models import Compression, NTP, Record, RecordBatch
+from redpanda_tpu.ops.exprs import field
+from redpanda_tpu.ops.transforms import (
+    Int,
+    Str,
+    filter_contains,
+    identity,
+    map_project,
+    map_uppercase,
+    where,
+)
+
+
+def _filter_spec():
+    return where(field("level") == "error")  # passthrough: byte-identity
+
+
+def _project_spec():
+    return where(field("level") == "error") | map_project(Int("code"), Str("msg", 16))
+
+
+def _json_batch(n, base_offset=0, codec=Compression.none, empty_every=0, null_every=0):
+    recs = []
+    for i in range(n):
+        if null_every and i % null_every == 0:
+            value = None
+        elif empty_every and i % empty_every == 0:
+            value = b""
+        else:
+            value = json.dumps(
+                {"level": ["error", "info"][i % 2], "code": i, "msg": f"m{i}"},
+                separators=(",", ":"),
+            ).encode()
+        recs.append(Record(offset_delta=i, timestamp_delta=i, value=value))
+    return RecordBatch.build(
+        recs, base_offset=base_offset, compression=codec, first_timestamp=1000
+    )
+
+
+def _scenarios():
+    return {
+        "plain": [_json_batch(8), _json_batch(6, base_offset=8)],
+        "compressed": [
+            _json_batch(8, codec=Compression.lz4),
+            _json_batch(6, base_offset=8, codec=Compression.gzip),
+        ],
+        "empty_values": [_json_batch(9, empty_every=3), _json_batch(5)],
+        "null_values": [_json_batch(9, null_every=3), _json_batch(5)],
+        "zero_record": [_json_batch(0), _json_batch(7), _json_batch(0)],
+        "all_zero": [_json_batch(0), _json_batch(0)],
+    }
+
+
+# ------------------------------------------------------------ codec parity
+def _gather_vs_padded(batches, use_native: bool, monkeypatch):
+    ex = batch_codec.explode_batches(batches)
+    keep = (np.arange(len(ex.sizes)) % 3) != 1  # arbitrary non-trivial mask
+    n = len(ex.sizes)
+    stride = max(int(ex.sizes.max()) if n else 1, 1)
+    if not use_native:
+        monkeypatch.setattr(batch_codec, "_native", lambda: None)
+    rows, lens = engine_mod._pack_values(ex, stride)
+    padded = batch_codec.frame_ranges(rows, lens, keep, ex.ranges)
+    gathered = batch_codec.frame_ranges_gather(
+        ex.joined, ex.offsets, ex.sizes, keep, ex.ranges
+    )
+    return padded, gathered
+
+
+@pytest.mark.parametrize("name", sorted(_scenarios()))
+def test_frame_gather_matches_padded_native(name, monkeypatch):
+    padded, gathered = _gather_vs_padded(_scenarios()[name], True, monkeypatch)
+    assert gathered == padded
+
+
+@pytest.mark.parametrize("name", sorted(_scenarios()))
+def test_frame_gather_matches_padded_python(name, monkeypatch):
+    """The python fallback (_frame_gather_py) must emit the exact same
+    varint framing as the native symbol and the padded python path."""
+    padded, gathered = _gather_vs_padded(_scenarios()[name], False, monkeypatch)
+    assert gathered == padded
+
+
+def test_frame_gather_empty_ranges_both_paths(monkeypatch):
+    src = b"abcdef"
+    offs = np.zeros(0, np.int64)
+    lens = np.zeros(0, np.int32)
+    keep = np.zeros(0, bool)
+    assert batch_codec.frame_ranges_gather(src, offs, lens, keep, []) == []
+    monkeypatch.setattr(batch_codec, "_native", lambda: None)
+    assert batch_codec.frame_ranges_gather(src, offs, lens, keep, []) == []
+
+
+def test_frame_gather_single_range_matches_frame_records():
+    """The single-range binding (rp_frame_gather) must emit exactly what
+    frame_records emits from rows packed off the same (offset, len)
+    table — rp_frame_many_gather routes through it per range, so this
+    parity covers the shared C body directly."""
+    from redpanda_tpu.native import lib
+
+    if lib is None or not getattr(lib, "has_frame_many_gather", False):
+        pytest.skip("native gather unavailable")
+    ex = batch_codec.explode_batches(_scenarios()["plain"])
+    n = len(ex.sizes)
+    keep = (np.arange(n) % 2) == 0
+    stride = max(int(ex.sizes.max()), 1)
+    rows, lens = engine_mod._pack_values(ex, stride)
+    want = batch_codec.frame_records(rows, lens, keep)
+    got = lib.frame_gather(ex.joined, ex.offsets, ex.sizes, keep)
+    assert got == want
+
+
+def test_gather_framing_failure_retries_with_cached_keep(monkeypatch):
+    """A framing failure after the mask was resolved must NOT lose the
+    keep mask: _resolve_keep consumes the slot, so the retry relies on
+    the cached _gather_mat — an uncached retry would read the empty slot
+    as 'no predicate' and silently emit keep-all output."""
+    req = _matrix_request(n_items=2)
+    engine = TpuEngine(
+        row_stride=256, compress_threshold=10**9,
+        force_mode="columnar_host", host_workers=0,
+    )
+    engine.enable_coprocessors([(1, _filter_spec().to_json(), ("orders",))])
+    expected = _reply_bits(engine.process_batch(req))
+
+    real = batch_codec.frame_ranges_gather
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise MemoryError("simulated framing allocation failure")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(batch_codec, "frame_ranges_gather", flaky)
+    ticket = engine.submit(req)
+    first = ticket.result()  # framing fails -> skip_on_failure empties items
+    assert all(not it.batches for it in first.items)
+    # harvesting the SAME launch again retries framing (the launch's mask
+    # slot is already consumed) and must produce the exact filtered
+    # output, not unfiltered keep-all
+    second = ticket.result()
+    engine.shutdown()
+    assert calls["n"] == 2
+    assert _reply_bits(second) == expected
+
+
+def test_frame_many_gather_rejects_bad_spans():
+    from redpanda_tpu.native import lib
+
+    if lib is None or not getattr(lib, "has_frame_many_gather", False):
+        pytest.skip("native gather unavailable")
+    src = b"abcdef"
+    keep = np.ones(2, np.uint8)
+    starts = np.array([0], np.int64)
+    ends = np.array([2], np.int64)
+    with pytest.raises(ValueError):
+        # span past the end of src: must be a ValueError, not a heap read
+        lib.frame_many_gather(
+            src, np.array([0, 4], np.int64), np.array([3, 10], np.int32),
+            keep, starts, ends,
+        )
+    with pytest.raises(ValueError):
+        lib.frame_many_gather(
+            src, np.array([-1, 0], np.int64), np.array([1, 1], np.int32),
+            keep, starts, ends,
+        )
+    with pytest.raises(ValueError):  # overlapping ranges
+        lib.frame_many_gather(
+            src, np.array([0, 1], np.int64), np.array([1, 1], np.int32),
+            keep, np.array([0, 0], np.int64), np.array([2, 2], np.int64),
+        )
+
+
+# ------------------------------------------------------------ arena
+def test_arena_reuses_and_caps():
+    arena = batch_codec.Arena()
+    a = arena.acquire(100)
+    arena.release(a)
+    b = arena.acquire(50)  # smaller request reuses the bigger buffer
+    assert b is a
+    st = arena.stats()
+    assert st["allocs"] == 1 and st["reuses"] == 1
+    arena.release(b)
+    # the free list is bounded
+    bufs = [arena.acquire(10) for _ in range(batch_codec.Arena.MAX_FREE + 4)]
+    for buf in bufs:
+        arena.release(buf)
+    assert arena.stats()["free_buffers"] <= batch_codec.Arena.MAX_FREE
+
+
+def test_frame_gather_arena_reuse_is_bit_identical():
+    batches = _scenarios()["plain"]
+    ex = batch_codec.explode_batches(batches)
+    keep = np.ones(len(ex.sizes), bool)
+    arena = batch_codec.Arena()
+    first = batch_codec.frame_ranges_gather(
+        ex.joined, ex.offsets, ex.sizes, keep, ex.ranges, arena=arena
+    )
+    second = batch_codec.frame_ranges_gather(
+        ex.joined, ex.offsets, ex.sizes, keep, ex.ranges, arena=arena
+    )
+    assert first == second
+    st = arena.stats()
+    if batch_codec._native() is not None:
+        assert st["reuses"] >= 1, st
+
+
+# ------------------------------------------------------ engine parity matrix
+def _reply_bits(reply):
+    return [
+        (it.script_id, str(it.source),
+         [(b.payload, b.header.crc, b.header.header_crc, b.header.record_count)
+          for b in it.batches])
+        for it in reply.items
+    ]
+
+
+def _run_engine(spec, force_mode, workers, gather, req):
+    engine = TpuEngine(
+        row_stride=256,
+        compress_threshold=10**9,
+        force_mode=force_mode,
+        host_workers=workers,
+        host_pool_probe=False,  # parity must exercise the fan-out
+        gather_frame=gather,
+    )
+    codes = engine.enable_coprocessors([(1, spec.to_json(), ("orders",))])
+    assert codes == [EnableResponseCode.success]
+    reply = engine.process_batch(req)
+    stats = engine.stats()
+    engine.shutdown()
+    return reply, stats
+
+
+def _matrix_request(n_items=6, n_recs=40):
+    return ProcessBatchRequest(
+        [
+            ProcessBatchItem(
+                1,
+                NTP.kafka("orders", p),
+                [
+                    _json_batch(n_recs, base_offset=100 * p),
+                    _json_batch(
+                        n_recs - 7, base_offset=100 * p + 50,
+                        empty_every=5, null_every=7,
+                    ),
+                ]
+                # zero-record batches must survive the launch-wide framing
+                # (an empty payload, kept=0) in every mode
+                + ([_json_batch(0, base_offset=100 * p + 90)] if p == 0 else []),
+            )
+            for p in range(n_items)
+        ]
+    )
+
+
+_MATRIX = [
+    ("passthrough_host", _filter_spec(), "columnar_host", True),
+    ("passthrough_device", _filter_spec(), "columnar_device", True),
+    ("identity", identity(), None, True),
+    ("projection", _project_spec(), "columnar_host", False),
+    ("uppercase", map_uppercase(), None, False),
+    ("payload", filter_contains(b"error"), None, False),
+]
+
+
+@pytest.mark.parametrize("use_native", [True, False], ids=["native", "no_native"])
+@pytest.mark.parametrize("workers", [0, 4], ids=["inline", "pool"])
+@pytest.mark.parametrize(
+    "name,spec,force_mode,expect_gather",
+    _MATRIX,
+    ids=[m[0] for m in _MATRIX],
+)
+def test_gather_bit_identical_to_padded(
+    name, spec, force_mode, expect_gather, workers, use_native, monkeypatch
+):
+    """Gather-on vs gather-off engines must agree byte-for-byte in every
+    plan kind × pool × native combination — and only byte-identity plans
+    may actually take the gather path."""
+    monkeypatch.setattr(engine_mod, "_SHARD_MIN_ROWS", 32)
+    if not use_native:
+        monkeypatch.setattr(batch_codec, "_native", lambda: None)
+    req = _matrix_request()
+    on, stats_on = _run_engine(spec, force_mode, workers, True, req)
+    off, stats_off = _run_engine(spec, force_mode, workers, False, req)
+    assert _reply_bits(on) == _reply_bits(off)
+    if expect_gather:
+        assert stats_on.get("n_frame_gather", 0.0) >= 1.0, stats_on
+        assert "n_frame_padded" not in stats_on
+    else:
+        # byte-mutating transforms must stay on the padded path even with
+        # gather enabled
+        assert "n_frame_gather" not in stats_on, stats_on
+    assert "n_frame_gather" not in stats_off
+
+
+def test_sharded_gather_matches_inline_gather(monkeypatch):
+    """Sharded launches gather-frame per shard; concatenated output must be
+    bit-identical to the inline gather path (extends the PR 3 suite)."""
+    monkeypatch.setattr(engine_mod, "_SHARD_MIN_ROWS", 32)
+    req = _matrix_request()
+    inline, _ = _run_engine(_filter_spec(), "columnar_host", 0, True, req)
+    sharded, stats = _run_engine(_filter_spec(), "columnar_host", 4, True, req)
+    assert stats["n_sharded_launches"] >= 1
+    assert stats.get("n_frame_gather", 0.0) >= 2.0  # one per shard
+    assert _reply_bits(inline) == _reply_bits(sharded)
+
+
+# ------------------------------------------------------ sharded seal
+def test_sharded_seal_engages_and_matches_serial(monkeypatch):
+    """With the pool pinned on and a reply of >= _SEAL_MIN_BATCHES output
+    batches, the recompress+seal fans out (t_sharded_seal/t_shard_seal)
+    and the sealed batches are bit-identical to the workers=0 serial
+    loop — compression ON so the recompress actually runs."""
+    monkeypatch.setattr(engine_mod, "_SHARD_MIN_ROWS", 32)
+    req = _matrix_request(n_items=10, n_recs=48)
+
+    def run(workers):
+        engine = TpuEngine(
+            row_stride=256,
+            compress_threshold=64,  # small: every batch recompresses
+            force_mode="columnar_host",
+            host_workers=workers,
+            host_pool_probe=False,
+            gather_frame=True,
+        )
+        engine.enable_coprocessors([(1, _filter_spec().to_json(), ("orders",))])
+        reply = engine.process_batch(req)
+        stats = engine.stats()
+        engine.shutdown()
+        return reply, stats
+
+    serial, stats0 = run(0)
+    sharded, stats4 = run(4)
+    assert "t_sharded_seal" in stats4 and "t_shard_seal" in stats4, stats4
+    assert "t_seal" in stats0 and "t_sharded_seal" not in stats0
+    assert _reply_bits(serial) == _reply_bits(sharded)
+    for it in sharded.items:  # the recompressed output really is compressed
+        for b in it.batches:
+            assert b.header.attrs != 0
+
+
+def test_seal_below_threshold_stays_inline(monkeypatch):
+    monkeypatch.setattr(engine_mod, "_SHARD_MIN_ROWS", 32)
+    req = _matrix_request(n_items=2)  # 2 slots < _SEAL_MIN_BATCHES jobs? 4 jobs
+    _, stats = _run_engine(_filter_spec(), "columnar_host", 4, True, req=req)
+    # 4 output batches < 8: the fan-out must not engage
+    assert "t_sharded_seal" not in stats
+
+
+# ------------------------------------------------------ storage round trip
+def test_sealed_batches_survive_storage_append(tmp_path):
+    """Engine output (gather path, recompressed) appended to a real DiskLog
+    must read back byte-identical with valid kafka + header CRCs."""
+    from redpanda_tpu.storage import DiskLog, LogConfig
+
+    req = _matrix_request(n_items=4)
+    engine = TpuEngine(
+        row_stride=256,
+        compress_threshold=64,
+        force_mode="columnar_host",
+        host_workers=0,
+        gather_frame=True,
+    )
+    engine.enable_coprocessors([(1, _filter_spec().to_json(), ("orders",))])
+    reply = engine.process_batch(req)
+    engine.shutdown()
+    out_batches = [b for it in reply.items for b in it.batches]
+    assert out_batches
+
+    async def roundtrip():
+        log = await DiskLog.open(
+            NTP.kafka("orders_mat", 0),
+            LogConfig(base_dir=str(tmp_path), fsync_on_append=False),
+        )
+        await log.append(out_batches)
+        got = await log.read(0, max_bytes=1 << 30)
+        await log.close()
+        return got
+
+    got = asyncio.run(roundtrip())
+    assert len(got) == len(out_batches)
+    for orig, back in zip(out_batches, got):
+        assert back.payload == orig.payload
+        assert back.header.crc == orig.header.crc
+        assert back.verify_kafka_crc() and back.verify_header_crc()
+
+
+# ------------------------------------------------------ arena on the engine
+def test_engine_arena_reuse_and_reset():
+    req = _matrix_request(n_items=4)
+    engine = TpuEngine(
+        row_stride=256, compress_threshold=10**9,
+        force_mode="columnar_host", host_workers=0,
+    )
+    engine.enable_coprocessors([(1, _filter_spec().to_json(), ("orders",))])
+    engine.process_batch(req)
+    engine.process_batch(req)
+    st = engine.stats()["arena"]
+    if batch_codec._native() is not None:
+        assert st["reuses"] >= 1, st
+    engine.reset_arenas()
+    st2 = engine.stats()["arena"]
+    assert st2["allocs"] == 0 and st2["reuses"] == 0
+    engine.shutdown()
+
+
+# ------------------------------------------------------ pool re-calibration
+def _recal_engine(monkeypatch, interval, ratios):
+    """Engine whose pool measurement returns the next (t_inline, t_sharded)
+    pair from `ratios` on each calibration."""
+    monkeypatch.setattr(engine_mod, "_SHARD_MIN_ROWS", 32)
+    seq = list(ratios)
+
+    def fake_measure(self, plan, batches, counts):
+        return seq.pop(0)
+
+    monkeypatch.setattr(TpuEngine, "_measure_pool_ratio", fake_measure)
+    engine = TpuEngine(
+        row_stride=256, compress_threshold=10**9,
+        force_mode="columnar_host", host_workers=4,
+        host_pool_recal_launches=interval,
+    )
+    engine.enable_coprocessors([(1, _filter_spec().to_json(), ("orders",))])
+    return engine
+
+
+def test_recalibration_reprobes_and_archives(monkeypatch):
+    """interval=2: launch 1 calibrates (inline wins), launch 3 re-measures
+    (sharded now wins) — the decision flips and the first probe is
+    archived under host_pool_probe_prev."""
+    engine = _recal_engine(
+        monkeypatch, 2, [(0.010, 0.009), (0.010, 0.005)]
+    )
+    req = _matrix_request(n_items=4)
+    for _ in range(3):
+        engine.process_batch(req)
+    stats = engine.stats()
+    engine.shutdown()
+    assert stats["host_pool_probe"]["chosen"] == "sharded"
+    assert stats["host_pool_probe_prev"]["chosen"] == "inline"
+    assert stats["host_pool_recal"]["interval"] == 2
+    assert stats["n_sharded_launches"] >= 1
+
+
+def test_recalibration_zero_pins_forever(monkeypatch):
+    engine = _recal_engine(monkeypatch, 0, [(0.010, 0.009)])
+    req = _matrix_request(n_items=4)
+    for _ in range(4):
+        engine.process_batch(req)
+    stats = engine.stats()
+    engine.shutdown()
+    # one calibration, never re-measured (the fake would IndexError)
+    assert stats["host_pool_probe"]["chosen"] == "inline"
+    assert "host_pool_probe_prev" not in stats
+    assert stats["host_pool_recal"]["interval"] == 0
+
+
+def test_recalibration_skipped_when_probe_pinned_off(monkeypatch):
+    """host_pool_probe=False is an explicit operator pin: the periodic
+    re-calibration must never override it."""
+    monkeypatch.setattr(engine_mod, "_SHARD_MIN_ROWS", 32)
+
+    def boom(self, plan, batches, counts):  # pragma: no cover
+        raise AssertionError("pinned engine must never measure")
+
+    monkeypatch.setattr(TpuEngine, "_measure_pool_ratio", boom)
+    engine = TpuEngine(
+        row_stride=256, compress_threshold=10**9,
+        force_mode="columnar_host", host_workers=4,
+        host_pool_probe=False, host_pool_recal_launches=1,
+    )
+    engine.enable_coprocessors([(1, _filter_spec().to_json(), ("orders",))])
+    req = _matrix_request(n_items=4)
+    for _ in range(3):
+        engine.process_batch(req)
+    stats = engine.stats()
+    engine.shutdown()
+    assert stats["n_sharded_launches"] >= 3
+    assert stats["host_pool_recal"]["interval"] == 0  # reported as pinned

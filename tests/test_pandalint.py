@@ -147,6 +147,40 @@ def test_lock_rpc_scope_is_package_wide(tmp_path):
         assert any(f.rule.startswith("LCK") for f in report.findings), sub
 
 
+def test_sleep_async_rules_exact_lines():
+    got = _active(_lint(os.path.join(FIXTURES, "sleep_async.py")))
+    assert got == [
+        ("SLP801", 14),  # from time import sleep
+        ("SLP801", 15),  # from time import sleep as snooze
+        ("SLP801", 16),  # import time as t; t.sleep
+        ("SLP802", 17),  # module-local sleepy helper called on the loop
+    ]
+
+
+def test_sleep_async_exempts_finjector(tmp_path):
+    """The finjector's deliberate blocking sleeps ARE the injected fault;
+    the checker must skip it wholesale (module file or package dir), and
+    RCT101's literal time.sleep stays its finding — not double-flagged."""
+    cfg = Config()
+    pkg = tmp_path / "redpanda_tpu"
+    pkg.mkdir(parents=True)
+    for rel, expect in (
+        ("redpanda_tpu/finjector.py", False),
+        ("redpanda_tpu/finjector/effects.py", False),
+        ("redpanda_tpu/coproc/sleepy.py", True),
+    ):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(os.path.join(FIXTURES, "sleep_async.py"), dst)
+        report = LintEngine(cfg).lint_file(str(dst), rel)
+        assert any(f.rule.startswith("SLP") for f in report.findings) is expect, rel
+        # the plain spelling is never SLP-flagged anywhere (RCT101 owns it)
+        assert not any(
+            f.rule.startswith("SLP") and f.line == 16 and "t.sleep" not in f.message
+            for f in report.findings
+        )
+
+
 def test_iobuf_rules_exact_lines():
     got = _active(_lint(os.path.join(FIXTURES, "copy_loop.py")))
     assert got == [

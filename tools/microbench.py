@@ -201,6 +201,89 @@ def bench_host_pool_scaling(secs: float) -> dict:
     return out
 
 
+def bench_harvest_path(secs: float) -> dict:
+    """Zero-copy harvest: gather vs padded framing on the 64-partition
+    JSON-filter workload (a pure where-filter -> passthrough plan, ~1KB
+    records — the shape where the padded path's [N, maxlen] row matrix
+    is pure overhead).
+
+    STAGE-TIME criterion, min-of-blocks: wall-clock A/B on a shared box
+    has ±30% A/A skew, so each block runs the same tick count and sums the
+    engine's own harvest-side stage seconds (extract_proj + assemble +
+    frame + seal); the per-mode result is the BEST block. The output
+    recompression cost is mode-independent (identical bytes compress on
+    both paths), so compress_threshold is maxed to keep the codec's
+    throughput — measured by zstd_stream on its own — from diluting the
+    copy physics the gather path removes."""
+    from redpanda_tpu.coproc import TpuEngine, ProcessBatchRequest
+    from redpanda_tpu.coproc.engine import ProcessBatchItem
+    from redpanda_tpu.models import NTP
+    from redpanda_tpu.models.record import Record, RecordBatch
+    from redpanda_tpu.ops.exprs import field
+    from redpanda_tpu.ops.transforms import where
+
+    rng = np.random.default_rng(11)
+    spec = where(field("level") == "error")
+    items = []
+    for p in range(64):
+        recs = [
+            Record(
+                offset_delta=i,
+                value=json.dumps({
+                    "level": ["error", "info", "warn"][(p + i) % 3],
+                    "code": i,
+                    "msg": "x" * (900 + int(rng.integers(0, 100))),
+                }).encode(),
+            )
+            for i in range(32)
+        ]
+        items.append(
+            ProcessBatchItem(1, NTP.kafka("bench", p), [RecordBatch.build(recs, base_offset=0)])
+        )
+    req = ProcessBatchRequest(items)
+    n_recs = 64 * 32
+    stage_keys = (
+        "t_extract_proj", "t_assemble", "t_rebuild",
+        "t_frame_gather", "t_seal", "t_sharded_seal",
+    )
+    ticks_per_block = 4
+    out = {}
+    for mode, gather in (("gather", True), ("padded", False)):
+        engine = TpuEngine(
+            row_stride=1152,
+            compress_threshold=10**9,
+            force_mode="columnar_host",
+            host_workers=0,
+            gather_frame=gather,
+        )
+        codes = engine.enable_coprocessors([(1, spec.to_json(), ("bench",))])
+        assert codes == [0]
+        engine.process_batch(req)  # warmup
+        best_stage = float("inf")
+        best_rate = 0.0
+        t_end = time.perf_counter() + secs
+        while time.perf_counter() < t_end:
+            engine.reset_stats()
+            t0 = time.perf_counter()
+            for _ in range(ticks_per_block):
+                engine.process_batch(req)
+            dt = time.perf_counter() - t0
+            stats = engine.stats()
+            block = sum(stats.get(k, 0.0) for k in stage_keys)
+            best_stage = min(best_stage, block)
+            best_rate = max(best_rate, ticks_per_block * n_recs / dt)
+        out[f"harvest_{mode}_stage_s"] = round(best_stage, 6)
+        out[f"harvest_{mode}_recs_per_s"] = round(best_rate, 1)
+        engine.shutdown()
+    gather_s = out["harvest_gather_stage_s"]
+    padded_s = out["harvest_padded_stage_s"]
+    out["harvest_speedup"] = round(padded_s / gather_s, 3) if gather_s > 0 else 0.0
+    out["harvest_stage_cut_pct"] = (
+        round((1.0 - gather_s / padded_s) * 100.0, 1) if padded_s > 0 else 0.0
+    )
+    return out
+
+
 def bench_compaction_index(secs: float) -> dict:
     """Key-index build rate (compaction_idx_bench shape)."""
     from redpanda_tpu.storage.compaction import KeyLatestIndex
@@ -506,6 +589,7 @@ BENCHES = {
     "batch_codec": bench_batch_codec,
     "explode_find": bench_explode_find,
     "host_pool_scaling": bench_host_pool_scaling,
+    "harvest_path": bench_harvest_path,
     "compaction_index": bench_compaction_index,
     "allocation": bench_allocation,
     "rpc_echo": bench_rpc_echo,
@@ -549,6 +633,14 @@ def main(argv=None) -> int:
         "share of the launch path exceeds PCT percent; implies the "
         "breaker_overhead bench",
     )
+    p.add_argument(
+        "--assert-harvest-speedup",
+        type=float,
+        metavar="RATIO",
+        help="fail (exit 1) if the gather harvest path's stage-time "
+        "speedup over the padded path falls below RATIO (e.g. 1.33 = a "
+        "25%% cut); implies the harvest_path bench",
+    )
     args = p.parse_args(argv)
     names = list(args.benches)
     if args.only:
@@ -564,6 +656,8 @@ def main(argv=None) -> int:
         names.append("host_pool_scaling")
     if args.assert_breaker_overhead is not None and "breaker_overhead" not in names:
         names.append("breaker_overhead")
+    if args.assert_harvest_speedup is not None and "harvest_path" not in names:
+        names.append("harvest_path")
     snap_before = None
     if args.metrics_snapshot:
         from redpanda_tpu.metrics import registry
@@ -605,6 +699,15 @@ def main(argv=None) -> int:
             print(
                 f"breaker overhead {pct}% exceeds budget "
                 f"{args.assert_breaker_overhead}%",
+                file=sys.stderr,
+            )
+            return 1
+    if args.assert_harvest_speedup is not None:
+        ratio = out.get("harvest_speedup", 0.0)
+        if ratio < args.assert_harvest_speedup:
+            print(
+                f"harvest gather speedup {ratio}x below floor "
+                f"{args.assert_harvest_speedup}x",
                 file=sys.stderr,
             )
             return 1

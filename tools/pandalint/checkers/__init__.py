@@ -14,6 +14,7 @@ from tools.pandalint.checkers.iobuf import IobufCopyChecker
 from tools.pandalint.checkers.enginesync import EngineSyncChecker
 from tools.pandalint.checkers.crossshard import CrossShardChecker
 from tools.pandalint.checkers.locks import LockRpcChecker
+from tools.pandalint.checkers.sleeps import SleepAsyncChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     ReactorChecker,
@@ -25,6 +26,7 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     EngineSyncChecker,
     CrossShardChecker,
     LockRpcChecker,
+    SleepAsyncChecker,
 )
 
 

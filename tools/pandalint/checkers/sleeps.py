@@ -1,0 +1,125 @@
+"""Blocking sleeps reaching async bodies past the literal-name rule.
+
+RCT101 flags the literal ``time.sleep(...)`` inside ``async def`` — but a
+blocking sleep stalls the reactor just as hard when it arrives renamed
+(``from time import sleep`` / ``import time as t``) or laundered through a
+module-local sync helper the coroutine calls. Both shapes have bitten real
+asyncio codebases precisely because the obvious grep misses them.
+
+The finjector is the ONE sanctioned home of deliberate blocking sleeps
+(an injected delay/wedge fault must actually block — that IS the fault),
+so files under ``redpanda_tpu/finjector`` are exempt wholesale rather
+than carrying a pragma per effect site.
+
+Heuristics (no type inference):
+
+- SLP801: a call inside ``async def`` that resolves to ``time.sleep``
+  through this module's import aliases (``from time import sleep [as x]``,
+  ``import time as t`` + ``t.sleep``). The plain ``time.sleep`` spelling
+  stays RCT101's finding — one rule per shape, nothing double-flags.
+- SLP802: a bare-name call inside ``async def`` to a sync function
+  defined in this module whose own body contains a blocking sleep (any
+  spelling). Wrapping the helper in ``asyncio.to_thread`` /
+  ``run_in_executor`` passes it as an argument, not a call, so offloaded
+  helpers are naturally clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.checkers.base import (
+    Checker,
+    FileContext,
+    RawFinding,
+    dotted,
+    enclosing_async_functions,
+    walk_in_function,
+)
+
+
+
+def _sleep_aliases(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(bare names bound to time.sleep, module aliases bound to time —
+    excluding the plain name ``time`` itself, which RCT101 owns)."""
+    sleep_names: set[str] = set()
+    time_aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    sleep_names.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time" and alias.asname not in (None, "time"):
+                    time_aliases.add(alias.asname)
+    return sleep_names, time_aliases
+
+
+def _is_blocking_sleep(call: ast.Call, sleep_names, time_aliases) -> bool:
+    """Any spelling of a blocking time.sleep, aliased or literal."""
+    name = dotted(call.func)
+    if name == "time.sleep":
+        return True
+    if name in sleep_names:
+        return True
+    root, _, tail = name.partition(".")
+    return root in time_aliases and tail == "sleep"
+
+
+class SleepAsyncChecker(Checker):
+    name = "sleep-async"
+    rules = {
+        "SLP801": "aliased blocking time.sleep inside async def",
+        "SLP802": "sync helper that blocks in time.sleep called from async def",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        rel = ctx.relpath.replace("\\", "/")
+        if any(
+            seg == "finjector" or seg.startswith("finjector.")
+            for seg in rel.split("/")
+        ):
+            # deliberate blocking injection sites live here by design
+            return
+        sleep_names, time_aliases = _sleep_aliases(ctx.tree)
+        # module-local sync functions whose bodies block in a sleep
+        sleepy_helpers: set[str] = set()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            for node in walk_in_function(fn):
+                if isinstance(node, ast.Call) and _is_blocking_sleep(
+                    node, sleep_names, time_aliases
+                ):
+                    sleepy_helpers.add(fn.name)
+                    break
+        for fn in enclosing_async_functions(ctx.tree):
+            for node in walk_in_function(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                if name == "time.sleep":
+                    continue  # RCT101's finding, not ours
+                if _is_blocking_sleep(node, sleep_names, time_aliases):
+                    yield RawFinding(
+                        "SLP801",
+                        node.lineno,
+                        node.col_offset,
+                        f"{name or 'sleep'}() is time.sleep in disguise and "
+                        f"blocks the event loop inside async {fn.name}(); "
+                        f"use asyncio.sleep",
+                    )
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in sleepy_helpers
+                ):
+                    yield RawFinding(
+                        "SLP802",
+                        node.lineno,
+                        node.col_offset,
+                        f"{node.func.id}() blocks in time.sleep and is "
+                        f"called on the loop inside async {fn.name}(); "
+                        f"offload with asyncio.to_thread",
+                    )

@@ -42,6 +42,10 @@ DEFAULT_SCOPES: dict[str, tuple[str, ...]] = {
     # Locks + network RPC can meet anywhere in the broker (raft, cluster,
     # coproc, kafka server), so the await-under-lock rule is package-wide.
     "lock-rpc": (),
+    # Disguised blocking sleeps can stall any shard's reactor; package-wide
+    # (the checker itself exempts the finjector, whose deliberate blocking
+    # sleeps ARE the injected fault).
+    "sleep-async": (),
 }
 
 DEFAULT_PACKAGE_ROOT = "redpanda_tpu"
