@@ -213,6 +213,37 @@ def _run_engine_mode(
     return rate, _fmt_stages(stats), shards, probe
 
 
+def _measure_aa_skew(req) -> dict:
+    """A/A box-skew self-check (ROADMAP item 4's "diagnose first"): two
+    IDENTICAL host-columnar passthrough rounds timed back to back before
+    any measured run. Their rate difference is the box's short-horizon
+    capacity noise — a cross-round BENCH delta inside this band (the
+    config3_payload_bridge_16p 5682→1439 rb/s "regression" on a ±30% box)
+    is weather, not a code regression, and every BENCH artifact now says
+    so on its face."""
+    from redpanda_tpu.coproc import TpuEngine
+    from redpanda_tpu.ops.exprs import field
+    from redpanda_tpu.ops.transforms import where
+
+    spec = where(field("level") == "error")
+    engine = TpuEngine(
+        row_stride=ROW_STRIDE, force_mode="columnar_host", host_workers=0
+    )
+    codes = engine.enable_coprocessors([(1, spec.to_json(), ("bench",))])
+    assert codes[0] == 0
+    _run_engine_stream(engine, req, GROUP, GROUP, DEPTH)  # warmup
+    rates = [
+        _run_engine_stream(engine, req, 2 * GROUP, GROUP, DEPTH)
+        for _ in range(2)
+    ]
+    engine.shutdown()
+    skew = abs(rates[0] - rates[1]) / max(rates) * 100.0 if max(rates) else 0.0
+    return {
+        "aa_rates_rb_s": [round(r, 1) for r in rates],
+        "aa_skew_pct": round(skew, 1),
+    }
+
+
 def run_cpu_baseline(req) -> float:
     """Single-core host engine: per-record decode + json.loads + predicate +
     rebuild + re-CRC (the work profile of the reference's JS supervisor)."""
@@ -408,6 +439,10 @@ def main():
     req = _build_workload()
     from redpanda_tpu.coproc import TpuEngine
 
+    # A/A control FIRST: whatever the measured runs report, the artifact
+    # carries the box's own same-code noise band to judge deltas against
+    aa = _measure_aa_skew(req)
+    TpuEngine.reset_columnar_probe()  # the headline measures its own pick
     value, stages, shard_stages, probe = _run_engine_mode(req, None)  # product
     dev_rate, dev_stages, _, _ = _run_engine_mode(req, "columnar_device")
     host_col_rate, host_col_stages, _, _ = _run_engine_mode(req, "columnar_host")
@@ -460,6 +495,10 @@ def main():
                     if tpu_ok
                     else {"device_note": "TPU tunnel unavailable; CPU-device fallback"}
                 ),
+                # same-code A/A control measured before everything else:
+                # deltas inside this band are box noise, not regressions
+                "aa_skew_pct": aa["aa_skew_pct"],
+                "aa_rates_rb_s": aa["aa_rates_rb_s"],
                 "partitions": P,
                 "records_per_batch": RECORDS_PER_BATCH,
                 "group_ticks_per_launch": GROUP,

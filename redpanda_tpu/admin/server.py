@@ -13,6 +13,9 @@ Parity with redpanda/admin_server.cc:
   N injections, DELETE disarms — rpk debug failpoints)
 - GET  /v1/coproc/status               (engine breaker + fault-domain stats;
   rpk debug coproc)
+- GET  /v1/slo[?mark=N], POST /v1/slo/mark[?name=N]  (SLO verdicts over the
+  pandaprobe histograms + named baseline marks; rpk debug slo — no
+  reference analogue, the ducktape suite judges latency externally)
 - GET  /metrics                        (:148-151 prometheus)
 - GET  /v1/trace/recent, /v1/trace/slow (pandaprobe span traces; no
   reference analogue — seastar requests never leave their shard, ours
@@ -129,6 +132,8 @@ class AdminServer:
             web.put("/v1/failure-probes/{module}/{probe}/{type}", self._set_probe),
             web.delete("/v1/failure-probes/{module}/{probe}", self._unset_probe),
             web.get("/v1/coproc/status", self._coproc_status),
+            web.get("/v1/slo", self._slo),
+            web.post("/v1/slo/mark", self._slo_mark),
             web.get("/metrics", self._metrics),
             web.get("/v1/trace/recent", self._trace_recent),
             web.get("/v1/trace/slow", self._trace_slow),
@@ -519,6 +524,38 @@ class AdminServer:
             "breaker": stats.pop("breaker", None),
             "stats": stats,
         })
+
+    # ------------------------------------------------------------ slo
+    async def _slo(self, req: web.Request) -> web.Response:
+        """Judge the active SLO spec (observability/slo.py) over the probe
+        histograms. ``?mark=NAME`` narrows the window to observations since
+        that named baseline (POST /v1/slo/mark?name=NAME); without it the
+        verdicts cover the process lifetime. Breaching objectives carry
+        trace exemplars resolvable via /v1/trace/slow."""
+        from redpanda_tpu.observability import tracer
+        from redpanda_tpu.observability.slo import slo
+
+        mark = req.query.get("mark")
+        try:
+            report = slo.evaluate(mark=mark)
+        except KeyError:
+            return web.json_response(
+                {"error": f"unknown mark {mark!r}", "marks": slo.marks()},
+                status=404,
+            )
+        report["exemplars_enabled"] = tracer.enabled
+        report["marks"] = slo.marks()
+        return web.json_response(report)
+
+    async def _slo_mark(self, req: web.Request) -> web.Response:
+        """Snapshot every histogram as a named baseline, so a later
+        GET /v1/slo?mark=NAME judges only what happened since — the
+        bracket an operator (or the chaos suite) puts around an incident."""
+        from redpanda_tpu.observability.slo import slo
+
+        name = req.query.get("name", "default")
+        series = slo.set_mark(name)
+        return web.json_response({"mark": name, "series": series})
 
     # ------------------------------------------------------------ metrics
     async def _metrics(self, req: web.Request) -> web.Response:

@@ -108,6 +108,15 @@ class Application:
             capacity=c.trace_ring_capacity,
             slow_threshold_ms=float(c.trace_slow_threshold_ms),
         )
+        # SLO engine: operator objectives (or the lenient broker defaults)
+        # judged at GET /v1/slo; loading arms per-metric breach thresholds
+        # so over-threshold observations record trace exemplars
+        from redpanda_tpu.observability.slo import slo
+
+        if c.slo_objectives_file:
+            slo.configure_from_file(c.slo_objectives_file)
+        else:
+            slo.arm_exemplars()
         # rpk iotune's characterization, when present (io-config.json in the
         # data dir): published below as metrics for operators/dashboards
         from redpanda_tpu.config.io_config import load_io_config
@@ -405,6 +414,13 @@ class Application:
         registry.gauge(
             "trace_spans_recorded", lambda: tracer.spans_recorded,
             "Spans committed to the trace ring since start",
+        )
+        from redpanda_tpu.observability.slo import slo as _slo
+
+        registry.gauge(
+            "slo_objectives_total",
+            lambda: float(len(_slo.spec.objectives)),
+            "Objectives in the active SLO spec (GET /v1/slo)",
         )
         if self.io_config:
             io = self.io_config

@@ -39,15 +39,19 @@ async def _client(args):
     return await KafkaClient(_parse_brokers(args.brokers), sasl=sasl).connect()
 
 
-async def _admin_request(args, method: str, path: str, body=None):
+async def _admin_request(args, method: str, path: str, body=None, query=None):
     import json as _json
     import urllib.parse
 
     from redpanda_tpu.http import HttpClient
 
     # user-supplied segments (names etc.) must be percent-encoded for the
-    # request line; structural separators stay intact
+    # request line; structural separators stay intact. Query VALUES go via
+    # `query` (urlencode: one correct encoding) — pre-encoding them into
+    # `path` would double-encode '%' here.
     path = urllib.parse.quote(path, safe="/?&=")
+    if query:
+        path += ("&" if "?" in path else "?") + urllib.parse.urlencode(query)
     async with HttpClient(f"http://{args.admin_api}") as c:
         headers = {}
         payload = b""
@@ -314,7 +318,8 @@ async def cmd_config(args) -> int:
 async def cmd_debug(args) -> int:
     """debug diagnostics: bundle (tar.gz of admin state), trace (render
     the broker's recent pandaprobe spans), coproc (engine breaker +
-    fault-domain stats), failpoints (honey-badger arm/disarm)."""
+    fault-domain stats), slo (objective verdicts + breach exemplars),
+    failpoints (honey-badger arm/disarm)."""
     import io
     import tarfile
     import time
@@ -392,6 +397,63 @@ async def cmd_debug(args) -> int:
                 print(f"  {k:<28}{stats[k]}")
         return 0
 
+    if args.debug_cmd == "slo":
+        # mark names are user input riding a query string: sent via the
+        # `query` dict so they get exactly ONE correct encoding (a name
+        # with '&'/'=' must not split the query; pre-quoting into the path
+        # would get '%' re-encoded by _admin_request)
+        if args.set_mark is not None:
+            status, body = await _admin_request(
+                args, "POST", "/v1/slo/mark", query={"name": args.set_mark}
+            )
+            if status != 200:
+                print(f"admin api returned {status}: {body}")
+                return 1
+            print(f"mark {body['mark']!r} set over {body['series']} series")
+            return 0
+        status, body = await _admin_request(
+            args, "GET", "/v1/slo",
+            query={"mark": args.mark} if args.mark else None,
+        )
+        if status != 200:
+            print(f"admin api returned {status}: {body}")
+            return 1
+        if args.json:
+            print(json.dumps(body, indent=2, sort_keys=True))
+            return 0
+        verdict = "PASS" if body.get("pass") else "FAIL"
+        print(
+            f"scenario {body.get('scenario')}: {verdict} "
+            f"({body.get('failed', 0)} failed, {body.get('no_data', 0)} no-data; "
+            f"window {body.get('window')})"
+        )
+        print(
+            f"{'OBJECTIVE':<24}{'METRIC':<30}{'Q':>5}{'OBSERVED':>12}"
+            f"{'THRESHOLD':>12}{'SAMPLES':>9}  STATUS"
+        )
+        for o in body.get("objectives", []):
+            obs = o.get("observed_ms")
+            print(
+                f"{o['name']:<24}{o['metric']:<30}"
+                f"{('p%g' % o['quantile']):>5}"
+                f"{(('%.2fms' % obs) if obs is not None else '-'):>12}"
+                f"{('%gms' % o['threshold_ms']):>12}"
+                f"{o.get('samples', 0):>9}  {o['status']}"
+            )
+            for ex in (o.get("exemplars") or [])[:5]:
+                print(
+                    f"    breach exemplar: trace={ex['trace_id']} "
+                    f"{ex['value_us'] / 1000.0:.2f}ms "
+                    f"(bucket <= {ex['bucket_us'] / 1000.0:.2f}ms) — "
+                    f"`rpk debug trace --slow` resolves it"
+                )
+        if not body.get("exemplars_enabled", False):
+            print(
+                "note: tracer disabled — breaches carry no exemplars "
+                "(set trace_enabled: true)"
+            )
+        return 0
+
     if args.debug_cmd == "failpoints":
         if args.fp_cmd == "list":
             status, body = await _admin_request(args, "GET", "/v1/failure-probes")
@@ -433,6 +495,7 @@ async def cmd_debug(args) -> int:
         ("metrics.txt", "/metrics"),
         ("traces.json", "/v1/trace/recent"),
         ("coproc.json", "/v1/coproc/status"),
+        ("slo.json", "/v1/slo"),
         ("failpoints.json", "/v1/failure-probes"),
     ]:
         status, body = await _admin_request(args, "GET", path)
@@ -632,6 +695,18 @@ def build_parser() -> argparse.ArgumentParser:
         "coproc", help="engine breaker + fault-domain + stage stats"
     )
     dc.add_argument("--json", action="store_true", help="raw JSON, no rendering")
+    dslo = dsub.add_parser(
+        "slo", help="SLO verdicts over the pandaprobe histograms (admin api)"
+    )
+    dslo.add_argument("--json", action="store_true", help="raw JSON, no rendering")
+    dslo.add_argument(
+        "--mark", default=None,
+        help="judge only observations since this named baseline",
+    )
+    dslo.add_argument(
+        "--set-mark", default=None, metavar="NAME",
+        help="snapshot a named baseline instead of evaluating",
+    )
     dfp = dsub.add_parser(
         "failpoints", help="list/arm/disarm honey-badger failure probes"
     )

@@ -119,6 +119,13 @@ PROPERTIES: list[Property] = [
     Property("trace_enabled", "Record pandaprobe spans (GET /v1/trace/recent)", False, bool),
     Property("trace_ring_capacity", "Bounded span ring size", 2048, int, _positive),
     Property("trace_slow_threshold_ms", "Spans over this land in the slow-request log", 500, int, _positive),
+    Property(
+        "slo_objectives_file",
+        "YAML/JSON SLO objective spec judged at GET /v1/slo (empty = the "
+        "lenient broker defaults in observability/slo.py); loading a spec "
+        "arms per-metric breach thresholds for trace exemplars",
+        "",
+    ),
     # --- security
     Property("enable_sasl", "Require SASL on the kafka listener", False, bool),
     Property("superusers", "Comma-separated superuser principals", ""),

@@ -17,7 +17,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from redpanda_tpu.coproc import wasm_event
+from redpanda_tpu.coproc import faults, wasm_event
 from redpanda_tpu.coproc.engine import EnableResponseCode, TpuEngine
 from redpanda_tpu.coproc.pacemaker import Pacemaker
 from redpanda_tpu.models.fundamental import COPROC_INTERNAL_TOPIC, NTP
@@ -97,7 +97,7 @@ class CoprocApi:
             return True
         except ValueError:
             return True  # lost a concurrent create: it exists
-        except Exception as e:
+        except Exception as e:  # pandalint: disable=EXC901 -- startup poll: the topic is not creatable until a controller leader exists; retried every 0.5s, not a fault
             logger.debug("coproc internal topic not creatable yet: %s", e)
             return False
 
@@ -158,7 +158,10 @@ class CoprocApi:
                 await self._ingest_once()
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as exc:
+                # classified: a broker that can no longer ingest deploys is
+                # degraded even though this loop survives to retry
+                faults.note_failure("wasm_ingest", exc)
                 logger.exception("coproc event ingest failed")
             await asyncio.sleep(self.poll_interval_s if created else 0.5)
 
@@ -198,7 +201,8 @@ class CoprocApi:
                     await self._disable(name)
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as exc:
+                faults.note_failure("wasm_event", exc)
                 logger.exception("poison coproc event %r skipped", name)
         self._listen_offset = next_offset
 

@@ -747,7 +747,7 @@ class Ticket:
                     idxs.append(len(seal_jobs))
                     seal_jobs.append((batch, payload, kept))
                 slot_plans.append(idxs)
-            except Exception as exc:
+            except Exception as exc:  # pandalint: disable=EXC901 -- held for phase 2: delivered as a value to the ErrorPolicy boundary, which classifies it via note_failure("rebuild")
                 # held for phase 2: the script error policy is applied in
                 # slot order there, exactly like the old per-slot loop
                 slot_plans.append(exc)
@@ -1224,7 +1224,7 @@ class TpuEngine:
                     compress_threshold=self._compress_threshold,
                     codec=self._output_codec,
                 )
-            except Exception as exc:  # delivered to the policy boundary
+            except Exception as exc:  # pandalint: disable=EXC901 -- delivered as a value to the ErrorPolicy boundary (note_failure("rebuild") classifies it there)
                 return exc
 
         pool = self._host_pool
@@ -1443,7 +1443,10 @@ class TpuEngine:
             t_inline, t_sharded = self._measure_pool_ratio(
                 plan, all_batches, counts
             )
-        except Exception:
+        except Exception as exc:
+            # classified: a box whose calibration keeps blowing up runs
+            # inline forever, which must be visible on /metrics
+            faults.note_failure("pool_calibration", exc)
             logger.exception("host pool calibration failed; keeping inline path")
             self._pool_decision = "inline"
         else:
@@ -1883,7 +1886,10 @@ class TpuEngine:
             t_dev = faults.fetch_with_deadline(
                 _device_leg, _PROBE_DEVICE_TIMEOUT_S
             )
-        except Exception:  # wedged (deadline) / no device / compile error
+        except Exception as exc:
+            # wedged (deadline) / no device / compile error: host wins the
+            # probe, and the reason lands in coproc_failures_total
+            faults.note_failure("columnar_probe", exc)
             t_dev = float("inf")
         TpuEngine._columnar_backend = (
             "device" if t_dev * _PROBE_DEVICE_MARGIN < t_host else "host"

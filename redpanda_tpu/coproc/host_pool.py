@@ -171,7 +171,7 @@ class HostStagePool:
         for f in futures:
             try:
                 results.append(f.result())
-            except BaseException as e:  # noqa: BLE001 — rethrown below
+            except BaseException as e:  # noqa: BLE001  # pandalint: disable=EXC901 -- collected, not swallowed: the first failure re-raises after every task completes
                 results.append(None)
                 if first_exc is None:
                     first_exc = e

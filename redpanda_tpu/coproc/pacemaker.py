@@ -389,5 +389,8 @@ class Pacemaker:
             await asyncio.sleep(self.offset_flush_interval_s)
             try:
                 self._save_offsets()
-            except Exception:
+            except Exception as exc:
+                # classified: losing offset snapshots silently would turn a
+                # later restart into a giant re-read with no warning
+                faults.note_failure("offset_flush", exc)
                 logger.exception("coproc offset flush failed")

@@ -48,19 +48,25 @@ MAX_PIPELINE = 64  # max in-flight requests per connection
 from redpanda_tpu.observability.probes import (  # noqa: E402
     kafka_fetch_hist as _fetch_latency,
     kafka_produce_hist as _produce_latency,
+    record_us as _record_us,
 )
 
 
 class RequestContext:
     """Per-request context handed to handlers (kafka::request_context)."""
 
-    __slots__ = ("broker", "header", "request", "connection")
+    __slots__ = ("broker", "header", "request", "connection", "trace_id")
 
     def __init__(self, broker, header: RequestHeader, request: dict, connection):
         self.broker = broker
         self.header = header
         self.request = request
         self.connection = connection
+        # stamped by the handler's root span (handlers.handle_produce/
+        # handle_fetch): the dispatch layer records the latency histogram
+        # AFTER the span closed, so exemplar capture needs the id carried
+        # out-of-band (observability/probes.py trace exemplars)
+        self.trace_id = None
 
     @property
     def api_version(self) -> int:
@@ -239,8 +245,17 @@ class Connection:
         finally:
             if gated:
                 await self.server.qdc.release(loop.time() - t_svc)
+        # exemplar-aware record: over-threshold observations keep the
+        # request's trace id so an SLO breach links to /v1/trace/slow.
+        # Fetch records WITHOUT a trace id on purpose: its root span is
+        # no_slow (a long poll's duration is intentional waiting, never in
+        # the slow ring), so a fetch exemplar could only ever be a dead
+        # link — fetch objectives are judged on their error budget instead.
         if header.api_key == PRODUCE:
-            _produce_latency.record(int((loop.time() - t0) * 1e6))
+            _record_us(
+                _produce_latency, int((loop.time() - t0) * 1e6),
+                trace_id=ctx.trace_id,
+            )
         elif header.api_key == FETCH:
             _fetch_latency.record(int((loop.time() - t0) * 1e6))
         return self._encode_response(header, api, response)

@@ -64,6 +64,13 @@ def _labelstr(labels: tuple[tuple[str, str], ...], extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def series_key(name: str, labels: tuple[tuple[str, str], ...] = ()) -> str:
+    """The unprefixed series identity used by snapshot()/histograms():
+    ``name{label="value",...}``. One function so the SLO engine, the
+    exemplar store and the snapshot diff all join on the same key."""
+    return f"{name}{_labelstr(labels)}"
+
+
 class MetricsRegistry:
     def __init__(self) -> None:
         self._counters: dict[str, Counter] = {}
@@ -94,6 +101,12 @@ class MetricsRegistry:
             h = Histogram(name, help, labels=tuple(sorted(labels.items())))
             self._hists[key] = h
         return h
+
+    def histograms(self) -> dict[str, Histogram]:
+        """Live histogram series keyed like snapshot() (series_key form).
+        The SLO engine quantile-interpolates straight off these buckets;
+        callers must treat the Histogram objects as read-only."""
+        return {series_key(h.name, h.labels): h for h in self._hists.values()}
 
     # ------------------------------------------------------------ exposition
     def render_prometheus(self) -> str:

@@ -13,11 +13,13 @@ engine's per-stage breakdown, ``*_bytes_total`` for transfer counters.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 import weakref
 
-from redpanda_tpu.metrics import Counter, Histogram, registry
+from redpanda_tpu.metrics import Counter, Histogram, registry, series_key
+from redpanda_tpu.observability.trace import tracer
 
 # ------------------------------------------------------------ broker path
 storage_append_hist = registry.histogram(
@@ -197,14 +199,139 @@ def coproc_stage_hist(stage: str) -> Histogram:
     return h
 
 
+# ------------------------------------------------------------ trace exemplars
+# When a histogram observation lands over its breach threshold, the ambient
+# trace id is recorded alongside the bucket it fell into, so an SLO breach
+# on /v1/slo (and `rpk debug slo`) links straight to the matching
+# /v1/trace/slow entry instead of leaving the operator to correlate by
+# timestamp. Thresholds come from the armed SLO objectives
+# (observability/slo.py arms threshold_ms per metric); a histogram with no
+# armed objective falls back to the tracer's slow threshold. Exemplars
+# only exist where a trace id does: with the tracer disabled the whole
+# layer is one dict lookup + compare per observation (the
+# slo_eval_overhead microbench gates that at <1% of a produce op).
+_EXEMPLAR_CAP = 16  # newest-first ring per series
+
+_exemplar_lock = threading.Lock()
+# id(hist) -> threshold_us armed by an SLO objective (None = tracer default)
+_exemplar_thresholds: dict[int, float] = {}
+# series key -> deque of {"trace_id", "value_us", "bucket_us"}
+_exemplars: dict[str, collections.deque] = {}
+
+
+# ids that already have a deallocation finalizer registered: tracked
+# SEPARATELY from the thresholds dict, because disarm/reset clear the
+# thresholds while the finalizer lives as long as the histogram — keying
+# "already registered" off the thresholds dict would register a fresh
+# finalizer on every disarm/re-arm cycle of an immortal registry
+# histogram (loadgen does one such cycle per scenario run).
+_exemplar_finalized: set[int] = set()
+
+
+def _drop_exemplar_threshold(key: int) -> None:
+    with _exemplar_lock:
+        _exemplar_thresholds.pop(key, None)
+        # the object is being deallocated: a future histogram at this
+        # address is a different object and deserves its own finalizer
+        _exemplar_finalized.discard(key)
+
+
+def arm_exemplar_threshold(hist: Histogram, threshold_us: float) -> None:
+    """Arm a per-histogram breach threshold (an SLO objective's
+    threshold_ms). Observations at or over it record the ambient trace id.
+
+    The store is keyed by id(hist) for the hot-path lookup; the finalizer
+    drops the entry when the histogram is collected (CPython runs it at
+    deallocation, before the address can be reused), so a scratch
+    histogram armed and dropped without a disarm can never bequeath its
+    threshold to an unrelated histogram allocated at the same address."""
+    with _exemplar_lock:
+        # one finalizer per object LIFETIME, not per (re-)arm call:
+        # evaluate() re-arms on every /v1/slo poll, and disarm/re-arm
+        # cycles must not register duplicates either
+        first = id(hist) not in _exemplar_finalized
+        if first:
+            _exemplar_finalized.add(id(hist))
+        _exemplar_thresholds[id(hist)] = float(threshold_us)
+    if first:
+        weakref.finalize(hist, _drop_exemplar_threshold, id(hist))
+
+
+def disarm_exemplar_threshold(hist: Histogram) -> None:
+    with _exemplar_lock:
+        _exemplar_thresholds.pop(id(hist), None)
+
+
+def reset_exemplars() -> None:
+    with _exemplar_lock:
+        _exemplar_thresholds.clear()
+        _exemplars.clear()
+
+
+def exemplars_for(key: str) -> list[dict]:
+    """Newest-first exemplars for one series key (metrics.series_key)."""
+    with _exemplar_lock:
+        ring = _exemplars.get(key)
+        return list(ring)[::-1] if ring else []
+
+
+def exemplars_snapshot() -> dict[str, list[dict]]:
+    with _exemplar_lock:
+        return {k: list(ring)[::-1] for k, ring in _exemplars.items() if ring}
+
+
+def _note_exemplar(hist: Histogram, value_us: int, trace_id) -> None:
+    """Slow path — only runs for an over-threshold observation."""
+    if trace_id is None:
+        trace_id = tracer.current_trace()
+        if trace_id is None:
+            return  # no trace to link: an exemplar would dangle
+    from redpanda_tpu.utils.hdr import _bucket_of, _bucket_upper
+
+    entry = {
+        "trace_id": trace_id,
+        "value_us": int(value_us),
+        "bucket_us": _bucket_upper(_bucket_of(int(value_us))),
+        # wall-clock stamp so a windowed SLO report can drop exemplars
+        # recorded before its baseline mark (the ring outlives incidents)
+        "ts": time.time(),
+    }
+    key = series_key(hist.name, hist.labels)
+    with _exemplar_lock:
+        ring = _exemplars.get(key)
+        if ring is None:
+            ring = _exemplars[key] = collections.deque(maxlen=_EXEMPLAR_CAP)
+        ring.append(entry)
+
+
+def record_us(hist: Histogram, value_us: int, trace_id=None) -> None:
+    """Record a latency observation with exemplar capture. The always-on
+    cost beyond hist.record is one dict lookup + compare; everything else
+    only runs once the value crossed the breach threshold."""
+    value_us = int(value_us)
+    hist.record(value_us)
+    thr = _exemplar_thresholds.get(id(hist))
+    if thr is None:
+        if not tracer.enabled:
+            return
+        thr = tracer.slow_threshold_us
+    if value_us >= thr:
+        _note_exemplar(hist, value_us, trace_id)
+
+
 def observe_us(hist: Histogram, t0: float) -> None:
     """Record elapsed-since-t0 (a perf_counter timestamp) in microseconds."""
-    hist.record(int((time.perf_counter() - t0) * 1e6))
+    record_us(hist, int((time.perf_counter() - t0) * 1e6))
 
 
 __all__ = [
     "Counter",
     "Histogram",
+    "arm_exemplar_threshold",
+    "exemplars_for",
+    "exemplars_snapshot",
+    "record_us",
+    "reset_exemplars",
     "coproc_breaker_state",
     "coproc_breaker_trips",
     "coproc_d2h_bytes",

@@ -93,19 +93,26 @@ class Transport:
         self._writer = None
 
     async def send(self, method_id: int, payload: bytes, timeout: float | None = None) -> bytes:
-        if honey_badger.enabled:  # keep the disabled hot path to one check,
-            # not a coroutine allocation per outbound RPC (hbadger.h:30-37
-            # compiles probes out of release builds; this is our analogue)
-            await honey_badger.maybe_inject("rpc", "send")
         if self._writer is None:
             raise TransportClosed("not connected")
-        corr = next(self._corr)
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._inflight[corr] = fut
         t0 = time.perf_counter()
         try:
             with tracer.span("rpc.send") as sp:
                 sp.set("method_id", method_id)
+                if honey_badger.enabled:  # keep the disabled hot path to one
+                    # check, not a coroutine allocation per outbound RPC
+                    # (hbadger.h:30-37 compiles probes out of release
+                    # builds; this is our analogue). Inside the timed span
+                    # deliberately: an injected slow/failed link must land
+                    # in rpc_request_latency_us and the rpc.send span, or
+                    # chaos runs judge a histogram the fault never touched.
+                    await honey_badger.maybe_inject("rpc", "send")
+                    if self._writer is None:
+                        # the transport closed while the fault blocked us
+                        raise TransportClosed("not connected")
+                corr = next(self._corr)
+                fut: asyncio.Future = asyncio.get_event_loop().create_future()
+                self._inflight[corr] = fut
                 self._writer.write(
                     wire.frame(payload, method_id, corr, compress=self.compress)
                 )

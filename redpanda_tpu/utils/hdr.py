@@ -69,17 +69,26 @@ class HdrHist:
             return 0
         target = max(1, int(round(self._total * p / 100.0)))
         seen = 0
-        for idx in sorted(self._counts):
-            seen += self._counts[idx]
+        # sorted(items()) materializes the dict in ONE GIL-atomic C call:
+        # readers (the /metrics scrape, the SLO engine's snapshot) run on
+        # other threads than some writers (harvester/executor stage
+        # records), and iterating the live dict would raise "changed size
+        # during iteration" the moment a writer occupies a new bucket —
+        # i.e. exactly during the incident being judged. A point-in-time
+        # smear against _total is acceptable; a crash is not.
+        items = sorted(self._counts.items())
+        for idx, n in items:
+            seen += n
             if seen >= target:
                 return _bucket_upper(idx)
-        return _bucket_upper(max(self._counts))
+        return _bucket_upper(items[-1][0]) if items else 0
 
     def cumulative_buckets(self) -> list[tuple[int, int]]:
-        """[(upper_bound, cumulative_count)] for prometheus exposition."""
+        """[(upper_bound, cumulative_count)] for prometheus exposition.
+        Safe against concurrent record(): see percentile()."""
         out = []
         seen = 0
-        for idx in sorted(self._counts):
-            seen += self._counts[idx]
+        for idx, n in sorted(self._counts.items()):
+            seen += n
             out.append((_bucket_upper(idx), seen))
         return out

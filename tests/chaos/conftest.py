@@ -5,21 +5,54 @@ each, so modules share one healthy 3-node cluster and every test leaves
 all nodes running (kills are followed by restarts)."""
 
 import asyncio
+import json
+import os
 
 import pytest
 
 from .harness import ProcCluster
 
+# Objectives the partition-tolerance suite judges incident windows with:
+# min_samples of 1 (a few-second fault window on one node only collects a
+# handful of observations, and a single 2s wedged write IS the incident)
+# and thresholds far under the wedge magnitude the suite injects, far
+# over healthy loopback latencies.
+CHAOS_SLO_OBJECTIVES = {
+    "name": "chaos_cluster",
+    "objectives": [
+        {"name": "produce_p99", "metric": "kafka_produce_latency_us",
+         "quantile": 99, "threshold_ms": 500, "min_samples": 1},
+        {"name": "rpc_p99", "metric": "rpc_request_latency_us",
+         "quantile": 99, "threshold_ms": 300, "min_samples": 1},
+        {"name": "replicate_p99", "metric": "raft_replicate_latency_us",
+         "quantile": 99, "threshold_ms": 1000, "min_samples": 1},
+    ],
+}
+
 
 @pytest.fixture(scope="package")
 def proc_cluster(tmp_path_factory):
+    base = tmp_path_factory.mktemp("chaos")
+    slo_file = os.path.join(str(base), "slo_objectives.json")
+    with open(slo_file, "w") as f:
+        json.dump(CHAOS_SLO_OBJECTIVES, f)
+
     async def _start():
         cluster = ProcCluster(
-            str(tmp_path_factory.mktemp("chaos")),
+            str(base),
             3,
             # replicate EVERYTHING 3x, including __consumer_offsets, so any
             # single kill is survivable (raft_availability_test shape)
-            extra_config={"default_topic_replication": 3, "coproc_enable": 1},
+            extra_config={
+                "default_topic_replication": 3,
+                "coproc_enable": 1,
+                # partition-tolerance suite: /v1/slo judges incident
+                # windows against the file above, and breaches need the
+                # tracer for exemplars / slow-span resolution
+                "trace_enabled": 1,
+                "trace_slow_threshold_ms": 300,
+                "slo_objectives_file": slo_file,
+            },
         )
         await cluster.start()
         return cluster

@@ -28,6 +28,18 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+async def admin_request(node: "BrokerProc", method: str, path: str) -> tuple[int, dict]:
+    """One admin-API call against a cluster node; returns (status, json).
+    Shared by the chaos suites so request behavior (timeout, decode) has
+    one home instead of a near-copy per test module."""
+    url = f"http://127.0.0.1:{node.ports['admin']}{path}"
+    async with aiohttp.ClientSession() as s:
+        async with s.request(
+            method, url, timeout=aiohttp.ClientTimeout(total=10)
+        ) as r:
+            return r.status, await r.json()
+
+
 class BrokerProc:
     def __init__(
         self,
@@ -142,8 +154,17 @@ class ProcCluster:
     async def start(self) -> "ProcCluster":
         for n in self.nodes:
             n.start()
-        await asyncio.gather(*(n.wait_ready() for n in self.nodes))
-        await self.wait_for_settled_writes()
+        try:
+            await asyncio.gather(*(n.wait_ready() for n in self.nodes))
+            await self.wait_for_settled_writes()
+        except Exception:
+            # a node that lost the ephemeral-port race (or died in any
+            # other way) must not leave its SIBLINGS running: the fixture
+            # error path has no cluster handle to stop, and leaked broker
+            # processes squat on ports and skew every later run
+            for n in self.nodes:
+                n.terminate()
+            raise
         return self
 
     async def wait_for_settled_writes(self, timeout: float = 45.0) -> None:

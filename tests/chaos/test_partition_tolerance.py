@@ -1,0 +1,281 @@
+"""Cluster partition-tolerance suite over the rpc.send failure probe.
+
+ISSUE 7 / ROADMAP item 3's still-open leg: the honey-badger ``rpc.send``
+probe (PR 4) armed between REAL broker processes of a ProcCluster, one
+effect per test — delay, exception, wedge — with the three invariants a
+partition-tolerant cluster owes its clients checked end to end:
+
+- **no lost acks=-1 writes**: every value whose quorum produce returned
+  during the fault is fetchable after recovery;
+- **leadership convergence**: a node whose outbound RPC is broken loses
+  its leaderships to healthy peers, and after disarm the cluster settles
+  on exactly one stable leader per partition;
+- **bounded, visible degradation**: the faulted window's /v1/slo report
+  (judged against the chaos objective file via a named mark) FAILs with
+  samples and breach exemplars that resolve in /v1/trace/slow — never a
+  silent PASS — and a fresh post-recovery window passes again.
+
+Faults are armed through each node's real admin API (what `rpk debug
+failpoints arm` calls); every test disarms and re-settles the shared
+cluster on its way out.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from redpanda_tpu.kafka.client import KafkaClient
+
+from .harness import admin_request as _admin
+from .test_chaos import connect_live, fetch_all_values, produce_acked
+
+pytestmark = pytest.mark.chaos
+
+TOPIC = "pt-topic"
+
+
+async def _arm(node, effect: str) -> None:
+    status, body = await _admin(
+        node, "PUT", f"/v1/failure-probes/rpc/send/{effect}"
+    )
+    assert status == 200, body
+
+
+async def _disarm(node) -> None:
+    status, body = await _admin(node, "DELETE", "/v1/failure-probes/rpc/send")
+    assert status == 200, body
+
+
+async def _ensure_topic(cluster) -> None:
+    c = await KafkaClient(cluster.bootstrap()).connect()
+    try:
+        try:
+            await c.create_topic(TOPIC, partitions=1, replication=3)
+        except Exception:
+            await c.refresh_metadata([TOPIC], auto_create=False)
+    finally:
+        await c.close()
+
+
+async def _leader_of(cluster, topic: str = TOPIC, partition: int = 0) -> int:
+    c = await connect_live(cluster, topic, partition)
+    try:
+        await c.refresh_metadata([topic])
+        return c._leaders[(topic, partition)]
+    finally:
+        await c.close()
+
+
+async def _local_leaders(node, topic: str) -> set[int]:
+    """Partitions of ``topic`` this node's raft state says it leads."""
+    try:
+        status, parts = await _admin(node, "GET", "/v1/partitions")
+    except Exception:
+        return set()
+    if status != 200:
+        return set()
+    return {
+        p["partition"] for p in parts
+        if p["topic"] == topic and p.get("is_leader")
+    }
+
+
+async def _assert_leadership_converged(
+    cluster, topic: str = TOPIC, partitions: int = 1, timeout: float = 45.0
+) -> dict[int, int]:
+    """Exactly one node claims each partition, and the claim is stable
+    across two polls separated by more than an election timeout."""
+    deadline = time.monotonic() + timeout
+    last: dict[int, list[int]] = {}
+    while time.monotonic() < deadline:
+        views = await asyncio.gather(
+            *(_local_leaders(n, topic) for n in cluster.nodes)
+        )
+        claims: dict[int, list[int]] = {p: [] for p in range(partitions)}
+        for node, led in zip(cluster.nodes, views):
+            for p in led:
+                if p in claims:
+                    claims[p].append(node.node_id)
+        last = claims
+        if all(len(v) == 1 for v in claims.values()):
+            stable = {p: v[0] for p, v in claims.items()}
+            await asyncio.sleep(1.2)  # > 2x election timeout (500ms)
+            views2 = await asyncio.gather(
+                *(_local_leaders(n, topic) for n in cluster.nodes)
+            )
+            claims2: dict[int, list[int]] = {p: [] for p in range(partitions)}
+            for node, led in zip(cluster.nodes, views2):
+                for p in led:
+                    if p in claims2:
+                        claims2[p].append(node.node_id)
+            if all(claims2.get(p) == [leader] for p, leader in stable.items()):
+                return stable
+        await asyncio.sleep(0.5)
+    raise AssertionError(f"leadership never converged: {last}")
+
+
+def _run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+# ---------------------------------------------------------------- tests
+def test_rpc_send_delay_no_lost_acked_writes(proc_cluster):
+    """A lagging (not dead) link: every node's outbound rpc delayed. The
+    cluster must stay available, every acked quorum write must survive,
+    and leadership must hold steady once the fault clears."""
+
+    async def body():
+        cluster = proc_cluster
+        await _ensure_topic(cluster)
+        c, acked_pre = await produce_acked(
+            cluster, TOPIC, [b"pre-%d" % i for i in range(5)]
+        )
+        await c.close()
+        leader = await _leader_of(cluster)
+        node = cluster.nodes[leader]
+        await _arm(node, "delay")
+        try:
+            c, acked = await produce_acked(
+                cluster, TOPIC, [b"delay-%d" % i for i in range(8)]
+            )
+            await c.close()
+        finally:
+            await _disarm(node)
+        await cluster.wait_for_settled_writes()
+        await _assert_leadership_converged(cluster)
+        c = await connect_live(cluster, TOPIC)
+        vals = await fetch_all_values(c, TOPIC)
+        await c.close()
+        missing = [v for v in acked_pre + acked if v not in vals]
+        assert not missing, f"ACKED WRITES LOST under rpc delay: {missing}"
+
+    _run(body())
+
+
+def test_rpc_send_exception_moves_leadership_to_healthy_nodes(proc_cluster):
+    """A node whose every outbound rpc fails cannot lead: its heartbeats
+    stop reaching followers, a healthy peer takes the partition over, and
+    acked writes keep landing throughout."""
+
+    async def body():
+        cluster = proc_cluster
+        await _ensure_topic(cluster)
+        sick = await _leader_of(cluster)
+        node = cluster.nodes[sick]
+        await _arm(node, "exception")
+        try:
+            # a healthy peer must take over within the election envelope
+            deadline = time.monotonic() + 45.0
+            new_leader = None
+            while time.monotonic() < deadline:
+                views = await asyncio.gather(*(
+                    _local_leaders(n, TOPIC)
+                    for n in cluster.nodes if n.node_id != sick
+                ))
+                holders = [
+                    n.node_id
+                    for n, led in zip(
+                        [n for n in cluster.nodes if n.node_id != sick], views
+                    )
+                    if 0 in led
+                ]
+                if holders:
+                    new_leader = holders[0]
+                    break
+                await asyncio.sleep(0.3)
+            assert new_leader is not None, "no healthy node took leadership"
+            assert new_leader != sick
+            # the cluster still accepts quorum writes with the sick node up
+            c, acked = await produce_acked(
+                cluster, TOPIC, [b"exc-%d" % i for i in range(5)]
+            )
+            await c.close()
+            assert len(acked) == 5
+        finally:
+            await _disarm(node)
+        await cluster.wait_for_settled_writes()
+        await _assert_leadership_converged(cluster)
+        c = await connect_live(cluster, TOPIC)
+        vals = await fetch_all_values(c, TOPIC)
+        await c.close()
+        missing = [v for v in acked if v not in vals]
+        assert not missing, f"ACKED WRITES LOST under rpc exception: {missing}"
+
+    _run(body())
+
+
+def test_rpc_send_wedge_degradation_is_bounded_and_visible(proc_cluster):
+    """The hard one: the leader's outbound rpc WEDGES (blocks ~2s per
+    send, the hung-link simulation). Quorum writes slow to a crawl but
+    must not be lost, and the incident window's SLO report on the wedged
+    node must FAIL with resolvable trace exemplars — bounded, visible
+    degradation, never a silent PASS."""
+
+    async def body():
+        cluster = proc_cluster
+        await _ensure_topic(cluster)
+        wedged = await _leader_of(cluster)
+        node = cluster.nodes[wedged]
+        # bracket the incident window on the node we are about to hurt
+        status, body_ = await _admin(node, "POST", "/v1/slo/mark?name=pt_wedge")
+        assert status == 200 and body_["series"] > 0
+        await _arm(node, "wedge")
+        t_fault0 = time.monotonic()
+        try:
+            # each quorum write pays the wedge on the replicate leg; a few
+            # are enough samples for the chaos objectives (min_samples 3)
+            c, acked = await produce_acked(
+                cluster, TOPIC, [b"wedge-%d" % i for i in range(4)]
+            )
+            await c.close()
+        finally:
+            await _disarm(node)
+        fault_s = time.monotonic() - t_fault0
+        # BOUNDED: the writes completed while the fault was armed — the
+        # wedge cap + deadline machinery kept each write finite
+        assert len(acked) == 4
+        assert fault_s < 120.0
+        # VISIBLE: the wedged node's incident window judges FAIL
+        status, report = await _admin(node, "GET", "/v1/slo?mark=pt_wedge")
+        assert status == 200
+        assert report["window"] == "since_mark"
+        assert report["failed"] >= 1, report
+        failed = [o for o in report["objectives"] if o["status"] == "FAIL"]
+        assert any(o["samples"] >= o["min_samples"] for o in failed)
+        # breaches carry trace exemplars that resolve on the same node's
+        # slow-span ring (tracer armed by the fixture)
+        exemplars = [
+            ex for o in failed for ex in (o.get("exemplars") or [])
+        ]
+        assert exemplars, f"no breach exemplars in {failed}"
+        status, slow = await _admin(node, "GET", "/v1/trace/slow?limit=500")
+        assert status == 200
+        slow_ids = {sp["trace_id"] for sp in slow.get("spans", [])}
+        resolved = [ex for ex in exemplars if ex["trace_id"] in slow_ids]
+        assert resolved, (exemplars, slow_ids)
+        # recovery: leadership converges, nothing acked was lost, and a
+        # FRESH window judges healthy again (degradation ended)
+        await cluster.wait_for_settled_writes()
+        await _assert_leadership_converged(cluster)
+        c = await connect_live(cluster, TOPIC)
+        vals = await fetch_all_values(c, TOPIC)
+        missing = [v for v in acked if v not in vals]
+        assert not missing, f"ACKED WRITES LOST under rpc wedge: {missing}"
+        status, _ = await _admin(node, "POST", "/v1/slo/mark?name=pt_recovered")
+        assert status == 200
+        c2, acked2 = await produce_acked(
+            cluster, TOPIC, [b"healthy-%d" % i for i in range(5)]
+        )
+        await c2.close()
+        assert len(acked2) == 5
+        status, report2 = await _admin(
+            node, "GET", "/v1/slo?mark=pt_recovered"
+        )
+        assert status == 200
+        assert report2["failed"] == 0, report2
+        await c.close()
+
+    _run(body())

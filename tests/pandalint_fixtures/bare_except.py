@@ -1,0 +1,71 @@
+"""EXC9xx fixture: broad catches with and without classification."""
+from redpanda_tpu.coproc import faults  # noqa: F401
+
+
+def swallow(risky):
+    try:
+        risky()
+    except Exception:
+        return None
+
+
+def naked(risky):
+    try:
+        risky()
+    except:  # noqa: E722
+        pass
+
+
+def classified(risky):
+    try:
+        risky()
+    except Exception as exc:
+        faults.note_failure("fixture", exc)
+
+
+def rethrow(risky):
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def conditional_rethrow(risky):
+    try:
+        risky()
+    except Exception as exc:
+        if isinstance(exc, KeyboardInterrupt):
+            raise
+        return None
+
+
+def import_probe():
+    try:
+        from redpanda_tpu.native import lib  # noqa: F401
+
+        return lib
+    except Exception:
+        return None
+
+
+def narrow(risky):
+    try:
+        risky()
+    except ValueError:
+        return None
+
+
+def tuple_broad(risky):
+    try:
+        risky()
+    except (ValueError, Exception):
+        return None
+
+
+def nested_defs_do_not_classify(risky):
+    try:
+        risky()
+    except Exception:
+        def later(exc):
+            faults.note_failure("fixture", exc)
+        return later

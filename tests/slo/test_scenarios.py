@@ -1,0 +1,68 @@
+"""Full loadgen scenarios: the `-m slow` half of the SLO harness.
+
+These are the minutes-long runs the driver executes out of band
+(`python tools/loadgen.py --scenario mixed_64p --report SLO_r0N.json`);
+in-tree they are marked slow so tier-1 stays fast while CI boxes with
+time budget still exercise the clustered mixed workload and the chaos
+breach path end to end.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from redpanda_tpu.finjector import honey_badger
+from redpanda_tpu.observability import probes, tracer
+
+from tools.loadgen import run_scenario_async
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    yield
+    honey_badger.disable()
+    tracer.configure(enabled=False)
+    tracer.reset()
+    probes.reset_exemplars()
+
+
+def test_mixed_64p_clean_passes(tmp_path):
+    import asyncio
+
+    report = asyncio.run(run_scenario_async(
+        "mixed_64p", base_dir=str(tmp_path), duration_s=8.0
+    ))
+    assert report["pass"] is True, [
+        o for o in report["objectives"] if o["status"] == "FAIL"
+    ]
+    assert report["workloads_ok"] is True
+    assert report["eos_check"]["exact"] is True
+    assert report["nodes"] == 3 and report["replication"] == 3
+    # replication means the rpc/replicate objectives judged real traffic
+    by_name = {o["name"]: o for o in report["objectives"]}
+    assert by_name["replicate_p99"]["samples"] > 0
+    assert by_name["rpc_p99"]["samples"] > 0
+    # tiered reads were served (the locally-evicted prefix came from the
+    # bucket via the fetch fall-through)
+    assert report["throughput"]["tiered_records_read"] > 0
+
+
+def test_mixed_64p_chaos_breaches_with_exemplars(tmp_path):
+    """rpc.send delay armed through the admin API: degradation must be
+    BOUNDED (EOS stays exact, the run completes) and VISIBLE (objectives
+    breach, breaches carry resolvable trace exemplars) — never silent."""
+    import asyncio
+
+    report = asyncio.run(run_scenario_async(
+        "mixed_64p", base_dir=str(tmp_path), duration_s=8.0, chaos=True
+    ))
+    assert report["chaos"] is not None
+    assert report["pass"] is False, "an 800ms rpc delay must breach"
+    assert report["eos_check"]["exact"] is True  # lossless under chaos
+    breached = [o for o in report["objectives"] if o["status"] == "FAIL"]
+    assert breached
+    with_exemplars = [o for o in breached if o.get("exemplars")]
+    assert with_exemplars, "no breach carried trace exemplars"
+    assert report["exemplars_resolved"] == report["exemplars_total"] > 0

@@ -415,3 +415,141 @@ def test_traceview_renders_breakdown_and_flamegraph():
 def test_registry_snapshot_is_jsonable():
     snap = registry.snapshot()
     json.dumps(snap)  # no weird types leak out of the registry
+
+
+# ---------------------------------------------------------------- exemplars
+def test_over_threshold_observations_record_trace_exemplars():
+    """The /v1/slo → /v1/trace/slow link: an observation over the armed
+    breach threshold records the ambient trace id alongside its bucket;
+    under-threshold and trace-less observations record nothing."""
+    from redpanda_tpu.metrics import Histogram
+
+    h = Histogram("exemplar_test_latency_us", "scratch")
+    key = "exemplar_test_latency_us"
+    probes.reset_exemplars()
+    probes.arm_exemplar_threshold(h, 1000.0)  # 1ms
+    tracer.configure(enabled=True, slow_threshold_ms=10_000)
+    tracer.reset()
+    try:
+        with tracer.span("req", root=True) as sp:
+            tid = sp.trace_id
+            probes.record_us(h, 500)      # under threshold: no exemplar
+            probes.record_us(h, 2_000)    # breach with ambient trace
+        probes.record_us(h, 3_000)        # breach, no ambient: skipped
+        probes.record_us(h, 4_000, trace_id=99)  # explicit id (dispatch path)
+        exs = probes.exemplars_for(key)
+        assert [(e["trace_id"], e["value_us"]) for e in exs] == [
+            (99, 4_000), (tid, 2_000),  # newest first
+        ]
+        # the bucket rides along so the exemplar anchors to the histogram
+        assert all(e["bucket_us"] >= e["value_us"] for e in exs)
+        assert key in probes.exemplars_snapshot()
+    finally:
+        tracer.configure(enabled=False)
+        tracer.reset()
+        probes.reset_exemplars()
+
+
+def test_unarmed_histogram_uses_tracer_slow_threshold():
+    """With no SLO objective armed, the exemplar fallback is the tracer's
+    slow threshold — and a disabled tracer records nothing at all."""
+    from redpanda_tpu.metrics import Histogram
+
+    h = Histogram("exemplar_fallback_latency_us", "scratch")
+    key = "exemplar_fallback_latency_us"
+    probes.reset_exemplars()
+    try:
+        # tracer disabled: even a huge observation records no exemplar
+        probes.record_us(h, 10_000_000, trace_id=5)
+        assert probes.exemplars_for(key) == []
+        tracer.configure(enabled=True, slow_threshold_ms=1.0)
+        with tracer.span("req", root=True) as sp:
+            probes.record_us(h, 500)    # under 1ms
+            probes.record_us(h, 5_000)  # over the slow threshold
+        exs = probes.exemplars_for(key)
+        assert [e["value_us"] for e in exs] == [5_000]
+        assert exs[0]["trace_id"] == sp.trace_id
+    finally:
+        tracer.configure(enabled=False)
+        tracer.reset()
+        probes.reset_exemplars()
+
+
+def test_produce_breach_links_slo_report_to_slow_trace(tmp_path):
+    """End to end on a real broker: an impossible produce objective turns
+    every produce into a breach; GET /v1/slo must FAIL with exemplars
+    whose trace ids appear in GET /v1/trace/slow."""
+    from redpanda_tpu.observability.slo import Objective, SloSpec, slo as slo_engine
+
+    async def main():
+        storage, broker, server, api, admin = await _start_stack(tmp_path)
+        client = await KafkaClient([("127.0.0.1", server.port)]).connect()
+        try:
+            await broker.create_topic(TopicConfig("slobreach", 1))
+            spec = SloSpec("breach_test", [Objective(
+                "impossible", "kafka_produce_latency_us", 0.001, 99.0,
+                min_samples=1,
+            )])
+            slo_engine.configure(spec)
+            baseline = slo_engine.snapshot()
+            for i in range(5):
+                await client.produce("slobreach", 0, [b"v%d" % i])
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{admin.port}/v1/slo"
+                ) as resp:
+                    assert resp.status == 200
+                    doc = await resp.json()
+                async with s.get(
+                    f"http://127.0.0.1:{admin.port}/v1/trace/slow?limit=500"
+                ) as resp:
+                    slow_doc = await resp.json()
+            # the admin endpoint judges process lifetime; the windowed
+            # verdict over our baseline agrees
+            windowed = slo_engine.evaluate(spec, baseline=baseline)
+            for report in (doc, windowed):
+                obj = next(
+                    o for o in report["objectives"]
+                    if o["name"] == "impossible"
+                )
+                assert obj["status"] == "FAIL"
+                assert obj["exemplars"], report
+            slow_ids = {sp_["trace_id"] for sp_ in slow_doc["spans"]}
+            ex_ids = {e["trace_id"] for e in obj["exemplars"]}
+            assert ex_ids & slow_ids, (ex_ids, slow_ids)
+            # marks round-trip over the admin api
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{admin.port}/v1/slo/mark?name=t"
+                ) as resp:
+                    assert resp.status == 200
+                async with s.get(
+                    f"http://127.0.0.1:{admin.port}/v1/slo?mark=t"
+                ) as resp:
+                    marked = await resp.json()
+                async with s.get(
+                    f"http://127.0.0.1:{admin.port}/v1/slo?mark=never"
+                ) as resp:
+                    assert resp.status == 404
+            obj_m = next(
+                o for o in marked["objectives"] if o["name"] == "impossible"
+            )
+            assert obj_m["status"] == "NO_DATA"  # nothing since the mark
+        finally:
+            await client.close()
+            await _stop_stack(storage, server, api, admin)
+
+    from redpanda_tpu.observability.slo import DEFAULT_SPEC
+
+    tracer.configure(enabled=True, slow_threshold_ms=0.001)
+    tracer.reset()
+    probes.reset_exemplars()
+    try:
+        run(main())
+    finally:
+        from redpanda_tpu.observability.slo import slo as _slo
+
+        _slo.configure(DEFAULT_SPEC, arm_exemplars=False)
+        tracer.configure(enabled=False)
+        tracer.reset()
+        probes.reset_exemplars()

@@ -181,6 +181,34 @@ def test_sleep_async_exempts_finjector(tmp_path):
         )
 
 
+def test_bare_except_rules_exact_lines():
+    got = _active(_lint(os.path.join(FIXTURES, "bare_except.py")))
+    assert got == [
+        ("EXC901", 8),   # swallow without classification
+        ("EXC902", 15),  # naked except:
+        ("EXC901", 61),  # (ValueError, Exception) tuple is still broad
+        ("EXC901", 68),  # note_failure only inside a nested def ≠ classified
+    ]
+
+
+def test_bare_except_scoped_to_coproc(tmp_path):
+    """note_failure is the coproc fault-domain contract; a broad catch in
+    kafka/raft has no classifier to report to and must not trip the gate."""
+    cfg = Config()
+    for sub, expect in (("kafka", False), ("raft", False), ("coproc", True)):
+        pkg = tmp_path / "redpanda_tpu" / sub
+        pkg.mkdir(parents=True)
+        dst = pkg / "be.py"
+        shutil.copyfile(os.path.join(FIXTURES, "bare_except.py"), dst)
+        report = LintEngine(cfg).lint_file(str(dst), f"redpanda_tpu/{sub}/be.py")
+        assert any(f.rule.startswith("EXC") for f in report.findings) is expect, sub
+    # faults.py — the classifier itself — is exempt wholesale
+    dst = tmp_path / "redpanda_tpu" / "coproc" / "faults.py"
+    shutil.copyfile(os.path.join(FIXTURES, "bare_except.py"), dst)
+    report = LintEngine(cfg).lint_file(str(dst), "redpanda_tpu/coproc/faults.py")
+    assert not any(f.rule.startswith("EXC") for f in report.findings)
+
+
 def test_iobuf_rules_exact_lines():
     got = _active(_lint(os.path.join(FIXTURES, "copy_loop.py")))
     assert got == [

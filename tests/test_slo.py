@@ -1,0 +1,303 @@
+"""SLO engine: bucket-interpolated quantiles, windows, verdicts, exemplars.
+
+The quantile tests drive exact known distributions through the real
+HdrHist bucket layout (and hand-built bucket lists for the prometheus
++Inf overflow shape) so the interpolation math is pinned down, not
+eyeballed: single-bucket, empty, overflow-clamp and the min_samples gate
+are the ISSUE 7 satellite checklist.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from redpanda_tpu.metrics import MetricsRegistry
+from redpanda_tpu.observability import probes
+from redpanda_tpu.observability.slo import (
+    DEFAULT_SPEC,
+    Objective,
+    SloEngine,
+    SloSpec,
+    breach_fraction,
+    interpolate_quantile,
+    window_delta,
+)
+from redpanda_tpu.utils.hdr import HdrHist
+
+
+def _buckets(h: HdrHist):
+    return [(float(u), c) for u, c in h.cumulative_buckets()]
+
+
+# ---------------------------------------------------------------- quantiles
+def test_quantile_single_bucket_interpolates_within_bounds():
+    """All mass in one bucket: every quantile must land inside that
+    bucket's TRUE (lower, upper] span — derived from the HDR layout, not
+    zero — ordered by rank."""
+    from redpanda_tpu.utils.hdr import _bucket_of, _bucket_upper
+
+    h = HdrHist()
+    for _ in range(100):
+        h.record(1000)  # one bucket
+    b = _buckets(h)
+    assert len(b) == 1
+    upper = b[0][0]
+    lower = float(_bucket_upper(_bucket_of(1000) - 1) + 1)
+    assert lower <= 1000 <= upper
+    p50 = interpolate_quantile(b, h.count, 50)
+    p95 = interpolate_quantile(b, h.count, 95)
+    p99 = interpolate_quantile(b, h.count, 99)
+    assert lower < p50 < p95 < p99 <= upper
+    # linear-in-rank WITHIN the true bucket: p50 sits at its midpoint
+    assert p50 == pytest.approx(lower + (upper - lower) * 0.5, rel=0.01)
+
+
+def test_quantile_empty_histogram_is_none():
+    assert interpolate_quantile([], 0, 99) is None
+    assert interpolate_quantile([(10.0, 5)], 0, 99) is None
+    assert breach_fraction([], 0, 100.0) == 0.0
+
+
+def test_quantile_exact_two_point_distribution():
+    """90 fast + 10 slow observations: p50 must sit in the fast bucket,
+    p99 in the slow one, and the crossover lands where the ranks say."""
+    h = HdrHist()
+    for _ in range(90):
+        h.record(100)
+    for _ in range(10):
+        h.record(100_000)
+    b = _buckets(h)
+    p50 = interpolate_quantile(b, h.count, 50)
+    p99 = interpolate_quantile(b, h.count, 99)
+    assert p50 <= 127  # the 100us bucket's upper bound (2^6*4 sub-buckets)
+    assert 90_000 <= p99 <= 130_000  # inside the slow bucket (±19% layout)
+    # the breach fraction at a mid threshold is the slow share, within the
+    # in-bucket linearity error (sparse log buckets spread a bucket's mass
+    # down to the previous recorded bound)
+    assert breach_fraction(b, h.count, 10_000.0) == pytest.approx(0.1, abs=0.02)
+
+
+def test_quantile_inf_overflow_bucket_clamps():
+    """Prometheus-shaped buckets with a +Inf overflow: the quantile inside
+    the overflow clamps to the observed max (or the last finite bound),
+    never extrapolates past what the histogram knows."""
+    inf = float("inf")
+    b = [(100.0, 50), (inf, 100)]
+    assert interpolate_quantile(b, 100, 99, observed_max=5000) == 5000.0
+    assert interpolate_quantile(b, 100, 99) == 100.0  # no max known
+    # ranks below the overflow still interpolate normally
+    assert interpolate_quantile(b, 100, 25) == pytest.approx(50.0)
+    # everything over a threshold beyond the last finite bound is the
+    # overflow mass
+    assert breach_fraction(b, 100, 200.0) == pytest.approx(0.5)
+
+
+def test_quantile_bimodal_gap_does_not_underestimate_tail():
+    """Sparse bucket lists omit the empty buckets between modes; the
+    straddling bucket's lower bound must come from the HDR layout, or a
+    bimodal tail (the chaos shape: most requests fast, a few at the
+    injected delay) interpolates down across the gap and reports a false
+    PASS. 990 at 2ms + 10 at 800ms: p99.5 must sit near 800ms, not at
+    the ~400ms midpoint of the gap."""
+    h = HdrHist()
+    for _ in range(990):
+        h.record(2_000)
+    for _ in range(10):
+        h.record(800_000)
+    b = _buckets(h)
+    p995 = interpolate_quantile(b, h.count, 99.5)
+    assert p995 > 700_000, p995
+    # and the breach fraction at a mid-gap threshold is exactly the tail
+    assert breach_fraction(b, h.count, 400_000.0) == pytest.approx(0.01, abs=1e-6)
+
+
+def test_quantile_foreign_bucket_ladder_uses_previous_bound():
+    """A scraped-prometheus ladder is contiguous — the previous bound IS
+    the lower bound. hdr_layout=False must interpolate from it even when
+    a bound coincides with an HDR upper; auto-detect falls back whenever
+    any bound misses the HDR layout (0.5 and 10 are not HDR bounds)."""
+    b = [(1.0, 0), (5.0, 100)]
+    assert interpolate_quantile(b, 100, 50, hdr_layout=False) == pytest.approx(3.0)
+    generic = [(0.5, 0), (10.0, 100)]  # auto: not an HDR ladder
+    assert interpolate_quantile(generic, 100, 50) == pytest.approx(5.25)
+    assert breach_fraction(b, 100, 3.0, hdr_layout=False) == pytest.approx(0.5)
+
+
+def test_quantile_monotone_in_q():
+    h = HdrHist()
+    for v in (10, 20, 40, 80, 160, 320, 640, 1280, 2560, 5120):
+        for _ in range(7):
+            h.record(v)
+    b = _buckets(h)
+    qs = [interpolate_quantile(b, h.count, q) for q in (10, 50, 90, 99, 100)]
+    assert qs == sorted(qs)
+    assert qs[-1] <= 5120 * 1.25  # bucket upper bound slack
+
+
+# ---------------------------------------------------------------- windows
+def test_window_delta_subtracts_cumulative_buckets():
+    h = HdrHist()
+    for _ in range(10):
+        h.record(100)
+    before = {"buckets": _buckets(h), "count": h.count, "sum": h.sum, "max": h.max}
+    for _ in range(5):
+        h.record(100_000)
+    after = {"buckets": _buckets(h), "count": h.count, "sum": h.sum, "max": h.max}
+    d = window_delta(after, before)
+    assert d["count"] == 5
+    # ONLY the new observations: the fast bucket contributes nothing
+    assert interpolate_quantile(d["buckets"], d["count"], 50) > 10_000
+    # zero-delta bounds kept by window_delta pin the slow bucket's lower
+    # bound, so nearly all the windowed mass sits over the threshold
+    assert breach_fraction(d["buckets"], d["count"], 10_000.0) > 0.9
+    # no baseline = the full history
+    assert window_delta(after, None) is after
+
+
+# ---------------------------------------------------------------- objectives
+def test_min_samples_gate_is_no_data_not_fail():
+    reg = MetricsRegistry()
+    h = reg.histogram("kafka_produce_latency_us")
+    for _ in range(9):
+        h.record(10_000_000)  # 10s — way over threshold, but under-sampled
+    eng = SloEngine(reg)
+    spec = SloSpec("t", [
+        Objective("p", "kafka_produce_latency_us", 1.0, 99.0, min_samples=10)
+    ])
+    rep = eng.evaluate(spec)
+    assert rep["objectives"][0]["status"] == "NO_DATA"
+    assert rep["pass"] is True and rep["no_data"] == 1
+    h.record(10_000_000)  # the 10th sample opens the gate
+    rep = eng.evaluate(spec)
+    assert rep["objectives"][0]["status"] == "FAIL"
+    assert rep["pass"] is False
+
+
+def test_unregistered_metric_is_no_data():
+    eng = SloEngine(MetricsRegistry())
+    rep = eng.evaluate(SloSpec("t", [Objective("x", "nope_latency_us", 1.0)]))
+    assert rep["objectives"][0]["status"] == "NO_DATA"
+    assert rep["objectives"][0]["detail"] == "metric not registered"
+
+
+def test_budget_pct_overrides_quantile_verdict():
+    """An explicit error budget relaxes the raw quantile: 10% of samples
+    over threshold passes a 20% budget but fails a 5% one."""
+    reg = MetricsRegistry()
+    h = reg.histogram("kafka_fetch_latency_us")
+    for _ in range(90):
+        h.record(100)
+    for _ in range(10):
+        h.record(1_000_000)
+    eng = SloEngine(reg)
+
+    def verdict(budget):
+        spec = SloSpec("t", [Objective(
+            "f", "kafka_fetch_latency_us", 10.0, 99.0, budget_pct=budget
+        )])
+        return eng.evaluate(spec)["objectives"][0]["status"]
+
+    assert verdict(20.0) == "PASS"
+    assert verdict(5.0) == "FAIL"
+
+
+def test_labeled_objective_targets_one_series():
+    reg = MetricsRegistry()
+    fast = reg.histogram("coproc_stage_latency_us", stage="explode")
+    slow = reg.histogram("coproc_stage_latency_us", stage="fetch")
+    for _ in range(20):
+        fast.record(100)
+        slow.record(10_000_000)
+    eng = SloEngine(reg)
+    spec = SloSpec("t", [Objective(
+        "explode", "coproc_stage_latency_us", 100.0, 99.0,
+        labels={"stage": "explode"},
+    )])
+    rep = eng.evaluate(spec)
+    assert rep["objectives"][0]["status"] == "PASS"  # the slow series is NOT judged
+
+
+def test_marks_window_the_verdict():
+    reg = MetricsRegistry()
+    h = reg.histogram("rpc_request_latency_us")
+    for _ in range(50):
+        h.record(5_000_000)  # terrible past
+    eng = SloEngine(reg)
+    eng.set_mark("incident_over")
+    for _ in range(50):
+        h.record(100)  # healthy since
+    spec = SloSpec("t", [Objective("r", "rpc_request_latency_us", 10.0, 99.0)])
+    assert eng.evaluate(spec)["pass"] is False  # lifetime: the past counts
+    rep = eng.evaluate(spec, mark="incident_over")
+    assert rep["pass"] is True and rep["window"] == "since_mark"
+    with pytest.raises(KeyError):
+        eng.evaluate(spec, mark="never_set")
+    assert "incident_over" in eng.marks()
+
+
+# ---------------------------------------------------------------- spec io
+def test_spec_parse_validation_and_roundtrip(tmp_path):
+    doc = {
+        "name": "s",
+        "objectives": [
+            {"metric": "kafka_produce_latency_us", "threshold_ms": 5,
+             "quantile": 95, "min_samples": 7,
+             "labels": {"stage": "explode"}},
+        ],
+    }
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps(doc))
+    spec = SloSpec.load(str(p))
+    assert spec.objectives[0].quantile == 95
+    assert spec.objectives[0].labels == {"stage": "explode"}
+    assert spec.objectives[0].name == "kafka_produce_latency_us_p95"
+    # YAML form parses too (config already depends on pyyaml)
+    y = tmp_path / "slo.yaml"
+    y.write_text(
+        "name: s\nobjectives:\n"
+        "  - metric: kafka_fetch_latency_us\n    threshold_ms: 9\n"
+    )
+    assert SloSpec.load(str(y)).objectives[0].metric == "kafka_fetch_latency_us"
+    with pytest.raises(ValueError):
+        SloSpec.from_dict({"name": "x", "objectives": []})
+    with pytest.raises(ValueError):
+        Objective.from_dict({"metric": "m"})  # threshold missing
+    with pytest.raises(ValueError):
+        Objective.from_dict({"metric": "m", "threshold_ms": 0})
+    with pytest.raises(ValueError):
+        Objective.from_dict({"metric": "m", "threshold_ms": 1, "quantile": 0})
+    json.dumps(DEFAULT_SPEC.to_dict())  # serializable
+
+
+# ---------------------------------------------------------------- exemplars
+def test_breaching_objective_carries_armed_exemplars():
+    """Loading a spec arms the objective threshold on the histogram; an
+    over-threshold observation recorded with a trace id becomes the
+    breach's exemplar, bucket included."""
+    probes.reset_exemplars()
+    reg = MetricsRegistry()
+    h = reg.histogram("kafka_produce_latency_us")
+    eng = SloEngine(reg)
+    spec = SloSpec("t", [Objective("p", "kafka_produce_latency_us", 1.0, 99.0)])
+    eng.configure(spec)  # arms 1ms on the histogram
+    try:
+        probes.record_us(h, 500, trace_id=7)      # under: no exemplar
+        probes.record_us(h, 50_000, trace_id=8)   # breach: exemplar
+        probes.record_us(h, 60_000, trace_id=None)  # breach, no trace: skipped
+        rep = eng.evaluate(spec)
+        obj = rep["objectives"][0]
+        assert obj["status"] == "FAIL"
+        exs = obj["exemplars"]
+        assert [e["trace_id"] for e in exs] == [8]
+        assert exs[0]["value_us"] == 50_000
+        assert exs[0]["bucket_us"] >= 50_000  # the bucket it landed in
+        # a windowed report only carries exemplars recorded INSIDE the
+        # window — incident A's traces must not decorate incident B
+        baseline = eng.snapshot()
+        probes.record_us(h, 70_000, trace_id=11)
+        rep2 = eng.evaluate(spec, baseline=baseline)
+        assert [e["trace_id"] for e in rep2["objectives"][0]["exemplars"]] == [11]
+    finally:
+        probes.reset_exemplars()

@@ -1,0 +1,983 @@
+"""Closed-loop mixed-workload load generator gated on pandaprobe SLOs.
+
+The ducktape/consistency-suite analogue (SURVEY §4.2-4.3) for the
+"heavy traffic from millions of users" leg of the north star: simulated
+clients drive produce → coproc-transform → fetch, consumer groups with
+live rebalances, EOS consume-transform-produce transactions, and
+tiered-storage reads against a real in-process broker (or an in-process
+multi-node cluster over loopback RPC), then the run is *judged*: the
+pandaprobe registry is snapshotted before and after, and the delta is
+evaluated against the scenario's declarative SLO objectives
+(observability/slo.py). The verdict — per-objective quantiles,
+pass/fail, throughput, and breach exemplars that resolve to
+/v1/trace/slow entries — lands in an ``SLO_r0N.json`` report alongside
+the BENCH trajectory.
+
+Arrival model: **open-loop arrival, closed-loop completion**. Each
+producer client schedules arrivals on the wall clock (a slow broker does
+not slow the offered load down — no coordinated omission) but awaits
+every operation to completion, so the broker-side histograms see true
+end-to-end latencies under the configured concurrency.
+
+Chaos: ``--chaos`` arms the scenario's honey-badger probe (PR 4) through
+the REAL admin API before the measured window — ``rpc.send`` delay
+between the in-process cluster's nodes is the canonical one: every
+replicate leg pays the injected delay, the rpc/produce objectives
+breach, and each breach carries trace exemplars. The cluster-level
+partition-tolerance suite over real broker *processes* lives in
+tests/chaos/test_partition_tolerance.py; this tool is the load half.
+
+Usage:
+    python tools/loadgen.py --scenario mixed_64p --report SLO_r06.json
+    python tools/loadgen.py --scenario mixed_64p --chaos --report SLO_r06_chaos.json
+    python tools/loadgen.py --list
+
+Scale: client counts multiply with ``--clients-scale`` (the default
+sizes target a 2-core CI box; ``--clients-scale 8`` simulates thousands
+of clients on real hardware).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import copy
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+# the S3 imposter (tiered-storage scenarios) lives with the tests
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+# ================================================================ scenarios
+# Objective threshold notes: clean runs must PASS on a busy shared box, so
+# thresholds are generous against in-process latencies; the chaos delay is
+# sized (see "chaos") to push the rpc/replicate tails well past them.
+def _objectives(produce_ms, fetch_ms, append_ms, replicate_ms, rpc_ms,
+                explode_ms, min_samples):
+    return [
+        {"name": "produce_p99", "metric": "kafka_produce_latency_us",
+         "quantile": 99, "threshold_ms": produce_ms, "min_samples": min_samples},
+        # fetch includes deliberate long-poll waits; judge on the error
+        # budget (5% may ride the poll) instead of the raw quantile
+        {"name": "fetch_p99", "metric": "kafka_fetch_latency_us",
+         "quantile": 99, "threshold_ms": fetch_ms,
+         "min_samples": min_samples, "budget_pct": 5.0},
+        {"name": "append_p99", "metric": "storage_append_latency_us",
+         "quantile": 99, "threshold_ms": append_ms, "min_samples": min_samples},
+        {"name": "replicate_p99", "metric": "raft_replicate_latency_us",
+         "quantile": 99, "threshold_ms": replicate_ms, "min_samples": 1},
+        {"name": "rpc_p99", "metric": "rpc_request_latency_us",
+         "quantile": 99, "threshold_ms": rpc_ms, "min_samples": 1},
+        {"name": "coproc_explode_p95", "metric": "coproc_stage_latency_us",
+         "labels": {"stage": "explode"}, "quantile": 95,
+         "threshold_ms": explode_ms, "min_samples": 1},
+    ]
+
+
+SCENARIOS: dict[str, dict] = {
+    # Tier-1 smoke: one broker, seconds long, deterministic PASS under
+    # deliberately loose objectives (tests/slo/test_slo_smoke.py).
+    "smoke": {
+        "nodes": 1,
+        "partitions": 4,
+        "replication": 1,
+        "duration_s": 2.0,
+        "producers": 4,
+        "produce_rate": 25.0,      # arrivals/s per producer client
+        "records_per_op": 4,
+        "record_bytes": 128,
+        "group_members": 2,
+        "rebalance_every_s": 0.0,  # off: the smoke run must be quiet
+        "eos_pairs": 1,
+        "eos_abort_every": 3,
+        "transform_readers": 1,
+        "tiered_readers": 0,
+        "coproc": True,
+        "objectives": _objectives(10_000, 20_000, 5_000, 10_000, 5_000,
+                                  5_000, 20),
+        "chaos": {"module": "rpc", "probe": "send", "effect": "delay",
+                  "delay_ms": 800},
+    },
+    # The acceptance scenario: an in-process 3-node cluster, 64-partition
+    # replicated topic, all four workload families at once. Clean run
+    # passes; --chaos delays every inter-node rpc.send 800ms, breaching
+    # the rpc (and usually replicate) objectives with trace exemplars.
+    "mixed_64p": {
+        "nodes": 3,
+        "partitions": 64,
+        "replication": 3,
+        "duration_s": 12.0,
+        "producers": 24,
+        "produce_rate": 6.0,
+        "records_per_op": 8,
+        "record_bytes": 256,
+        "group_members": 6,
+        "rebalance_every_s": 3.0,
+        "eos_pairs": 3,
+        "eos_abort_every": 4,
+        "transform_readers": 2,
+        "tiered_readers": 2,
+        "coproc": True,
+        # thresholds sit in the clean/chaos separation band: the clean run
+        # measures produce/replicate p99 ≈ 100ms and rpc p99 ≈ 40ms on a
+        # 2-core box, while an 800ms rpc.send delay pushes rpc past 800ms
+        # and produce/replicate into seconds — so clean PASSes with ~20x
+        # margin and chaos breaches with exemplars, deterministically
+        "objectives": _objectives(2_000, 30_000, 5_000, 2_000, 500,
+                                  5_000, 100),
+        "chaos": {"module": "rpc", "probe": "send", "effect": "delay",
+                  "delay_ms": 800},
+    },
+    # Single-node heavy-partition variant: no replication rpc, coproc and
+    # host-stage machinery under the full partition fan-out.
+    "standalone_64p": {
+        "nodes": 1,
+        "partitions": 64,
+        "replication": 1,
+        "duration_s": 8.0,
+        "producers": 16,
+        "produce_rate": 10.0,
+        "records_per_op": 8,
+        "record_bytes": 256,
+        "group_members": 4,
+        "rebalance_every_s": 2.5,
+        "eos_pairs": 2,
+        "eos_abort_every": 4,
+        "transform_readers": 2,
+        "tiered_readers": 2,
+        "coproc": True,
+        "objectives": _objectives(15_000, 30_000, 8_000, 10_000, 5_000,
+                                  8_000, 50),
+        "chaos": {"module": "coproc", "probe": "device_dispatch",
+                  "effect": "delay", "delay_ms": 800},
+    },
+}
+
+TOPIC = "loadgen"
+EOS_SRC_GROUP = "loadgen-eos"
+EOS_DST = "loadgen-eos-out"
+TIERED_TOPIC = "loadgen-tiered"
+SCRIPT_NAME = "loadgen-filter"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ================================================================ the stack
+class Stack:
+    """1..N in-process Applications sharing this process's registry,
+    tracer, SLO engine and honey badger — which is exactly what lets the
+    scenario snapshot/judge them directly while chaos arming still goes
+    through the real admin API."""
+
+    def __init__(self, scenario: dict, base_dir: str, imposter=None):
+        self.scenario = scenario
+        self.base_dir = base_dir
+        self.imposter = imposter
+        self.apps = []
+        self.kafka_ports: list[int] = []
+        self.admin_ports: list[int] = []
+
+    def _configs(self):
+        from redpanda_tpu.config import Configuration
+
+        s = self.scenario
+        n = s["nodes"]
+        thresholds = [o["threshold_ms"] for o in s["objectives"]]
+        slow_ms = max(1, int(min(thresholds)))
+        rpc_ports = [_free_port() for _ in range(n)]
+        # kafka ports are pre-allocated, not ephemeral: in clustered mode
+        # the advertised port replicates through the controller's
+        # register_node command, which reads the configured value
+        kafka_ports = [_free_port() for _ in range(n)]
+        seed_str = (
+            ",".join(f"{i}@127.0.0.1:{p}" for i, p in enumerate(rpc_ports))
+            if n > 1 else ""
+        )
+        configs = []
+        for i in range(n):
+            c = Configuration()
+            sets = {
+                "node_id": i,
+                "data_directory": os.path.join(self.base_dir, f"n{i}"),
+                "kafka_api_port": kafka_ports[i],
+                "advertised_kafka_api_port": kafka_ports[i],
+                "admin_api_port": 0,
+                "rpc_server_port": rpc_ports[i],
+                "seed_servers": seed_str,
+                "default_topic_replication": s["replication"],
+                # tolerate the injected rpc delay without election storms:
+                # a heartbeat delayed by the chaos effect must still land
+                # inside the election timeout
+                "raft_election_timeout_ms": 2500,
+                "raft_heartbeat_interval_ms": 250,
+                "coproc_enable": bool(s.get("coproc")),
+                # exemplars + /v1/trace/slow resolution need the tracer;
+                # the slow ring threshold tracks the tightest objective so
+                # every breach-sized span is resolvable afterwards
+                "trace_enabled": True,
+                "trace_slow_threshold_ms": slow_ms,
+            }
+            if self.imposter is not None:
+                sets.update({
+                    "cloud_storage_enabled": True,
+                    "cloud_storage_bucket": "loadgen",
+                    "cloud_storage_api_endpoint":
+                        f"http://127.0.0.1:{self.imposter.port}",
+                    "cloud_storage_access_key": "k",
+                    "cloud_storage_secret_key": "s",
+                    "cloud_storage_segment_max_upload_interval_sec": 1,
+                })
+            for k, v in sets.items():
+                c.set(k, v)
+            configs.append(c)
+        return configs
+
+    async def start(self) -> "Stack":
+        from redpanda_tpu.app import Application
+
+        configs = self._configs()
+        # return_exceptions + assign-before-raise: if one node fails to
+        # start (port bind race), the ones that DID start are recorded so
+        # the caller's stack.stop() tears them down instead of leaking
+        # live brokers into the process
+        results = await asyncio.gather(
+            *(Application(c).start() for c in configs),
+            return_exceptions=True,
+        )
+        self.apps = [a for a in results if not isinstance(a, BaseException)]
+        errors = [e for e in results if isinstance(e, BaseException)]
+        if errors:
+            raise errors[0]
+        # the config property is integer milliseconds; re-apply the exact
+        # float so every breach-sized span (possibly sub-ms in tests) is
+        # guaranteed to land in the slow ring its exemplar points at
+        from redpanda_tpu.observability import tracer
+
+        tracer.configure(
+            slow_threshold_ms=min(
+                o["threshold_ms"] for o in self.scenario["objectives"]
+            )
+        )
+        self.kafka_ports = [a.kafka_server.port for a in self.apps]
+        self.admin_ports = [a.admin.port for a in self.apps]
+        if len(self.apps) > 1:
+            await self._wait_settled()
+        return self
+
+    async def _wait_settled(self, timeout: float = 60.0) -> None:
+        """Same contract as the chaos harness's wait_for_settled_writes:
+        two acks=-1 canary writes across an election-timeout margin."""
+        from redpanda_tpu.kafka.client import KafkaClient
+
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            c = None
+            try:
+                c = await KafkaClient(self.bootstrap()).connect()
+                try:
+                    await c.create_topic(
+                        "loadgen-canary", partitions=1,
+                        replication=self.scenario["replication"],
+                    )
+                except Exception:
+                    await c.refresh_metadata(["loadgen-canary"], auto_create=False)
+                await c.produce("loadgen-canary", 0, [b"settle-1"], acks=-1)
+                await asyncio.sleep(0.6)
+                await c.produce("loadgen-canary", 0, [b"settle-2"], acks=-1)
+                await c.close()
+                return
+            except Exception as e:  # noqa: BLE001 — retried until deadline
+                last = e
+                if c is not None:
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.5)
+        raise TimeoutError(f"cluster writes never settled: {last!r}")
+
+    def bootstrap(self) -> list[tuple[str, int]]:
+        return [("127.0.0.1", p) for p in self.kafka_ports]
+
+    async def stop(self) -> None:
+        for a in self.apps:
+            try:
+                await a.stop()
+            except Exception:
+                pass
+
+
+# ================================================================ workloads
+def _payload(client_id: int, seq: int, j: int, size: int) -> bytes:
+    level = ("error", "info", "warn")[(client_id + seq + j) % 3]
+    doc = '{"level":"%s","code":%d,"msg":"c%d-%d-%d-' % (
+        level, j, client_id, seq, j
+    )
+    pad = max(0, size - len(doc) - 2)
+    return (doc + "x" * pad + '"}').encode()
+
+
+async def _sleep_or_stop(stop: asyncio.Event, delay: float) -> bool:
+    """True when the stop event fired during the wait. No shield: wait_for
+    cancels the Event.wait() on timeout, which is harmless and leak-free
+    (a shielded waiter would survive until stop.set(), thousands of them
+    over a long scenario)."""
+    if delay <= 0:
+        return stop.is_set()
+    try:
+        await asyncio.wait_for(stop.wait(), delay)
+        return True
+    except asyncio.TimeoutError:
+        return False
+
+
+async def _producer(i, client, partitions, rate, k, size, stop, stats):
+    loop = asyncio.get_event_loop()
+    interval = 1.0 / rate
+    # stagger client phases so arrivals spread over the interval
+    next_t = loop.time() + (i % 16) / 16.0 * interval
+    part = i % partitions
+    seq = 0
+    while not stop.is_set():
+        now = loop.time()
+        if next_t > now:
+            if await _sleep_or_stop(stop, next_t - now):
+                break
+        # open loop: the schedule advances regardless of completion time
+        next_t += interval
+        part = (part + 1) % partitions
+        values = [_payload(i, seq, j, size) for j in range(k)]
+        seq += 1
+        try:
+            await client.produce(TOPIC, part, values, acks=-1)
+            stats["produce_ops"] += 1
+            stats["produced_records"] += k
+        except Exception:
+            stats["produce_errors"] += 1
+
+
+async def _group_member(i, client, topics, stop, stats):
+    from redpanda_tpu.kafka.client.consumer import GroupConsumer
+
+    c = GroupConsumer(
+        client, "loadgen-group", topics,
+        session_timeout_ms=8000, heartbeat_interval_s=0.5,
+    )
+    try:
+        await c.join()
+        stats["group_joins"] += 1
+        while not stop.is_set():
+            try:
+                out = await c.poll(max_records=500)
+                n = sum(len(v) for v in out.values())
+                stats["consumed_records"] += n
+                await c.commit()
+                if c.rejoin_needed:
+                    stats["rebalances_seen"] += 1
+                if not out:
+                    await _sleep_or_stop(stop, 0.05)
+            except Exception:
+                stats["consume_errors"] += 1
+                if await _sleep_or_stop(stop, 0.2):
+                    break
+    finally:
+        try:
+            await c.leave()
+        except Exception:
+            pass
+
+
+async def _rebalancer(client, topics, every_s, stop, stats):
+    """Forces group rebalances by cycling a transient member in and out —
+    every join and leave bumps the generation for the whole group."""
+    from redpanda_tpu.kafka.client.consumer import GroupConsumer
+
+    while not stop.is_set():
+        if await _sleep_or_stop(stop, every_s):
+            break
+        t = GroupConsumer(
+            client, "loadgen-group", topics,
+            session_timeout_ms=8000, heartbeat_interval_s=0.5,
+        )
+        try:
+            await t.join()
+            await _sleep_or_stop(stop, 0.3)
+            await t.leave()
+            stats["rebalances_forced"] += 1
+        except Exception:
+            stats["rebalance_errors"] += 1
+
+
+async def _eos_pair(i, client, partitions, abort_every, stop, stats):
+    """Consume-transform-produce with EOS: read the main topic, write the
+    transform to EOS_DST inside a transaction with staged group offsets;
+    every ``abort_every``-th transaction aborts. The end-of-run
+    read_committed count over EOS_DST must equal exactly the committed
+    records — the closed-loop exactly-once check."""
+    from redpanda_tpu.kafka.client.producer import TransactionalProducer
+
+    p = TransactionalProducer(client, f"loadgen-eos-{i}")
+    await p.init()
+    src_part = i % partitions
+    pos = 0
+    n_tx = 0
+    while not stop.is_set():
+        try:
+            batches, hwm = await client.fetch(
+                TOPIC, src_part, pos, max_wait_ms=100, max_bytes=64 * 1024
+            )
+        except Exception:
+            stats["eos_errors"] += 1
+            if await _sleep_or_stop(stop, 0.2):
+                break
+            continue
+        values = []
+        new_pos = pos
+        for b in batches:
+            for r in b.records():
+                off = b.header.base_offset + r.offset_delta
+                if off >= pos and r.value:
+                    values.append(b"eos:" + r.value[:64])
+                    new_pos = off + 1
+        if not values:
+            if await _sleep_or_stop(stop, 0.05):
+                break
+            continue
+        values = values[:64]
+        try:
+            p.begin()
+            await p.send(EOS_DST, i, values)
+            await p.send_offsets(
+                f"{EOS_SRC_GROUP}-{i}", {(TOPIC, src_part): new_pos}
+            )
+            if abort_every and n_tx % abort_every == abort_every - 1:
+                await p.abort()
+                stats["eos_aborted_tx"] += 1
+            else:
+                await p.commit()
+                stats["eos_committed_tx"] += 1
+                stats["eos_committed_records"] += len(values)
+                pos = new_pos
+            n_tx += 1
+        except Exception:
+            stats["eos_errors"] += 1
+            try:
+                await p.abort()
+            except Exception:
+                # a dead transaction epoch needs a fresh producer session
+                try:
+                    await p.init()
+                except Exception:
+                    pass
+            if await _sleep_or_stop(stop, 0.2):
+                break
+
+
+async def _transform_reader(i, client, mat_topic, partitions, stop, stats):
+    """Closes the produce → coproc → fetch loop: tails the materialized
+    topic the deployed transform writes."""
+    positions = {p: 0 for p in range(partitions)}
+    part = i
+    while not stop.is_set():
+        part = (part + 1) % partitions
+        try:
+            batches, _ = await client.fetch(
+                mat_topic, part, positions[part], max_wait_ms=20
+            )
+            n = sum(len(b.records()) for b in batches)
+            if batches:
+                positions[part] = batches[-1].last_offset + 1
+            stats["transform_records_read"] += n
+        except Exception:
+            stats["transform_read_errors"] += 1
+            if await _sleep_or_stop(stop, 0.25):
+                break
+        if await _sleep_or_stop(stop, 0.05):
+            break
+
+
+async def _tiered_reader(i, client, hi_offset, stop, stats):
+    """Re-reads the archived-and-locally-evicted prefix: every fetch below
+    the local log start falls through to the cloud read path."""
+    off = 0
+    while not stop.is_set():
+        try:
+            batches, _ = await client.fetch(
+                TIERED_TOPIC, 0, off, max_wait_ms=10, max_bytes=32 * 1024
+            )
+            stats["tiered_reads"] += 1
+            stats["tiered_records_read"] += sum(
+                len(b.records()) for b in batches
+            )
+            off = batches[-1].last_offset + 1 if batches else 0
+            if off >= hi_offset:
+                off = 0
+        except Exception:
+            stats["tiered_read_errors"] += 1
+            if await _sleep_or_stop(stop, 0.25):
+                break
+        if await _sleep_or_stop(stop, 0.05):
+            break
+
+
+# ================================================================ setup
+async def _deploy_transform(stack: Stack, client) -> str:
+    """Deploy the JSON-filter transform through the real wasm-event path
+    (what `rpk wasm deploy` produces) and wait until every node's engine
+    activated it."""
+    from redpanda_tpu.coproc import wasm_event
+    from redpanda_tpu.models.fundamental import COPROC_INTERNAL_TOPIC
+    from redpanda_tpu.ops.transforms import filter_field_eq
+
+    spec = filter_field_eq("level", "error")
+    rec = wasm_event.make_deploy_record(
+        SCRIPT_NAME, spec.to_json(), [TOPIC]
+    )
+    batch = wasm_event.deploy_batch([rec])
+    deadline = time.monotonic() + 30.0
+    while True:
+        try:
+            await client.produce_batches(COPROC_INTERNAL_TOPIC, 0, [batch])
+            break
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            await asyncio.sleep(0.5)
+    def _active() -> bool:
+        return all(
+            a.coproc is not None and SCRIPT_NAME in a.coproc.active_scripts()
+            for a in stack.apps
+        )
+    while not _active():
+        if time.monotonic() > deadline:
+            raise TimeoutError("transform never activated on every node")
+        await asyncio.sleep(0.1)
+    return f"{TOPIC}.${SCRIPT_NAME}$"
+
+
+async def _setup_tiered(stack: Stack, client) -> int:
+    """Build a topic whose prefix lives ONLY in the bucket: produce across
+    several small segments, archive the closed ones, then DeleteRecords
+    the local prefix. Returns the high watermark readers cycle over."""
+    from redpanda_tpu.kafka.protocol import messages as m
+
+    await client.create_topic(
+        TIERED_TOPIC, partitions=1, replication=1,
+        configs={"segment.bytes": "8192"},
+    )
+    for seq in range(24):
+        await client.produce(
+            TIERED_TOPIC, 0,
+            [_payload(999, seq, j, 512) for j in range(4)],
+            acks=-1,
+        )
+    # archive the closed segments now (deterministic, no interval wait)
+    uploaded = 0
+    for a in stack.apps:
+        arch = getattr(a, "archival", None)
+        if arch is not None:
+            uploaded += await arch.run_once()
+    if uploaded == 0:
+        raise RuntimeError("tiered setup: nothing archived")
+    hwm = await client.latest_offset(TIERED_TOPIC, 0)
+    evict_to = hwm // 2
+    conn = await client.leader_connection(TIERED_TOPIC, 0)
+    resp = await conn.request(m.DELETE_RECORDS, {
+        "topics": [{
+            "name": TIERED_TOPIC,
+            "partitions": [{"partition_index": 0, "offset": evict_to}],
+        }],
+        "timeout_ms": 30_000,
+    })
+    pr = resp["topics"][0]["partitions"][0]
+    if pr["error_code"] != 0:
+        raise RuntimeError(f"tiered setup: delete_records error {pr}")
+    if pr["low_watermark"] > 0:
+        raise RuntimeError(
+            "tiered setup: local eviction lost the archived prefix "
+            f"(low_watermark {pr['low_watermark']})"
+        )
+    return hwm
+
+
+async def _arm_chaos(stack: Stack, chaos: dict) -> dict:
+    """Arm the scenario's failure probe through the real admin API (and
+    size the injected delay), exactly like an operator with rpk."""
+    import aiohttp
+
+    from redpanda_tpu.finjector import honey_badger
+
+    honey_badger.delay_ms = int(chaos.get("delay_ms", 50))
+    url = (
+        f"http://127.0.0.1:{stack.admin_ports[0]}/v1/failure-probes/"
+        f"{chaos['module']}/{chaos['probe']}/{chaos['effect']}"
+    )
+    async with aiohttp.ClientSession() as s:
+        async with s.put(url) as resp:
+            body = await resp.json()
+            if resp.status != 200:
+                raise RuntimeError(f"chaos arm failed: {resp.status} {body}")
+    return {**chaos, "armed": body.get("armed")}
+
+
+async def _resolve_exemplars(stack: Stack, report: dict) -> None:
+    """Every breach exemplar must resolve to a /v1/trace/slow entry; the
+    report says how many did, so a broken link is visible on its face."""
+    import aiohttp
+
+    trace_ids = {
+        ex["trace_id"]
+        for o in report["objectives"]
+        for ex in o.get("exemplars") or []
+    }
+    report["exemplars_total"] = len(trace_ids)
+    if not trace_ids:
+        report["exemplars_resolved"] = 0
+        return
+    url = f"http://127.0.0.1:{stack.admin_ports[0]}/v1/trace/slow?limit=500"
+    async with aiohttp.ClientSession() as s:
+        async with s.get(url) as resp:
+            doc = await resp.json()
+    slow_ids = {sp["trace_id"] for sp in doc.get("spans", [])}
+    report["exemplars_resolved"] = len(trace_ids & slow_ids)
+
+
+async def _verify_eos(client, eos_pairs: int, stats: dict) -> dict:
+    """read_committed count over EOS_DST must equal the committed records
+    exactly: nothing aborted leaked, nothing committed lost."""
+    visible = 0
+    for p in range(eos_pairs):
+        off = 0
+        while True:
+            batches, hwm = await client.fetch(
+                EOS_DST, p, off, max_wait_ms=10, isolation_level=1
+            )
+            if not batches:
+                if off >= hwm:
+                    break
+                off = hwm  # aborted-range hole: skip to the watermark
+                continue
+            visible += sum(len(b.records()) for b in batches)
+            off = batches[-1].last_offset + 1
+    return {
+        "committed_records": stats["eos_committed_records"],
+        "visible_read_committed": visible,
+        "exact": visible == stats["eos_committed_records"],
+    }
+
+
+# ================================================================ scenario run
+def _spec_for(scenario_name: str, s: dict):
+    from redpanda_tpu.observability.slo import SloSpec
+
+    return SloSpec.from_dict(
+        {"name": scenario_name, "objectives": s["objectives"]}
+    )
+
+
+async def run_scenario_async(
+    name: str,
+    *,
+    chaos: bool = False,
+    duration_s: float | None = None,
+    clients_scale: float = 1.0,
+    overrides: dict | None = None,
+    base_dir: str | None = None,
+) -> dict:
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.observability.slo import slo
+
+    s = copy.deepcopy(SCENARIOS[name])
+    s.update(overrides or {})
+    if duration_s is not None:
+        s["duration_s"] = float(duration_s)
+    for key in ("producers", "group_members", "eos_pairs",
+                "transform_readers", "tiered_readers"):
+        s[key] = max(0 if s[key] == 0 else 1, int(s[key] * clients_scale))
+
+    tmp = None
+    if base_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="loadgen-")
+        base_dir = tmp.name
+
+    from redpanda_tpu.finjector import honey_badger
+    from redpanda_tpu.observability import tracer
+
+    # A scenario reconfigures process-wide singletons (the injected delay,
+    # the active SLO spec, the tracer slow threshold); in-process callers
+    # (the pytest suite) must get every one of them back afterwards —
+    # disable() clears probes but deliberately not the delay knob, and
+    # nothing else restores itself
+    saved_delay_ms = honey_badger.delay_ms
+    saved_spec = slo.spec
+    saved_slow_us = tracer.slow_threshold_us
+    saved_trace_enabled = tracer.enabled
+    spec = None
+
+    imposter = None
+    if s["tiered_readers"]:
+        from s3_imposter import S3Imposter
+
+        imposter = await S3Imposter().start()
+
+    stack = Stack(s, base_dir, imposter=imposter)
+    stats: dict[str, int] = {
+        k: 0 for k in (
+            "produce_ops", "produced_records", "produce_errors",
+            "consumed_records", "consume_errors", "group_joins",
+            "rebalances_forced", "rebalances_seen", "rebalance_errors",
+            "eos_committed_tx", "eos_aborted_tx", "eos_committed_records",
+            "eos_errors", "transform_records_read", "transform_read_errors",
+            "tiered_reads", "tiered_records_read", "tiered_read_errors",
+        )
+    }
+    clients: list = []
+    t_setup0 = time.monotonic()
+    try:
+        await stack.start()
+        n_clients = max(
+            2, min(8, s["producers"] + s["group_members"] + s["eos_pairs"])
+        )
+        clients = await asyncio.gather(*(
+            KafkaClient(stack.bootstrap()).connect() for _ in range(n_clients)
+        ))
+
+        def client_for(i: int):
+            return clients[i % len(clients)]
+
+        admin = clients[0]
+        await admin.create_topic(
+            TOPIC, partitions=s["partitions"], replication=s["replication"]
+        )
+        await admin.create_topic(
+            EOS_DST, partitions=max(1, s["eos_pairs"]),
+            replication=s["replication"],
+        )
+        mat_topic = None
+        if s.get("coproc"):
+            mat_topic = await _deploy_transform(stack, admin)
+        tiered_hwm = 0
+        if s["tiered_readers"]:
+            tiered_hwm = await _setup_tiered(stack, admin)
+
+        # ---- warmup: touch every path once so the measured window holds
+        # steady-state latencies, not first-op compiles and cache fills
+        for p in range(s["partitions"]):
+            await admin.produce(
+                TOPIC, p, [_payload(0, 0, j, s["record_bytes"])
+                            for j in range(2)], acks=-1
+            )
+        if mat_topic is not None:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                try:
+                    hv = await admin.latest_offset(mat_topic, 0)
+                    if hv > 0:
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.2)
+
+        chaos_info = None
+        if chaos:
+            if not s.get("chaos"):
+                raise ValueError(f"scenario {name} defines no chaos probe")
+            chaos_info = await _arm_chaos(stack, s["chaos"])
+
+        # ---- the measured window
+        spec = _spec_for(name, s)
+        slo.configure(spec)          # arms per-metric exemplar thresholds
+        baseline = slo.snapshot()
+        stop = asyncio.Event()
+        tasks = []
+        for i in range(s["producers"]):
+            tasks.append(asyncio.create_task(_producer(
+                i, client_for(i), s["partitions"], s["produce_rate"],
+                s["records_per_op"], s["record_bytes"], stop, stats,
+            )))
+        group_topics = [TOPIC]
+        for i in range(s["group_members"]):
+            tasks.append(asyncio.create_task(_group_member(
+                i, client_for(100 + i), group_topics, stop, stats
+            )))
+        if s["group_members"] and s["rebalance_every_s"] > 0:
+            tasks.append(asyncio.create_task(_rebalancer(
+                client_for(200), group_topics, s["rebalance_every_s"],
+                stop, stats,
+            )))
+        for i in range(s["eos_pairs"]):
+            tasks.append(asyncio.create_task(_eos_pair(
+                i, client_for(300 + i), s["partitions"],
+                s["eos_abort_every"], stop, stats,
+            )))
+        if mat_topic is not None:
+            for i in range(s["transform_readers"]):
+                tasks.append(asyncio.create_task(_transform_reader(
+                    i, client_for(400 + i), mat_topic, s["partitions"],
+                    stop, stats,
+                )))
+        for i in range(s["tiered_readers"]):
+            tasks.append(asyncio.create_task(_tiered_reader(
+                i, client_for(500 + i), tiered_hwm, stop, stats
+            )))
+
+        t0 = time.monotonic()
+        await asyncio.sleep(s["duration_s"])
+        stop.set()
+        if tasks:
+            done, pending = await asyncio.wait(tasks, timeout=20.0)
+            for t in pending:
+                t.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+            for t in done:
+                t.exception()  # consume; stats carry the error counts
+        elapsed = time.monotonic() - t0
+
+        if chaos_info is not None:
+            # disarm before the closed-loop verification reads
+            honey_badger.disable()
+
+        eos_check = (
+            await _verify_eos(admin, s["eos_pairs"], stats)
+            if s["eos_pairs"] else None
+        )
+
+        report = slo.evaluate(spec, baseline=baseline)
+        await _resolve_exemplars(stack, report)
+        report.update({
+            "chaos": chaos_info,
+            "duration_s": round(elapsed, 3),
+            "setup_s": round(t0 - t_setup0, 3),
+            "nodes": s["nodes"],
+            "partitions": s["partitions"],
+            "replication": s["replication"],
+            "clients": {
+                "producers": s["producers"],
+                "group_members": s["group_members"],
+                "eos_pairs": s["eos_pairs"],
+                "transform_readers": s["transform_readers"],
+                "tiered_readers": s["tiered_readers"],
+            },
+            "throughput": {
+                **stats,
+                "produce_ops_per_s": round(stats["produce_ops"] / elapsed, 1),
+                "produced_records_per_s": round(
+                    stats["produced_records"] / elapsed, 1
+                ),
+            },
+            "eos_check": eos_check,
+            # the lossless-workload bar: EOS stays exactly-once always;
+            # client-visible produce ERRORS (unacked, retriable) are
+            # expected bounded degradation under chaos, but a CLEAN run
+            # must not see any
+            "workloads_ok": (
+                (eos_check is None or eos_check["exact"])
+                and (chaos_info is not None or stats["produce_errors"] == 0)
+            ),
+        })
+        return report
+    finally:
+        for c in clients:
+            try:
+                await c.close()
+            except Exception:
+                pass
+        honey_badger.disable()
+        honey_badger.delay_ms = saved_delay_ms
+        # disarm the scenario's per-histogram exemplar thresholds before
+        # restoring the spec: configure(arm_exemplars=False) restores the
+        # OBJECT but would leave e.g. a 2000ms produce threshold silently
+        # recording exemplars for the rest of the process (a later
+        # in-process /v1/slo re-arms its own spec lazily)
+        if spec is not None:
+            from redpanda_tpu.observability import probes as _probes
+
+            hists = slo.registry.histograms()
+            for o in spec.objectives:
+                h = hists.get(o.series)
+                if h is not None:
+                    _probes.disarm_exemplar_threshold(h)
+        slo.configure(saved_spec, arm_exemplars=False)
+        tracer.configure(
+            enabled=saved_trace_enabled,
+            slow_threshold_ms=saved_slow_us / 1000.0,
+        )
+        await stack.stop()
+        if imposter is not None:
+            await imposter.stop()
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def run_scenario(name: str, **kw) -> dict:
+    return asyncio.run(run_scenario_async(name, **kw))
+
+
+# ================================================================ cli
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--scenario", default="smoke", help="see --list")
+    p.add_argument("--report", default=None, metavar="SLO_r0N.json",
+                   help="report path (default SLO_<scenario>.json)")
+    p.add_argument("--chaos", action="store_true",
+                   help="arm the scenario's honey-badger probe for the "
+                        "measured window")
+    p.add_argument("--duration", type=float, default=None,
+                   help="override the scenario's measured window (s)")
+    p.add_argument("--clients-scale", type=float, default=1.0,
+                   help="multiply every client count (8 ≈ thousands of "
+                        "clients on real hardware)")
+    p.add_argument("--list", action="store_true", help="list scenarios")
+    args = p.parse_args(argv)
+    if args.list:
+        for name, s in SCENARIOS.items():
+            print(f"{name:<16} nodes={s['nodes']} partitions={s['partitions']} "
+                  f"duration={s['duration_s']}s producers={s['producers']} "
+                  f"chaos={s['chaos']['module']}.{s['chaos']['probe']}")
+        return 0
+    if args.scenario not in SCENARIOS:
+        p.error(f"unknown scenario {args.scenario!r}; --list shows them")
+    report = run_scenario(
+        args.scenario, chaos=args.chaos, duration_s=args.duration,
+        clients_scale=args.clients_scale,
+    )
+    out = args.report or f"SLO_{args.scenario}.json"
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    verdict = "PASS" if report["pass"] else "FAIL"
+    print(json.dumps({
+        "scenario": report["scenario"],
+        "verdict": verdict,
+        "failed_objectives": report["failed"],
+        "chaos": bool(report.get("chaos")),
+        "exemplars": f"{report.get('exemplars_resolved', 0)}"
+                     f"/{report.get('exemplars_total', 0)} resolved",
+        "produced_records_per_s":
+            report["throughput"]["produced_records_per_s"],
+        "workloads_ok": report["workloads_ok"],
+        "report": out,
+    }))
+    # a chaos run is EXPECTED to breach; its exit code reflects only that
+    # the harness itself worked and the workloads stayed lossless
+    if args.chaos:
+        return 0 if report["workloads_ok"] else 1
+    return 0 if (report["pass"] and report["workloads_ok"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -416,6 +416,91 @@ def _bench_tracer_overhead_disabled(secs: float) -> dict:
     }
 
 
+def bench_slo_eval_overhead(secs: float) -> dict:
+    """Cost of the always-on SLO layer on a produce-shaped op.
+
+    What a produce pays for the SLO harness is ONE exemplar-aware
+    histogram record (probes.record_us: the raw bucket record plus a
+    threshold lookup + compare — the breach slow path never runs in
+    steady state). Same derived min-of-blocks discipline as the tracer
+    and breaker benches: wall-clock A/B cannot resolve sub-1% on a
+    shared box, but the hook is strictly additive straight-line code, so
+    (per-call hook delta) / (per-op cost) IS its share of the hot path.
+    ``slo_evaluate_ms`` — one full spec evaluation, the operator-triggered
+    GET /v1/slo cost — is reported informationally; it is never on a
+    request path."""
+    from redpanda_tpu.metrics import Histogram
+    from redpanda_tpu.models.record import Record, RecordBatch
+    from redpanda_tpu.observability import probes, tracer
+    from redpanda_tpu.observability.slo import DEFAULT_SPEC, SloEngine
+
+    was_enabled = tracer.enabled
+    tracer.configure(enabled=False)
+    recs = [Record(offset_delta=i, value=b"x" * 256) for i in range(32)]
+
+    def op():
+        RecordBatch.build(recs, base_offset=0).encode_internal()
+
+    # scratch histograms, NOT registered series: thousands of synthetic
+    # samples must never leak into the live registry
+    raw = Histogram("bench_slo_raw_us", "unregistered bench scratch")
+    hooked = Histogram("bench_slo_hooked_us", "unregistered bench scratch")
+    # armed with a threshold the samples never cross: the steady-state
+    # shape (the breach path is per-incident, not per-op)
+    probes.arm_exemplar_threshold(hooked, 1e12)
+    try:
+
+        def timed_block(fn, k: int) -> float:
+            t0 = time.perf_counter()
+            for _ in range(k):
+                fn()
+            return time.perf_counter() - t0
+
+        op()
+        per_op = min(timed_block(op, 4) / 4 for _ in range(3))
+        k = max(4, int(0.003 / per_op))
+        rounds = max(16, int(secs / (k * per_op)))
+        best_op = min(timed_block(op, k) / k for _ in range(rounds))
+
+        record_ns = float("inf")
+        hooked_ns = float("inf")
+        n_raw = 2000
+        for _ in range(10):
+            t0 = time.perf_counter()
+            for _ in range(n_raw):
+                raw.record(500)
+            record_ns = min(record_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+            t0 = time.perf_counter()
+            for _ in range(n_raw):
+                probes.record_us(hooked, 500)
+            hooked_ns = min(hooked_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+        hook_ns = max(0.0, hooked_ns - record_ns)
+        pct = hook_ns / (best_op * 1e9) * 100.0 if best_op else 0.0
+
+        # informational: one operator-triggered evaluation of the default
+        # spec over the live registry
+        # arm=False: a read-only judgment — the bench must not overwrite
+        # exemplar thresholds an in-process caller armed on the LIVE
+        # registry with DEFAULT_SPEC's lenient ones
+        eng = SloEngine()
+        eng.evaluate(DEFAULT_SPEC, arm=False)  # warm lazy imports
+        t0 = time.perf_counter()
+        eng.evaluate(DEFAULT_SPEC, arm=False)
+        eval_ms = (time.perf_counter() - t0) * 1e3
+        return {
+            "slo_record_raw_ns": round(record_ns, 1),
+            "slo_record_hooked_ns": round(hooked_ns, 1),
+            "slo_hook_cost_ns": round(hook_ns, 1),
+            "slo_op_cost_ns": round(best_op * 1e9, 1),
+            "slo_evaluate_ms": round(eval_ms, 3),
+            "slo_eval_overhead_pct": round(pct, 3),
+        }
+    finally:
+        # surgical: an in-process caller's armed objectives must survive
+        probes.disarm_exemplar_threshold(hooked)
+        tracer.configure(enabled=was_enabled)
+
+
 def bench_breaker_overhead(secs: float) -> dict:
     """Cost of the fault machinery on the UNFAULTED coproc launch path.
 
@@ -595,6 +680,7 @@ BENCHES = {
     "rpc_echo": bench_rpc_echo,
     "tracer_overhead": bench_tracer_overhead,
     "breaker_overhead": bench_breaker_overhead,
+    "slo_eval_overhead": bench_slo_eval_overhead,
 }
 
 
@@ -634,6 +720,14 @@ def main(argv=None) -> int:
         "breaker_overhead bench",
     )
     p.add_argument(
+        "--assert-slo-overhead",
+        type=float,
+        metavar="PCT",
+        help="fail (exit 1) if the always-on SLO/exemplar hook's share of "
+        "a produce-shaped op exceeds PCT percent; implies the "
+        "slo_eval_overhead bench",
+    )
+    p.add_argument(
         "--assert-harvest-speedup",
         type=float,
         metavar="RATIO",
@@ -658,6 +752,8 @@ def main(argv=None) -> int:
         names.append("breaker_overhead")
     if args.assert_harvest_speedup is not None and "harvest_path" not in names:
         names.append("harvest_path")
+    if args.assert_slo_overhead is not None and "slo_eval_overhead" not in names:
+        names.append("slo_eval_overhead")
     snap_before = None
     if args.metrics_snapshot:
         from redpanda_tpu.metrics import registry
@@ -699,6 +795,15 @@ def main(argv=None) -> int:
             print(
                 f"breaker overhead {pct}% exceeds budget "
                 f"{args.assert_breaker_overhead}%",
+                file=sys.stderr,
+            )
+            return 1
+    if args.assert_slo_overhead is not None:
+        pct = out.get("slo_eval_overhead_pct", 0.0)
+        if pct > args.assert_slo_overhead:
+            print(
+                f"slo hook overhead {pct}% exceeds budget "
+                f"{args.assert_slo_overhead}%",
                 file=sys.stderr,
             )
             return 1

@@ -15,6 +15,7 @@ from tools.pandalint.checkers.enginesync import EngineSyncChecker
 from tools.pandalint.checkers.crossshard import CrossShardChecker
 from tools.pandalint.checkers.locks import LockRpcChecker
 from tools.pandalint.checkers.sleeps import SleepAsyncChecker
+from tools.pandalint.checkers.excepts import BareExceptChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     ReactorChecker,
@@ -27,6 +28,7 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     CrossShardChecker,
     LockRpcChecker,
     SleepAsyncChecker,
+    BareExceptChecker,
 )
 
 

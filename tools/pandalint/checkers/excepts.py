@@ -1,0 +1,134 @@
+"""Fault classification discipline: no silent `except Exception` in coproc.
+
+PR 4 routed every formerly-silent swallow in the engine through
+``faults.note_failure`` so each degradation path shows up as a
+``coproc_failures_total{domain,kind}`` series — an invisible fallback is
+how a broker runs demoted for a week before anyone notices. This checker
+makes that a ratchet: a broad catch added to ``redpanda_tpu/coproc`` must
+either classify what it swallowed or say (with a reasoned pragma) why it
+is allowed to stay silent.
+
+Heuristic scope (no type inference), confined to ``redpanda_tpu/coproc``:
+
+- EXC901: an ``except Exception`` / ``except BaseException`` handler whose
+  body neither calls ``note_failure`` (any dotted spelling) nor re-raises.
+  A handler that re-raises (bare ``raise`` or ``raise exc`` anywhere in
+  its body, including conditionally) propagates rather than swallows and
+  is exempt.
+- EXC902: a bare ``except:`` — strictly worse (it also eats
+  CancelledError/SystemExit), flagged regardless of body.
+
+Sanctioned shapes that never flag:
+
+- **Import probes**: a ``try`` whose body contains an ``import`` —
+  "is the native build / optional dep present" is a configuration
+  decision made once, not a runtime fault (engine hot paths that *do*
+  want the demotion visible classify it anyway, e.g. ``_pack_values``'s
+  ``note_failure("native_lib", ...)``).
+- **faults.py itself**: the classifier's own retry envelope re-raises at
+  exhaustion; it is the one module allowed to reason about raw failures.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.checkers.base import (
+    Checker,
+    FileContext,
+    RawFinding,
+    dotted,
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _catches_broad(handler: ast.ExceptHandler) -> bool:
+    """True when the handler type includes Exception/BaseException (bare
+    handlers are EXC902's finding, not this predicate's)."""
+    t = handler.type
+    if t is None:
+        return False
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        name = dotted(node)
+        if name in _BROAD or name.split(".")[-1] in _BROAD:
+            return True
+    return False
+
+
+def _body_walk(handler: ast.ExceptHandler) -> Iterator[ast.AST]:
+    """Walk the handler body WITHOUT descending into nested function defs
+    (a classification inside a nested callback only runs if something
+    calls it — it does not classify THIS swallow)."""
+    stack: list[ast.AST] = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _classifies_or_reraises(handler: ast.ExceptHandler) -> bool:
+    for node in _body_walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name == "note_failure" or name.endswith(".note_failure"):
+                return True
+    return False
+
+
+def _try_imports(try_node: ast.Try) -> bool:
+    return any(
+        isinstance(stmt, (ast.Import, ast.ImportFrom)) for stmt in try_node.body
+    )
+
+
+class BareExceptChecker(Checker):
+    name = "bare-except"
+    rules = {
+        "EXC901": "except Exception in coproc without a faults.note_failure "
+                  "classification (or re-raise) in the handler body",
+        "EXC902": "bare except: swallows CancelledError/SystemExit too",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        rel = ctx.relpath.replace("\\", "/")
+        if rel.endswith("/faults.py"):
+            # the classification module itself: its retry envelope holds
+            # raw failures by design and re-delivers them at exhaustion
+            return
+        for try_node in ast.walk(ctx.tree):
+            if not isinstance(try_node, ast.Try):
+                continue
+            imports = _try_imports(try_node)
+            for handler in try_node.handlers:
+                if handler.type is None:
+                    yield RawFinding(
+                        "EXC902",
+                        handler.lineno,
+                        handler.col_offset,
+                        "bare except: catches CancelledError and SystemExit "
+                        "too; catch Exception and classify via "
+                        "faults.note_failure",
+                    )
+                    continue
+                if not _catches_broad(handler):
+                    continue
+                if imports:
+                    continue  # import probe: a configuration, not a fault
+                if _classifies_or_reraises(handler):
+                    continue
+                yield RawFinding(
+                    "EXC901",
+                    handler.lineno,
+                    handler.col_offset,
+                    "except Exception swallowed without classification: "
+                    "call faults.note_failure(domain, exc) so the "
+                    "degradation lands in coproc_failures_total, or "
+                    "re-raise",
+                )
