@@ -203,6 +203,11 @@ def _run_engine_mode(
         # breaker was open (or launches fell back to host) is an artifact
         # of a degraded link, and must say so on its face
         "breaker": stats.get("breaker"),
+        # per-domain decision plane: breaker split + posture at run end
+        # (coproc/governor.py; the process-wide journal summary + tail ride
+        # at the top level of the BENCH json, collected after all runs)
+        "breakers": stats.get("breakers"),
+        "governor_posture": (stats.get("governor") or {}).get("posture"),
         "fallback_rows": stats.get("n_fallback_rows", 0.0),
         "device_retries": stats.get("n_retries", 0.0),
     }
@@ -475,6 +480,18 @@ def main():
         extras["device_lz4_probe"] = measure_probe(
             n_records=32, record_size=256, reps=1
         )
+        # decision-plane record for the whole bench process: every adaptive
+        # decision any of the runs made (calibrations, backend probes,
+        # breaker transitions, harvest/seal modes, lz4 keep-or-kill,
+        # deadline moves) is reconstructible from this block alone — the
+        # same view /v1/governor serves on a live broker
+        from redpanda_tpu.coproc import governor as gov_mod
+
+        extras["governor"] = {
+            "posture": probe["governor_posture"],
+            "journal": gov_mod.journal.summary(),
+            "journal_tail": gov_mod.journal.entries(limit=16),
+        }
     except Exception as exc:  # secondary metrics must never sink the bench
         extras["configs_error"] = repr(exc)
 

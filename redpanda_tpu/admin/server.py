@@ -13,6 +13,9 @@ Parity with redpanda/admin_server.cc:
   N injections, DELETE disarms — rpk debug failpoints)
 - GET  /v1/coproc/status               (engine breaker + fault-domain stats;
   rpk debug coproc)
+- GET  /v1/governor[?limit=N&domain=D] (coproc decision journal + per-domain
+  posture/breakers/deadlines; rpk debug governor — no reference analogue,
+  the reference's autotune decisions are log-only)
 - GET  /v1/slo[?mark=N], POST /v1/slo/mark[?name=N]  (SLO verdicts over the
   pandaprobe histograms + named baseline marks; rpk debug slo — no
   reference analogue, the ducktape suite judges latency externally)
@@ -132,6 +135,7 @@ class AdminServer:
             web.put("/v1/failure-probes/{module}/{probe}/{type}", self._set_probe),
             web.delete("/v1/failure-probes/{module}/{probe}", self._unset_probe),
             web.get("/v1/coproc/status", self._coproc_status),
+            web.get("/v1/governor", self._governor),
             web.get("/v1/slo", self._slo),
             web.post("/v1/slo/mark", self._slo_mark),
             web.get("/metrics", self._metrics),
@@ -524,6 +528,46 @@ class AdminServer:
             "breaker": stats.pop("breaker", None),
             "stats": stats,
         })
+
+    async def _governor(self, req: web.Request) -> web.Response:
+        """The coproc decision plane (coproc/governor.py): every adaptive
+        decision this process made — host-pool calibration, columnar
+        backend, device_lz4, breaker transitions, harvest path, seal
+        engagement, adaptive deadlines — as a journal (newest-first, with
+        measured inputs + verdict + reason + active-config snapshot) plus
+        the live per-domain posture. ``?limit=N`` caps the journal slice,
+        ``?domain=NAME`` filters it. `rpk debug governor` renders this."""
+        from redpanda_tpu.coproc import governor as gov_mod
+
+        try:
+            limit = max(1, int(req.query.get("limit", "64")))
+        except ValueError:
+            return web.json_response(
+                {"error": "limit must be an int"}, status=400
+            )
+        domain = req.query.get("domain")
+        if domain is not None and domain not in gov_mod.DOMAINS:
+            return web.json_response(
+                {"error": f"unknown domain {domain!r}",
+                 "domains": list(gov_mod.DOMAINS)},
+                status=404,
+            )
+        body = {
+            "domains": list(gov_mod.DOMAINS),
+            "journal": gov_mod.journal.entries(limit=limit, domain=domain),
+            "summary": gov_mod.journal.summary(),
+        }
+        api = getattr(self.broker, "coproc_api", None)
+        if api is None:
+            # the journal is process-wide (probes may have run without a
+            # live engine), but there is no posture without one
+            body["enabled"] = False
+        else:
+            g = api.engine.governor
+            body["enabled"] = True
+            body["posture"] = g.posture()
+            body["breaker"] = g.aggregate_breaker_snapshot()
+        return web.json_response(body)
 
     # ------------------------------------------------------------ slo
     async def _slo(self, req: web.Request) -> web.Response:

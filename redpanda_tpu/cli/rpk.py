@@ -318,8 +318,9 @@ async def cmd_config(args) -> int:
 async def cmd_debug(args) -> int:
     """debug diagnostics: bundle (tar.gz of admin state), trace (render
     the broker's recent pandaprobe spans), coproc (engine breaker +
-    fault-domain stats), slo (objective verdicts + breach exemplars),
-    failpoints (honey-badger arm/disarm)."""
+    fault-domain stats), governor (decision journal + per-domain posture),
+    slo (objective verdicts + breach exemplars), failpoints (honey-badger
+    arm/disarm)."""
     import io
     import tarfile
     import time
@@ -391,10 +392,64 @@ async def cmd_debug(args) -> int:
             print(f"  {k:<28}{v}")
         for k in (
             "columnar_backend", "host_pool_probe", "host_pool_probe_prev",
-            "host_pool_recal", "columnar_probe", "arena",
+            "host_pool_recal", "columnar_probe", "arena", "breakers",
         ):
             if stats.get(k) is not None:
                 print(f"  {k:<28}{stats[k]}")
+        return 0
+
+    if args.debug_cmd == "governor":
+        query = {"limit": str(args.limit)}
+        if args.domain:
+            query["domain"] = args.domain
+        status, body = await _admin_request(
+            args, "GET", "/v1/governor", query=query
+        )
+        if status != 200:
+            print(f"admin api returned {status}: {body}")
+            return 1
+        if args.json:
+            print(json.dumps(body, indent=2, sort_keys=True))
+            return 0
+        posture = body.get("posture")
+        if posture:
+            print("posture:")
+            for dom in (
+                "host_pool", "columnar_backend", "device_lz4",
+                "harvest_path", "sharded_seal",
+            ):
+                print(f"  {dom:<20}{posture.get(dom) or '(undecided)'}")
+            for dom, b in sorted((posture.get("breakers") or {}).items()):
+                print(
+                    f"  breaker[{dom}]".ljust(22)
+                    + f"{b.get('state', '?')} trips={b.get('trips', 0)} "
+                    f"consecutive={b.get('consecutive_failures', 0)}"
+                    f"/{b.get('threshold', '?')}"
+                )
+            for dom, ms in sorted(
+                (posture.get("deadlines_ms") or {}).items()
+            ):
+                print(f"  deadline[{dom}]".ljust(22) + f"{ms}ms")
+        else:
+            print("no live coproc engine (journal below is process-wide)")
+        summary = body.get("summary") or {}
+        print(
+            f"journal: {summary.get('entries', 0)} entries "
+            f"(seq {summary.get('seq', 0)}, "
+            f"{summary.get('dropped', 0)} dropped, "
+            f"capacity {summary.get('capacity', 0)})"
+        )
+        entries = body.get("journal") or []
+        if entries:
+            print(f"{'SEQ':>5}  {'DOMAIN':<18}{'VERDICT':<12}REASON")
+        for e in entries:
+            print(
+                f"{e['seq']:>5}  {e['domain']:<18}{e['verdict']:<12}"
+                f"{e['reason']}"
+            )
+            inputs = e.get("inputs") or {}
+            if inputs:
+                print(f"{'':>7}inputs: {json.dumps(inputs, sort_keys=True)}")
         return 0
 
     if args.debug_cmd == "slo":
@@ -495,6 +550,7 @@ async def cmd_debug(args) -> int:
         ("metrics.txt", "/metrics"),
         ("traces.json", "/v1/trace/recent"),
         ("coproc.json", "/v1/coproc/status"),
+        ("governor.json", "/v1/governor"),
         ("slo.json", "/v1/slo"),
         ("failpoints.json", "/v1/failure-probes"),
     ]:
@@ -695,6 +751,18 @@ def build_parser() -> argparse.ArgumentParser:
         "coproc", help="engine breaker + fault-domain + stage stats"
     )
     dc.add_argument("--json", action="store_true", help="raw JSON, no rendering")
+    dgov = dsub.add_parser(
+        "governor",
+        help="coproc decision journal + per-domain posture (admin api)",
+    )
+    dgov.add_argument("--json", action="store_true", help="raw JSON, no rendering")
+    dgov.add_argument(
+        "--limit", type=int, default=32, help="journal entries to fetch"
+    )
+    dgov.add_argument(
+        "--domain", default=None,
+        help="filter the journal to one decision domain",
+    )
     dslo = dsub.add_parser(
         "slo", help="SLO verdicts over the pandaprobe histograms (admin api)"
     )

@@ -184,6 +184,22 @@ PROPERTIES: list[Property] = [
         "Open-breaker cooldown before one half-open probe launch may re-admit the device",
         30_000, int, _positive,
     ),
+    # --- coproc governor / decision plane (coproc/governor.py)
+    Property(
+        "coproc_adaptive_deadline",
+        "Derive per-domain device deadlines from the observed coproc_stage_latency_us p99.9 (coproc_device_deadline_ms stays the floor and is never undercut); false pins every domain to the static knob",
+        True, bool,
+    ),
+    Property(
+        "coproc_adaptive_deadline_margin",
+        "Multiplier over the observed stage p99.9 when deriving an adaptive deadline (clamped to [floor, 8x floor])",
+        4.0, float, _positive,
+    ),
+    Property(
+        "coproc_governor_journal_capacity",
+        "Bounded in-memory governor decision journal size (GET /v1/governor, rpk debug governor)",
+        256, int, _positive,
+    ),
     # --- tiered storage (cloud_storage_* group)
     Property("cloud_storage_enabled", "Enable tiered storage", False, bool),
     Property("cloud_storage_bucket", "S3 bucket", ""),

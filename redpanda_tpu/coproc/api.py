@@ -50,6 +50,13 @@ class CoprocApi:
             retry_backoff_ms=_knob("coproc_retry_backoff_ms", None),
             breaker_threshold=_knob("coproc_breaker_threshold", None),
             breaker_cooldown_ms=_knob("coproc_breaker_cooldown_ms", None),
+            adaptive_deadline=_knob("coproc_adaptive_deadline", None),
+            adaptive_deadline_margin=_knob(
+                "coproc_adaptive_deadline_margin", None
+            ),
+            governor_journal_capacity=_knob(
+                "coproc_governor_journal_capacity", None
+            ),
         )
         self.pacemaker = Pacemaker(
             broker, self.engine,

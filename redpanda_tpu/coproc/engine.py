@@ -58,7 +58,7 @@ from redpanda_tpu.ops.pipeline import IN_META, make_packed_pipeline, unpack_resu
 
 logger = logging.getLogger("rptpu.coproc.engine")
 from redpanda_tpu.ops.transforms import TransformSpec
-from redpanda_tpu.coproc import batch_codec, faults, host_pool
+from redpanda_tpu.coproc import batch_codec, faults, governor, host_pool
 from redpanda_tpu.coproc.column_plan import ColumnarPlan, HostPlan, PayloadPlan, plan_spec
 
 
@@ -263,6 +263,10 @@ class _Launch:
         if isinstance(dev, np.ndarray) or eng is None:
             # host-fallback result (already materialized) / bare test launch
             packed = np.asarray(dev)
+        elif not eng.governor.breaker_for(faults.HARVEST).allow_device():
+            # open harvest breaker: fetches are demoted straight to the
+            # exact host fallback without spending a retry envelope
+            packed = self._payload_host_fallback()
         else:
             def leg():
                 faults.inject(faults.HARVEST)
@@ -272,7 +276,7 @@ class _Launch:
             if packed is None:
                 packed = self._payload_host_fallback()
             else:
-                eng._breaker.record_success()
+                eng.governor.breaker_for(faults.HARVEST).record_success()
         self._stat("t_fetch", t0)
         self._packed_dev = None
         self._staged_np = None
@@ -317,9 +321,17 @@ class _Launch:
         eng = self.engine
         # wait out the harvester's WHOLE retry envelope, not one attempt's
         # deadline: timing out mid-envelope would start a duplicate
-        # concurrent fetch of the same array and double-count the failure
+        # concurrent fetch of the same array and double-count the failure.
+        # Sized off the governor's envelope BOUND (the max deadline ever
+        # issued, = the static envelope until an adaptive raise happens),
+        # and RE-READ before the second wait below: the harvester derives
+        # its own deadline concurrently, and it publishes any raise into
+        # the bound before fetching, so the re-reading waiter can never
+        # end up shorter than the fetch it is waiting on
         wait_s = (
-            eng._fault_policy.envelope_s() + 1.0 if eng is not None else 30.0
+            eng.governor.envelope_bound_s(faults.HARVEST) + 1.0
+            if eng is not None
+            else 30.0
         )
         if slot._mask_event is not None:
             # harvester thread pays the link round trip concurrently
@@ -350,7 +362,14 @@ class _Launch:
                         bits = self._fetch_mask_bits(slot)
                     else:
                         # the harvester is ACTIVELY harvesting this mask:
-                        # one more envelope bounds its verdict
+                        # one more envelope bounds its verdict. Re-read
+                        # the bound — the harvester published any adaptive
+                        # raise into it before starting its fetch
+                        if eng is not None:
+                            wait_s = (
+                                eng.governor.envelope_bound_s(faults.HARVEST)
+                                + 1.0
+                            )
                         finished = slot._mask_event.wait(timeout=wait_s)
                         bits = slot._mask_np
                         if bits is None:
@@ -379,6 +398,13 @@ class _Launch:
         dev = slot._mask_dev
         if eng is None:  # bare launch in tests: old synchronous behavior
             return np.asarray(dev)
+        fetch_breaker = eng.governor.breaker_for(faults.MASK_FETCH)
+        if not fetch_breaker.allow_device():
+            # open mask-fetch breaker: this domain is demoted — go straight
+            # to the exact numpy fallback over the retained columns instead
+            # of burning a full retry envelope on a known-dead D2H path.
+            # Dispatch keeps its own breaker; launches stay on-device.
+            return self._mask_host_fallback(slot)
 
         def leg():
             faults.inject(faults.MASK_FETCH)
@@ -388,7 +414,7 @@ class _Launch:
         if bits is None:
             bits = self._mask_host_fallback(slot)
         else:
-            eng._breaker.record_success()
+            fetch_breaker.record_success()
         return bits
 
     def _mask_host_fallback(self, slot) -> np.ndarray:
@@ -551,8 +577,26 @@ class _Launch:
         return self._gather_mat
 
     def _count_frame(self, key: str) -> None:
-        if self.engine is not None:
-            self.engine._stat_add(key, 1.0)
+        eng = self.engine
+        if eng is None:
+            return
+        eng._stat_add(key, 1.0)
+        # decision-plane bookkeeping: which framing path this launch took.
+        # record_mode journals only on CHANGE (first engagement or a mode
+        # flip); the steady-state cost is one lock + one compare per launch
+        mode = "gather" if key == "n_frame_gather" else "padded"
+        eng.governor.record_mode(
+            governor.HARVEST_PATH,
+            mode,
+            "byte-identity plan framed zero-copy from the joined blob"
+            if mode == "gather"
+            else "byte-mutating plan framed via the padded row matrix",
+            {"script_id": self.script_id, "mode": self.mode},
+            # dedupe per SCRIPT: the framing path is a property of the
+            # script's plan, and a mixed gather+padded workload must not
+            # flip-flop the journal on every alternating launch
+            key=self.script_id,
+        )
 
     def _shard_keep(self, shard: _HostShard) -> np.ndarray:
         """Resolve one shard's keep mask via the shared _resolve_keep."""
@@ -841,12 +885,16 @@ class TpuEngine:
         retry_backoff_ms: int | None = None,
         breaker_threshold: int | None = None,
         breaker_cooldown_ms: int | None = None,
+        adaptive_deadline: bool | None = None,
+        adaptive_deadline_margin: float | None = None,
+        governor_journal_capacity: int | None = None,
     ):
         self._handles: dict[int, ScriptHandle] = {}
         # fault domains: every device interaction runs under this envelope
-        # (per-attempt deadline, bounded retry + backoff), and the breaker
-        # demotes the whole engine to host execution after consecutive
-        # failures (coproc/faults.py; config coproc_device_deadline_ms etc.)
+        # (per-attempt deadline, bounded retry + backoff). The static
+        # deadline is the FLOOR: the governor derives per-domain effective
+        # deadlines from the observed stage p99.9 and may only raise them
+        # (coproc/governor.py; config coproc_device_deadline_ms etc.)
         self._fault_policy = faults.FaultPolicy(
             deadline_s=(
                 device_deadline_ms if device_deadline_ms is not None else 30_000
@@ -856,21 +904,53 @@ class TpuEngine:
                 retry_backoff_ms if retry_backoff_ms is not None else 50
             ) / 1000.0,
         )
-        self._breaker = faults.CircuitBreaker(
-            threshold=breaker_threshold if breaker_threshold is not None else 5,
-            cooldown_s=(
-                breaker_cooldown_ms if breaker_cooldown_ms is not None else 30_000
-            ) / 1000.0,
+        _threshold = breaker_threshold if breaker_threshold is not None else 5
+        _cooldown_s = (
+            breaker_cooldown_ms if breaker_cooldown_ms is not None else 30_000
+        ) / 1000.0
+        # The governor owns the decision plane: ONE per-domain breaker per
+        # device fault domain (a flaky mask-fetch path demotes fetches
+        # while dispatch stays on-device), adaptive per-domain deadlines,
+        # and the decision journal every adaptive choice appends to.
+        if governor_journal_capacity is not None:
+            governor.journal.configure(governor_journal_capacity)
+        self.governor = governor.Governor(
+            fault_policy=self._fault_policy,
+            breaker_threshold=_threshold,
+            breaker_cooldown_s=_cooldown_s,
             # a legitimate half-open probe runs a full retry envelope; the
             # stale-probe release must outwait it or a slow probe gets a
-            # second probe stacked onto the same struggling device
-            probe_timeout_s=max(
-                (breaker_cooldown_ms if breaker_cooldown_ms is not None else 30_000)
-                / 1000.0,
-                2.0 * self._fault_policy.envelope_s(),
+            # second probe stacked onto the same struggling device. The
+            # envelope here uses the static floor; adaptive growth is
+            # bounded by the governor's cap, and the max() keeps the
+            # cooldown as the operator-visible lower bound either way.
+            breaker_probe_timeout_s=max(
+                _cooldown_s, 2.0 * self._fault_policy.envelope_s()
+            ),
+            adaptive_deadline=(
+                adaptive_deadline if adaptive_deadline is not None else True
+            ),
+            deadline_margin=(
+                adaptive_deadline_margin
+                if adaptive_deadline_margin is not None
+                else 4.0
             ),
         )
-        probes.register_breaker(self._breaker)
+        self.governor.set_config_snapshot({
+            "device_deadline_ms": round(self._fault_policy.deadline_s * 1e3),
+            "launch_retries": self._fault_policy.retries,
+            "retry_backoff_ms": round(self._fault_policy.backoff_s * 1e3),
+            "breaker_threshold": _threshold,
+            "breaker_cooldown_ms": round(_cooldown_s * 1e3),
+            "force_mode": force_mode,
+            "gather_frame": bool(gather_frame),
+            "adaptive_deadline": (
+                adaptive_deadline if adaptive_deadline is not None else True
+            ),
+        })
+        # the dispatch-domain breaker doubles as the engine-level handle
+        # (dispatch is the domain every launch crosses first)
+        self._breaker = self.governor.breaker_for(faults.DEVICE_DISPATCH)
         self._row_stride = row_stride
         self._compress_threshold = compress_threshold
         self._output_codec = output_codec
@@ -893,7 +973,15 @@ class TpuEngine:
         # scale). host_pool_probe=False pins "sharded" unmeasured — bench
         # scaling runs and parity tests need the fan-out deterministically.
         self._pool_decision: str | None = None if host_pool_probe else "sharded"
+        self.governor.update_config_snapshot(host_workers=self._host_workers)
+        if not host_pool_probe:
+            # config pin, not a measurement — posture only, no journal
+            # entry (a decision the operator made is not an adaptive one)
+            self.governor.note_posture(governor.HOST_POOL, "sharded")
         self._pool_decision_lock = threading.Lock()
+        # set while a periodic re-calibration is pending, so the next
+        # calibration journals itself as a recal rather than a first probe
+        self._recal_pending = False
         self._host_pool_probe: dict | None = None
         self._host_pool_probe_prev: dict | None = None
         # Periodic re-calibration (config coproc_host_pool_recal_launches):
@@ -969,8 +1057,15 @@ class TpuEngine:
                 launch._mask_state = "harvesting"
             t_get = time.perf_counter()
             dev = launch._mask_dev
+            harvest_breaker = self.governor.breaker_for(faults.HARVEST)
             try:
-                if dev is not None:
+                if dev is not None and not harvest_breaker.allow_device():
+                    # open harvest breaker: skip the doomed fetch without
+                    # spending an envelope or a verdict — the woken caller
+                    # takes the exact host fallback (demoted fetches, while
+                    # dispatch's own breaker decides dispatch separately)
+                    launch._mask_np = None
+                elif dev is not None:
                     def leg(dev=dev):
                         faults.inject(faults.HARVEST)
                         # the fetch worker pays the D2H sync; this thread
@@ -979,10 +1074,10 @@ class TpuEngine:
                         return np.asarray(dev)
 
                     launch._mask_np = faults.retry_call(
-                        leg, self._fault_policy, faults.HARVEST,
-                        count=self._stat_add,
+                        leg, self.governor.policy_for(faults.HARVEST),
+                        faults.HARVEST, count=self._stat_add,
                     )
-                    self._breaker.record_success()
+                    harvest_breaker.record_success()
             except Exception as exc:
                 launch._mask_np = None  # materialize() falls back
                 # classified, never fatal: this daemon serves every launch
@@ -995,7 +1090,7 @@ class TpuEngine:
                 # re-raising would kill the daemon every launch depends on).
                 faults.note_failure(faults.HARVEST, exc)
                 if not isinstance(exc, faults.PROGRAMMING_ERRORS):
-                    self._breaker.record_failure()
+                    harvest_breaker.record_failure()
             finally:
                 t_done = time.perf_counter()
                 # device-time span: the fetch completes the async D2H, so
@@ -1139,7 +1234,12 @@ class TpuEngine:
         with self._stats_lock:
             out = dict(self._stats)
         out["host_workers"] = float(self._host_workers)
-        out["breaker"] = self._breaker.snapshot()
+        # "breaker" keeps its historical engine-level shape (worst state,
+        # summed counts); "breakers" is the per-domain split and
+        # "governor" the decision-plane snapshot (posture + journal summary)
+        out["breaker"] = self.governor.aggregate_breaker_snapshot()
+        out["breakers"] = self.governor.breakers_snapshot()
+        out["governor"] = self.governor.snapshot()
         out["arena"] = self._arena.stats()
         if self._host_pool_probe is not None:
             out["host_pool_probe"] = dict(self._host_pool_probe)
@@ -1259,7 +1359,30 @@ class TpuEngine:
                     )
                 else:
                     self._stat_add("t_sharded_seal", time.perf_counter() - t0)
+                    # journaled only once the fan-out COMMITTED: a pool-
+                    # machinery failure falls through to the inline loop
+                    # below, and recording "sharded" first would both lie
+                    # and flip-flop the dedupe into flooding the ring
+                    self.governor.record_mode(
+                        governor.SHARDED_SEAL,
+                        "sharded",
+                        f"reply-wide seal fan-out engaged: {len(jobs)} jobs "
+                        f">= {_SEAL_MIN_BATCHES} over {len(parts)} chunks",
+                        {"jobs": len(jobs), "chunks": len(parts)},
+                    )
                     return [b for chunk in chunks for b in chunk]
+        if len(jobs) >= _SEAL_MIN_BATCHES:
+            # only an ELIGIBLE reply sealing inline is a decision (pool off
+            # or degraded); small replies below the threshold are trivia,
+            # and journaling them would flip-flop the ring on workloads
+            # whose reply sizes oscillate around _SEAL_MIN_BATCHES
+            self.governor.record_mode(
+                governor.SHARDED_SEAL,
+                "inline",
+                "serial seal despite an eligible reply: pool off, measured "
+                "inline decision, or pool-machinery degradation",
+                {"jobs": len(jobs)},
+            )
         t0 = time.perf_counter()
         out = [seal_one(*j) for j in jobs]
         self._stat_add("t_seal", time.perf_counter() - t0)
@@ -1278,22 +1401,24 @@ class TpuEngine:
                     slot._mask_state = "abandoned"
 
     def _try_device_leg(self, domain: str, leg):
-        """One device leg under the engine's fault envelope: per-attempt
-        deadline + bounded retry (faults.retry_call), classified failure
-        accounting, and a breaker failure verdict on exhaustion. Returns
-        the leg's value, or None after exhausted retries — the call site
-        supplies its exact host fallback and, where the leg's success IS
-        the device verdict (harvest/fetch legs), records the success.
-        Every leg returns an array, so None is an unambiguous sentinel.
-        This is THE shape of a fault-tolerant device interaction; keeping
-        it in one place keeps the breaker verdicts exhaustive."""
+        """One device leg under the engine's fault envelope: the DOMAIN's
+        per-attempt deadline (adaptive, governor-derived) + bounded retry
+        (faults.retry_call), classified failure accounting, and a failure
+        verdict on the DOMAIN's breaker at exhaustion. Returns the leg's
+        value, or None after exhausted retries — the call site supplies
+        its exact host fallback and, where the leg's success IS the device
+        verdict (harvest/fetch legs), records the success. Every leg
+        returns an array, so None is an unambiguous sentinel. This is THE
+        shape of a fault-tolerant device interaction; keeping it in one
+        place keeps the breaker verdicts exhaustive."""
         try:
             return faults.retry_call(
-                leg, self._fault_policy, domain, count=self._stat_add
+                leg, self.governor.policy_for(domain), domain,
+                count=self._stat_add,
             )
         except Exception as exc:
             faults.note_failure(domain, exc, reraise_programming=True)
-            self._breaker.record_failure()
+            self.governor.breaker_for(domain).record_failure()
             return None
 
     def heartbeat(self) -> int:
@@ -1439,6 +1564,13 @@ class TpuEngine:
         shardable launch (the same measure-first posture as
         _probe_columnar_backend: never assume the cores are real). The
         ~4 extra explode passes cost one launch a few ms, once."""
+        recal = self._recal_pending
+        self._recal_pending = False
+        why = (
+            "periodic recalibration (coproc_host_pool_recal_launches)"
+            if recal
+            else "first shardable launch calibration"
+        )
         try:
             t_inline, t_sharded = self._measure_pool_ratio(
                 plan, all_batches, counts
@@ -1449,6 +1581,12 @@ class TpuEngine:
             faults.note_failure("pool_calibration", exc)
             logger.exception("host pool calibration failed; keeping inline path")
             self._pool_decision = "inline"
+            self.governor.record(
+                governor.HOST_POOL,
+                "inline",
+                f"{why} FAILED ({faults.kind_of(exc)}); keeping inline path",
+                {"error": faults.kind_of(exc), "workers": self._host_workers},
+            )
         else:
             ratio = t_inline / t_sharded if t_sharded > 0 else 0.0
             self._pool_decision = (
@@ -1462,6 +1600,13 @@ class TpuEngine:
                 "chosen": self._pool_decision,
             }
             logger.info("host pool calibration: %s", self._host_pool_probe)
+            self.governor.record(
+                governor.HOST_POOL,
+                self._pool_decision,
+                f"{why}: measured explode speedup {ratio:.3f}x vs margin "
+                f"{host_pool.PROBE_MARGIN} at {self._host_workers} workers",
+                dict(self._host_pool_probe, recalibration=recal),
+            )
         if self._pool_decision == "inline":
             self._host_pool.shutdown()  # threads idle forever otherwise
 
@@ -1507,6 +1652,9 @@ class TpuEngine:
                             )
                         self._pool_decision = None
                         self._launches_since_cal = 0
+                        # the calibration this triggers journals itself as
+                        # a recal (read + cleared in _calibrate_host_pool)
+                        self._recal_pending = True
         if self._pool_decision is None:
             # double-checked: concurrent first submits (two script fibers
             # on the coproc-tick executor) must not calibrate against each
@@ -1527,6 +1675,9 @@ class TpuEngine:
                 use_host = False
             elif TpuEngine._columnar_backend is not None:
                 use_host = TpuEngine._columnar_backend == "host"
+                self.governor.note_posture(
+                    governor.COLUMNAR_BACKEND, TpuEngine._columnar_backend
+                )
             else:
                 return False
         breaker_demoted_rows = 0
@@ -1700,6 +1851,7 @@ class TpuEngine:
                     stage("t_dispatch", t0)
                     self._count_fallback(n)
                 else:
+                    self._breaker.record_success()  # dispatch-domain verdict
                     stage("t_dispatch", t0)
                     self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
                     self._stat_add("bytes_d2h", n_pad // 8)
@@ -1767,6 +1919,10 @@ class TpuEngine:
             launch._packed_dev = launch._payload_host_fallback()
             self._stat_add("t_dispatch", time.perf_counter() - t0)
             return
+        # dispatch success IS the dispatch-domain verdict (the device
+        # accepted the program); whether the RESULT comes back alive is
+        # the harvest domain's verdict, recorded at fetch time
+        self._breaker.record_success()
         self._stat_add("t_dispatch", time.perf_counter() - t0)
         self._stat_add("bytes_h2d", staged.nbytes)
         self._stat_add("bytes_d2h", n_pad * (r_out + 8))
@@ -1808,6 +1964,13 @@ class TpuEngine:
                         use_host = True
                 else:
                     use_host = TpuEngine._columnar_backend == "host"
+            if TpuEngine._columnar_backend is not None:
+                # this engine runs the sticky process-wide pick (probed by
+                # us just above, or inherited): posture only — the probe
+                # that made the decision already journaled it
+                self.governor.note_posture(
+                    governor.COLUMNAR_BACKEND, TpuEngine._columnar_backend
+                )
             breaker_demoted = False
             if not use_host and not self._breaker.allow_device():
                 # open breaker: the whole launch stays on the exact numpy
@@ -1836,6 +1999,7 @@ class TpuEngine:
                     self._stat_add("t_dispatch", time.perf_counter() - t0)
                     self._count_fallback(n)
                 else:
+                    self._breaker.record_success()  # dispatch-domain verdict
                     self._stat_add("t_dispatch", time.perf_counter() - t0)
                     self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
                     self._stat_add("bytes_d2h", n_pad // 8)
@@ -1900,6 +2064,16 @@ class TpuEngine:
             "margin": _PROBE_DEVICE_MARGIN,
             "chosen": TpuEngine._columnar_backend,
         }
+        self.governor.record(
+            governor.COLUMNAR_BACKEND,
+            TpuEngine._columnar_backend,
+            "measured predicate leg: host "
+            f"{t_host * 1e3:.3f} ms vs device "
+            + ("unavailable" if t_dev == float("inf")
+               else f"{t_dev * 1e3:.3f} ms")
+            + f" (device must win {_PROBE_DEVICE_MARGIN}x; process-sticky)",
+            dict(TpuEngine._columnar_probe),
+        )
 
     def _pack_staged(self, exploded, n_pad: int) -> np.ndarray:
         """[n_pad, row_stride + IN_META] uint8: record bytes then LE32 length.

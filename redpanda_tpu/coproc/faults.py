@@ -299,9 +299,17 @@ class CircuitBreaker:
         cooldown_s: float = 30.0,
         clock=time.monotonic,
         probe_timeout_s: float | None = None,
+        name: str = "",
+        listener=None,
     ) -> None:
         self.threshold = max(1, int(threshold))
         self.cooldown_s = float(cooldown_s)
+        # identity + transition hook for the governor's decision journal:
+        # ``listener(name, old_state, new_state, info)`` fires AFTER the
+        # breaker's lock is released (a listener that re-enters breaker
+        # state, or appends to a locked journal, must not deadlock here)
+        self.name = name
+        self._listener = listener
         # how long an admitted half-open probe may run before its slot is
         # presumed abandoned. MUST exceed the probe launch's own retry
         # envelope (FaultPolicy.envelope_s) or a legitimately-slow probe
@@ -338,40 +346,85 @@ class CircuitBreaker:
             # forever and the engine would stay demoted until restart.
             self._probe_inflight = False
 
+    def _tick_event_locked(self, events: list) -> None:
+        """Run _tick_locked and capture its transition (if any) while the
+        lock is STILL held — the (old, new, reason, info) tuple must be a
+        consistent snapshot of one transition, not a re-read after other
+        threads may have moved the state again."""
+        old = self._state
+        self._tick_locked()
+        if self._state != old:
+            events.append((
+                old, self._state,
+                "cooldown elapsed; half-open probe slot available",
+                self._info_locked(),
+            ))
+
+    def _info_locked(self) -> dict:
+        return {"consecutive_failures": self._consecutive, "trips": self.trips}
+
+    def _fire(self, events: list) -> None:
+        """Deliver captured transitions OUTSIDE the lock (the listener
+        appends to the governor's journal, which takes its own locks)."""
+        if self._listener is None:
+            return
+        for old, new, reason, info in events:
+            try:
+                self._listener(self.name, old, new, reason, info)
+            except Exception:  # pragma: no cover - observability must not kill the data path
+                logger.exception("breaker transition listener failed")
+
     @property
     def state(self) -> str:
+        events: list = []
         with self._lock:
-            self._tick_locked()
-            return self._state
+            self._tick_event_locked(events)
+            state = self._state
+        self._fire(events)
+        return state
 
     def allow_device(self) -> bool:
         """May the next launch touch the device? Half-open admits exactly
         one probe at a time; everyone else stays on the host fallback until
         that probe's verdict lands."""
+        events: list = []
         with self._lock:
-            self._tick_locked()
+            self._tick_event_locked(events)
             if self._state == STATE_CLOSED:
-                return True
-            if self._state == STATE_HALF_OPEN and not self._probe_inflight:
+                allowed = True
+            elif self._state == STATE_HALF_OPEN and not self._probe_inflight:
                 self._probe_inflight = True
                 self._probe_started_at = self._clock()
-                return True
-            return False
+                allowed = True
+            else:
+                allowed = False
+        self._fire(events)
+        return allowed
 
     def record_success(self) -> None:
+        events: list = []
         with self._lock:
-            self._tick_locked()
+            self._tick_event_locked(events)
             self._consecutive = 0
             if self._state == STATE_HALF_OPEN:
                 logger.info(
-                    "coproc breaker re-closed after successful half-open probe"
+                    "coproc breaker %s re-closed after successful half-open "
+                    "probe", self.name or "(unnamed)",
                 )
+                old = self._state
                 self._state = STATE_CLOSED
                 self._probe_inflight = False
+                events.append((
+                    old, STATE_CLOSED,
+                    "half-open probe succeeded; device re-admitted",
+                    self._info_locked(),
+                ))
+        self._fire(events)
 
     def record_failure(self) -> None:
+        events: list = []
         with self._lock:
-            self._tick_locked()
+            self._tick_event_locked(events)
             self._consecutive += 1
             tripped = False
             if self._state == STATE_HALF_OPEN:
@@ -382,25 +435,39 @@ class CircuitBreaker:
             ):
                 tripped = True
             if tripped:
+                old = self._state
                 self._state = STATE_OPEN
                 self._opened_at = self._clock()
                 self._probe_inflight = False
                 self.trips += 1
                 probes.coproc_breaker_trips.inc()
                 logger.warning(
-                    "coproc breaker OPEN after %d consecutive device "
-                    "failures (trip #%d); engine demoted to host execution, "
+                    "coproc breaker %s OPEN after %d consecutive device "
+                    "failures (trip #%d); domain demoted to host execution, "
                     "re-probe in %.1fs",
-                    self._consecutive, self.trips, self.cooldown_s,
+                    self.name or "(unnamed)", self._consecutive, self.trips,
+                    self.cooldown_s,
                 )
+                events.append((
+                    old, STATE_OPEN,
+                    f"{self._consecutive} consecutive failure(s) against "
+                    f"threshold {self.threshold}",
+                    self._info_locked(),
+                ))
+        self._fire(events)
 
     def snapshot(self) -> dict:
+        events: list = []
         with self._lock:
-            self._tick_locked()
-            return {
+            self._tick_event_locked(events)
+            out = {
                 "state": self._state,
                 "consecutive_failures": self._consecutive,
                 "trips": self.trips,
                 "threshold": self.threshold,
                 "cooldown_ms": round(self.cooldown_s * 1000.0),
             }
+            if self.name:
+                out["domain"] = self.name
+        self._fire(events)
+        return out

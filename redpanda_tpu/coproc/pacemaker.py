@@ -148,16 +148,21 @@ class ScriptContext:
             # wedged. A timed-out executor call is ABANDONED, not retried
             # in place: its ticket is never harvested, so nothing is
             # written (no duplicates), and the un-advanced offsets make the
-            # next tick re-read the same records (no loss).
+            # next tick re-read the same records (no loss). The governor
+            # may have adaptively RAISED per-domain deadlines since the
+            # static backstop was sized at startup, so re-derive per tick:
+            # the backstop must always sit above the engine's own envelope
+            # or it would abandon legitimately mid-envelope ticks.
+            deadline_s = pm.tick_deadline_for(pm.engine)
             with tracer.span("coproc.submit.wait"):
                 ticket = await asyncio.wait_for(
                     loop.run_in_executor(ex, pm.engine.submit, req),
-                    timeout=pm.tick_deadline_s,
+                    timeout=deadline_s,
                 )
             with tracer.span("coproc.harvest.wait"):
                 reply = await asyncio.wait_for(
                     loop.run_in_executor(ex, ticket.result),
-                    timeout=pm.tick_deadline_s,
+                    timeout=deadline_s,
                 )
             if self.script_id in reply.deregistered:
                 logger.warning("script %s deregistered by engine policy", self.name)
@@ -248,6 +253,18 @@ class Pacemaker:
         # timeout, so a small fixed cap would head-of-line block every
         # other script's tick behind a few wedged fetches.
         self._engine_executor: ThreadPoolExecutor | None = None
+
+    def tick_deadline_for(self, engine) -> float:
+        """Effective tick backstop: the configured static deadline, never
+        below 4x the engine's worst-case per-domain retry envelope (the
+        governor can raise per-domain deadlines adaptively at runtime; a
+        backstop sized once at startup would then fire on healthy-but-slow
+        ticks). Engines without a governor (bare test doubles) keep the
+        static value."""
+        gov = getattr(engine, "governor", None)
+        if gov is None:
+            return self.tick_deadline_s
+        return max(self.tick_deadline_s, 4.0 * gov.max_envelope_s())
 
     @property
     def engine_executor(self) -> ThreadPoolExecutor:

@@ -118,32 +118,11 @@ coproc_fallback_rows = registry.counter(
     "Records whose transform stages re-executed on the pure-host fallback",
 )
 
-# Breaker-state gauge: breakers are per-engine while the registry is
-# process-wide, so the gauge follows the most recently constructed engine's
-# breaker (the broker has exactly one; bench/test engines hand over on
-# construction). Weakref: a dead bench engine must not pin its breaker.
-_breaker_ref: "weakref.ref | None" = None
-
-
-def register_breaker(breaker) -> None:
-    global _breaker_ref
-    _breaker_ref = weakref.ref(breaker)
-
-
-def _breaker_state_value() -> float:
-    b = _breaker_ref() if _breaker_ref is not None else None
-    if b is None:
-        return -1.0
-    from redpanda_tpu.coproc.faults import STATE_NUM
-
-    return STATE_NUM.get(b.state, -1.0)
-
-
-coproc_breaker_state = registry.gauge(
-    "coproc_breaker_state",
-    _breaker_state_value,
-    "Device circuit breaker state (0 closed, 1 open, 2 half_open, -1 none)",
-)
+# Breaker-state gauges moved to the governor (coproc/governor.py): they
+# are per-DOMAIN labeled series (coproc_breaker_state{domain=...}) owned by
+# the engine's Governor via weakref — the old single weakref-to-latest-
+# engine gauge reported a stale engine's breaker after restarts and in
+# multi-engine tests.
 
 # ------------------------------------------------------ host-stage pool
 # Busy-worker gauge for the coproc host-stage pool (coproc/host_pool.py).
@@ -332,7 +311,6 @@ __all__ = [
     "exemplars_snapshot",
     "record_us",
     "reset_exemplars",
-    "coproc_breaker_state",
     "coproc_breaker_trips",
     "coproc_d2h_bytes",
     "coproc_failure_counter",
@@ -345,7 +323,6 @@ __all__ = [
     "coproc_retries_total",
     "coproc_shard_rows_hist",
     "coproc_stage_hist",
-    "register_breaker",
     "host_pool_task_finished",
     "host_pool_task_started",
     "kafka_fetch_hist",

@@ -239,9 +239,24 @@ def measure_probe(n_records: int = 64, record_size: int = 512, reps: int = 2) ->
         for c in comp:
             lz4_block_decompress(c, record_size)
     host_s = (time.perf_counter() - t0) / 20
-    return {
+    probe = {
         "device_mb_s": round(total / 1e6 / dev_s, 3),
         "host_mb_s": round(total / 1e6 / host_s, 1),
         "ratio_device_vs_host": round(host_s / dev_s, 6),
         "decision": "host",
     }
+    # keep-or-kill is a governed decision like every other measured probe:
+    # it lands in the process decision journal (coproc/governor.py) so a
+    # BENCH artifact's device_lz4 verdict is reconstructible from
+    # /v1/governor alone. Imported here, not at module top: ops/ must not
+    # import coproc/ at import time.
+    from redpanda_tpu.coproc import governor
+
+    governor.journal_record(
+        governor.DEVICE_LZ4,
+        probe["decision"],
+        f"device block decode {probe['device_mb_s']} MB/s vs host liblz4 "
+        f"{probe['host_mb_s']} MB/s (ratio {probe['ratio_device_vs_host']}x)",
+        dict(probe),
+    )
+    return probe

@@ -141,6 +141,15 @@ class Consensus:
         # cancelled on stop() (pandalint TSK301)
         self._bg_tasks: set[asyncio.Task] = set()
         self._batcher: _ReplicateBatcher | None = None
+        # sampled "owner trace" for the replicate path's detached rpc sends:
+        # the batcher's flush task and follower recovery run under
+        # tracer.detached() by span-hygiene design, so their rpc.send spans
+        # (and SLO breach exemplars) carried no trace id at all. One
+        # submitter's ambient trace id per coalesced flush round is sampled
+        # here and CONSUMED by the first append_entries send, so a breach
+        # report on the replicate path resolves to a real trace without
+        # re-attributing the long-lived tasks wholesale.
+        self._replicate_owner: int | None = None
         self._snapshots = SnapshotManager(log.dir, name="raft_snapshot")
         self._snapshot_rx: dict | None = None  # in-progress chunked install
         self._transferring = False
@@ -540,10 +549,19 @@ class Consensus:
                         "batches": blob,
                         "flush": True,
                     }
+                    # consume-once owner trace: the span JOINS the sampled
+                    # submitter's trace for exactly one send (trace_id=None
+                    # = the usual untraced no-op), so the rpc.send
+                    # histogram record inside — and any exemplar a breach
+                    # captures — resolves to a real trace
+                    owner, self._replicate_owner = self._replicate_owner, None
                     try:
-                        reply = await self._client_for(f.node.id).append_entries(
-                            req, timeout=self.timings.rpc_timeout_s
-                        )
+                        with tracer.span(
+                            "raft.append_entries.send", trace_id=owner
+                        ):
+                            reply = await self._client_for(f.node.id).append_entries(
+                                req, timeout=self.timings.rpc_timeout_s
+                            )
                     except (RpcError, TransportClosed, OSError):
                         return  # next heartbeat/append retries
                     if reply["term"] > self.term:
@@ -950,6 +968,13 @@ class _ReplicateBatcher:
         loop = asyncio.get_event_loop()
         enqueued: asyncio.Future = loop.create_future()
         replicated: asyncio.Future = loop.create_future()
+        # sample the submitter's ambient trace as the round's owner trace
+        # (the flush task itself is deliberately detached); latest non-None
+        # submitter wins — ONE resolvable exemplar per flush round is the
+        # contract, not per-submission attribution
+        tid = tracer.current_trace()
+        if tid is not None:
+            self._c._replicate_owner = tid
         self._pending.append((batches, enqueued, replicated, timeout))
         if self._flush_task is None or self._flush_task.done():
             # detached: under sustained load this task loops across MANY

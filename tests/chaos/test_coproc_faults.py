@@ -128,7 +128,13 @@ def _engine(spec, force_mode, workers):
         # Tight fault envelope so wedge runs stay fast: the per-attempt
         # deadline (60ms) sits BELOW wedge_max_s (120ms), which is what
         # forces the deadline-abandonment path a real wedged link takes.
+        # The adaptive derivation is pinned OFF for the same reason the
+        # deadline itself is pinned: earlier fault runs in this process
+        # inflate the fetch-stage p99.9, and a governor-raised deadline
+        # above the wedge cap would let the wedged fetch "succeed" late
+        # instead of exercising the abandonment path under test.
         device_deadline_ms=60,
+        adaptive_deadline=False,
         launch_retries=1,
         retry_backoff_ms=1,
         # parity runs must observe every probe point on the device path,

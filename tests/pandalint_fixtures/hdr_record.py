@@ -1,0 +1,50 @@
+"""HST10xx fixture: histogram records with and without serializing locks."""
+import threading
+
+from redpanda_tpu.observability import probes
+
+_stats_lock = threading.Lock()
+
+
+def unlocked(latency_hist, v):
+    latency_hist.record(v)
+
+
+def unlocked_attr(engine, v):
+    engine.stage_hist.record(v)
+
+
+def unlocked_lookup(v):
+    probes.coproc_stage_hist("explode").record(v)
+
+
+def non_lock_with(tracer, latency_hist, v):
+    with tracer.span("x"):
+        latency_hist.record(v)
+
+
+def locked(latency_hist, v):
+    with _stats_lock:
+        latency_hist.record(v)
+
+
+def locked_attr(engine, v):
+    with engine._stats_lock:
+        engine.stage_hist.record(v)
+        probes.coproc_stage_hist("find").record(v)
+
+
+def nested_def_escapes_lock(latency_hist):
+    with _stats_lock:
+        def later(v):
+            latency_hist.record(v)
+
+        return later
+
+
+def not_a_histogram(recorder, v):
+    recorder.record(v)
+
+
+def suppressed(latency_hist, v):
+    latency_hist.record(v)  # pandalint: disable=HST1001 -- fixture: single-threaded owner records here

@@ -603,11 +603,22 @@ def test_engine_shutdown_stops_harvester_and_is_idempotent():
     engine.shutdown()  # idempotent
 
 
-def test_breaker_state_gauge_follows_registered_breaker():
+def test_breaker_state_gauge_is_per_domain_labeled():
+    """The governor owns per-domain labeled breaker gauges (the old single
+    weakref-to-latest-engine gauge reported a stale engine's state after
+    restarts): a dispatch trip must move ONLY the dispatch series."""
+    from redpanda_tpu.metrics import registry
+
+    def gauge(domain):
+        return registry.snapshot()[f'coproc_breaker_state{{domain="{domain}"}}']
+
     engine = _engine(breaker_threshold=1)
-    assert probes.coproc_breaker_state.fn() == faults.STATE_NUM[faults.STATE_CLOSED]
+    assert gauge("device_dispatch") == faults.STATE_NUM[faults.STATE_CLOSED]
     engine._breaker.record_failure()
-    assert probes.coproc_breaker_state.fn() == faults.STATE_NUM[faults.STATE_OPEN]
+    assert gauge("device_dispatch") == faults.STATE_NUM[faults.STATE_OPEN]
+    # per-domain isolation: fetch/harvest domains stay closed
+    assert gauge("mask_fetch") == faults.STATE_NUM[faults.STATE_CLOSED]
+    assert gauge("harvest") == faults.STATE_NUM[faults.STATE_CLOSED]
 
 
 def test_payload_mode_dispatch_fault_exact_fallback():

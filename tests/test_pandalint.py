@@ -209,6 +209,33 @@ def test_bare_except_scoped_to_coproc(tmp_path):
     assert not any(f.rule.startswith("EXC") for f in report.findings)
 
 
+def test_hdr_record_rules_exact_lines():
+    got = _active(_lint(os.path.join(FIXTURES, "hdr_record.py")))
+    assert got == [
+        ("HST1001", 10),  # bare unlocked record
+        ("HST1001", 14),  # attribute-held histogram, unlocked
+        ("HST1002", 18),  # inline coproc_stage_hist(...) lookup, unlocked
+        ("HST1001", 23),  # a with that is not a lock does not serialize
+        ("HST1001", 40),  # nested def under a lock runs later, unlocked
+    ]
+
+
+def test_hdr_record_scoped_to_coproc(tmp_path):
+    """The HdrHist serialization contract is a threaded-coproc concern;
+    dispatch-layer records elsewhere run on the owning event loop and must
+    not trip the gate."""
+    cfg = Config()
+    for sub, expect in (
+        ("kafka", False), ("observability", False), ("coproc", True),
+    ):
+        pkg = tmp_path / "redpanda_tpu" / sub
+        pkg.mkdir(parents=True)
+        dst = pkg / "hr.py"
+        shutil.copyfile(os.path.join(FIXTURES, "hdr_record.py"), dst)
+        report = LintEngine(cfg).lint_file(str(dst), f"redpanda_tpu/{sub}/hr.py")
+        assert any(f.rule.startswith("HST") for f in report.findings) is expect, sub
+
+
 def test_iobuf_rules_exact_lines():
     got = _active(_lint(os.path.join(FIXTURES, "copy_loop.py")))
     assert got == [

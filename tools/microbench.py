@@ -630,6 +630,124 @@ def bench_breaker_overhead(secs: float) -> dict:
                     arm[effect](module, probe)
 
 
+def bench_governor_overhead(secs: float) -> dict:
+    """Cost of the governor's decision-plane hooks on the UNFAULTED coproc
+    launch path.
+
+    What a healthy launch pays the governor, per launch: two
+    ``record_mode`` calls on their CLOSED path (harvest-path + seal
+    verdicts unchanged -> one lock + one compare each) and a few
+    ``policy_for`` lookups (cached adaptive deadline -> two dict lookups +
+    an int compare). The journal append itself runs only when a verdict
+    CHANGES — per-incident, not per-launch — but its cost is priced too
+    (``governor_journal_append_ns``) on a throwaway DecisionJournal so the
+    live process journal and the decision counters stay untouched.
+
+    Same derived min-of-blocks discipline as the tracer/breaker/slo
+    benches: wall-clock A/B cannot resolve sub-1% on a shared box, but the
+    hooks are strictly additive straight-line code, so (per-call hook
+    cost x conservative per-launch count) / (per-launch cost) IS their
+    share of the hot path. --assert-governor-overhead gates it."""
+    import json as _json
+
+    from redpanda_tpu.coproc import TpuEngine, ProcessBatchRequest, faults
+    from redpanda_tpu.coproc import governor as gov
+    from redpanda_tpu.coproc.engine import ProcessBatchItem
+    from redpanda_tpu.models import NTP, Record, RecordBatch
+    from redpanda_tpu.ops.exprs import field
+    from redpanda_tpu.ops.transforms import Int, Str, map_project, where
+
+    # the denominator: a real columnar host launch over 512 records (the
+    # same deterministic device-free shape as the breaker bench)
+    engine = TpuEngine(
+        row_stride=256, compress_threshold=10**9,
+        force_mode="columnar_host", host_workers=0,
+    )
+    spec = where(field("level") == "error") | map_project(
+        Int("code"), Str("msg", 16)
+    )
+    engine.enable_coprocessors([(1, spec.to_json(), ("orders",))])
+    recs = [
+        Record(
+            offset_delta=i, timestamp_delta=i,
+            value=_json.dumps(
+                {"level": ["error", "info"][i % 2], "code": i, "msg": f"m{i}"},
+                separators=(",", ":"),
+            ).encode(),
+        )
+        for i in range(512)
+    ]
+    batch = RecordBatch.build(recs, base_offset=0, first_timestamp=1000)
+    req = ProcessBatchRequest(
+        [ProcessBatchItem(1, NTP.kafka("orders", 0), [batch])]
+    )
+
+    def op():
+        engine.process_batch(req)
+
+    def timed_block(fn, k: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(k):
+            fn()
+        return time.perf_counter() - t0
+
+    op()  # warmup (plan compile, caches, first record_mode entries)
+    per_op = min(timed_block(op, 2) / 2 for _ in range(3))
+    k = max(2, int(0.01 / per_op))
+    rounds = max(12, int(secs / (k * per_op)))
+    best_op = min(timed_block(op, k) / k for _ in range(rounds))
+
+    # per-call hook costs on PRIVATE instances: the scratch governor gets
+    # its OWN journal (journal_override: its priming entries and any
+    # deadline derivation must not land in the live process journal or
+    # move coproc_governor_decisions_total), its own histogram source
+    # (the live stage histograms must not drive a scratch DEADLINE entry),
+    # and no gauges (register_gauges=False: it must not steal the live
+    # engine's labeled series)
+    from redpanda_tpu.utils.hdr import HdrHist
+
+    journal = gov.DecisionJournal(capacity=256)
+    scratch_hists: dict = {}
+    scratch = gov.Governor(
+        fault_policy=faults.FaultPolicy(),
+        register_gauges=False,
+        journal_override=gov.DecisionJournal(capacity=256),
+        stage_hist=lambda s: scratch_hists.setdefault(s, HdrHist()),
+    )
+    scratch.record_mode("harvest_path", "gather", "bench prime")
+    scratch.policy_for(faults.DEVICE_DISPATCH)
+    append_ns = mode_ns = policy_ns = float("inf")
+    n_raw = 5000
+    for _ in range(10):
+        t0 = time.perf_counter()
+        for _ in range(n_raw):
+            journal.append(
+                "harvest_path", "gather", "bench append", {"rows": 512}
+            )
+        append_ns = min(append_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+        t0 = time.perf_counter()
+        for _ in range(n_raw):
+            scratch.record_mode("harvest_path", "gather", "bench prime")
+        mode_ns = min(mode_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+        t0 = time.perf_counter()
+        for _ in range(n_raw):
+            scratch.policy_for(faults.DEVICE_DISPATCH)
+        policy_ns = min(policy_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+    # conservative per-launch budget: harvest-path + seal record_mode on
+    # the closed path, plus a policy_for per device leg (dispatch, mask
+    # fetch, harvest)
+    hooks_per_launch = 2 * mode_ns + 3 * policy_ns
+    pct = hooks_per_launch / (best_op * 1e9) * 100.0 if best_op else 0.0
+    engine.shutdown()
+    return {
+        "governor_journal_append_ns": round(append_ns, 1),
+        "governor_record_mode_closed_ns": round(mode_ns, 1),
+        "governor_policy_for_ns": round(policy_ns, 1),
+        "governor_launch_cost_us": round(best_op * 1e6, 1),
+        "governor_overhead_pct": round(pct, 3),
+    }
+
+
 def bench_rpc_echo(secs: float) -> dict:
     """Loopback RPC round trips (rpc_bench shape) over the real stack."""
     from redpanda_tpu import rpc
@@ -681,6 +799,7 @@ BENCHES = {
     "tracer_overhead": bench_tracer_overhead,
     "breaker_overhead": bench_breaker_overhead,
     "slo_eval_overhead": bench_slo_eval_overhead,
+    "governor_overhead": bench_governor_overhead,
 }
 
 
@@ -728,6 +847,14 @@ def main(argv=None) -> int:
         "slo_eval_overhead bench",
     )
     p.add_argument(
+        "--assert-governor-overhead",
+        type=float,
+        metavar="PCT",
+        help="fail (exit 1) if the governor's closed-path decision hooks' "
+        "share of a columnar launch exceeds PCT percent; implies the "
+        "governor_overhead bench",
+    )
+    p.add_argument(
         "--assert-harvest-speedup",
         type=float,
         metavar="RATIO",
@@ -754,6 +881,8 @@ def main(argv=None) -> int:
         names.append("harvest_path")
     if args.assert_slo_overhead is not None and "slo_eval_overhead" not in names:
         names.append("slo_eval_overhead")
+    if args.assert_governor_overhead is not None and "governor_overhead" not in names:
+        names.append("governor_overhead")
     snap_before = None
     if args.metrics_snapshot:
         from redpanda_tpu.metrics import registry
@@ -804,6 +933,15 @@ def main(argv=None) -> int:
             print(
                 f"slo hook overhead {pct}% exceeds budget "
                 f"{args.assert_slo_overhead}%",
+                file=sys.stderr,
+            )
+            return 1
+    if args.assert_governor_overhead is not None:
+        pct = out.get("governor_overhead_pct", 0.0)
+        if pct > args.assert_governor_overhead:
+            print(
+                f"governor hook overhead {pct}% exceeds budget "
+                f"{args.assert_governor_overhead}%",
                 file=sys.stderr,
             )
             return 1

@@ -16,6 +16,7 @@ from tools.pandalint.checkers.crossshard import CrossShardChecker
 from tools.pandalint.checkers.locks import LockRpcChecker
 from tools.pandalint.checkers.sleeps import SleepAsyncChecker
 from tools.pandalint.checkers.excepts import BareExceptChecker
+from tools.pandalint.checkers.hdrrecord import HdrRecordChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     ReactorChecker,
@@ -29,6 +30,7 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     LockRpcChecker,
     SleepAsyncChecker,
     BareExceptChecker,
+    HdrRecordChecker,
 )
 
 

@@ -1,0 +1,123 @@
+"""Histogram record discipline: HdrHist.record is a read-modify-write.
+
+``HdrHist.record()`` bumps a bucket dict, a total, a sum and a max — four
+plain read-modify-writes with no internal lock (utils/hdr.py keeps the hot
+path to integer math on purpose; readers get GIL-atomic snapshots, writers
+must serialize). In the coproc data path, records happen from harvester
+daemons, the host-stage pool's shard workers AND the coproc-tick executor
+concurrently, so every record there goes through a serializing lock (the
+engine's ``_stat_add`` records under ``_stats_lock``) — an unlocked record
+silently LOSES samples under contention, which corrupts exactly the
+latency tails the governor derives its adaptive deadlines from.
+
+Heuristic scope (no type inference), confined to ``redpanda_tpu/coproc``
+(the one subtree where several threads share the engine's histograms;
+single-threaded dispatch-layer records elsewhere are the owning thread by
+contract):
+
+- HST1001: ``<histogram>.record(...)`` — a receiver whose dotted name
+  mentions ``hist`` — outside any lexically-enclosing ``with`` block whose
+  context manager looks like a lock (dotted name mentioning ``lock`` /
+  ``mutex``).
+- HST1002: the same, with the histogram looked up inline —
+  ``coproc_stage_hist(...).record(...)`` / ``registry.histogram(...)
+  .record(...)`` — the shape where the lock is easiest to forget because
+  no histogram variable exists to "own".
+
+A record inside a function DEFINED under a lock block does not count as
+locked (the closure runs later, on whatever thread calls it), and a
+``with`` that is not a lock (``tracer.span(...)``) does not serialize.
+A site that is genuinely single-threaded carries a reasoned
+``# pandalint: disable=HST1001 -- ...`` pragma, which doubles as the
+documentation of WHY that thread owns the histogram.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.checkers.base import (
+    Checker,
+    FileContext,
+    RawFinding,
+    dotted,
+)
+
+_LOCKISH = ("lock", "mutex")
+
+
+def _is_lockish(expr: ast.expr) -> bool:
+    """Does a with-item's context expression look like a serializing lock?
+    Accepts names/attributes (``self._stats_lock``) and calls returning
+    one (``lock()``, ``self._lock.acquire_timeout(...)``)."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = dotted(expr).lower()
+    return any(part in name for part in _LOCKISH)
+
+
+def _hist_receiver(call: ast.Call) -> tuple[str, str] | None:
+    """(rule, receiver description) when this is a histogram .record()."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "record"):
+        return None
+    recv = f.value
+    if isinstance(recv, ast.Call):
+        name = dotted(recv.func)
+        if "hist" in name.lower():
+            return "HST1002", f"{name}(...)"
+        return None
+    name = dotted(recv)
+    if name and "hist" in name.lower():
+        return "HST1001", name
+    return None
+
+
+class HdrRecordChecker(Checker):
+    name = "hdr-record"
+    rules = {
+        "HST1001": "histogram .record() in threaded coproc code outside a "
+                   "serializing lock (HdrHist read-modify-write contract)",
+        "HST1002": "inline histogram lookup .record() (coproc_stage_hist/"
+                   "registry.histogram) outside a serializing lock",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        yield from self._walk(ctx.tree.body, locked=False)
+
+    def _walk(self, body, locked: bool) -> Iterator[RawFinding]:
+        for node in body:
+            yield from self._visit(node, locked)
+
+    def _visit(self, node: ast.AST, locked: bool) -> Iterator[RawFinding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def under a lock block runs LATER, on whatever
+            # thread calls it: the lock is not held there
+            yield from self._walk(node.body, locked=False)
+            return
+        if isinstance(node, ast.Lambda):
+            yield from self._visit(node.body, locked=False)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            has_lock = any(_is_lockish(item.context_expr) for item in node.items)
+            for item in node.items:  # the context exprs evaluate unlocked
+                yield from self._visit(item.context_expr, locked)
+            yield from self._walk(node.body, locked or has_lock)
+            return
+        if isinstance(node, ast.Call):
+            hit = _hist_receiver(node)
+            if hit is not None and not locked:
+                rule, recv = hit
+                yield RawFinding(
+                    rule,
+                    node.lineno,
+                    node.col_offset,
+                    f"{recv}.record() without a serializing lock: "
+                    f"HdrHist.record is a read-modify-write and coproc "
+                    f"records race across harvester/pool/executor threads "
+                    f"— hold the owning lock (the engine records under "
+                    f"_stats_lock)",
+                )
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(child, locked)

@@ -50,6 +50,11 @@ DEFAULT_SCOPES: dict[str, tuple[str, ...]] = {
     # (coproc/faults.py); a broad catch elsewhere in the broker has no
     # classifier to report to, so the rule would only breed pragmas there.
     "bare-except": ("redpanda_tpu/coproc",),
+    # HdrHist.record serialization is a threaded-coproc contract: the
+    # engine's histograms are shared by harvester/pool/executor threads.
+    # Dispatch-layer records elsewhere run on the owning event loop by
+    # construction, so package-wide the rule would only breed pragmas.
+    "hdr-record": ("redpanda_tpu/coproc",),
 }
 
 DEFAULT_PACKAGE_ROOT = "redpanda_tpu"
