@@ -200,6 +200,11 @@ PROPERTIES: list[Property] = [
         "Bounded in-memory governor decision journal size (GET /v1/governor, rpk debug governor)",
         256, int, _positive,
     ),
+    Property(
+        "coproc_lockwatch",
+        "Debug: wrap the engine's named locks in a lock-order recorder that journals acquisition edges into the governor 'lockwatch' domain (validates the pandalint static acquisition graph); off = no wrapper installed, zero overhead",
+        False, bool,
+    ),
     # --- tiered storage (cloud_storage_* group)
     Property("cloud_storage_enabled", "Enable tiered storage", False, bool),
     Property("cloud_storage_bucket", "S3 bucket", ""),

@@ -36,6 +36,12 @@ class CoprocApi:
         max_batch = _knob("coproc_max_batch_size", 32 * 1024)
         inflight_bytes = _knob("coproc_max_inflight_bytes", 10 * 1024 * 1024)
         flush_ms = _knob("coproc_offset_flush_interval_ms", 300_000)
+        if _knob("coproc_lockwatch", False):
+            # must flip BEFORE the engine is built: per-object locks bind
+            # their recorder (or lack of one) at construction
+            from redpanda_tpu.coproc import lockwatch
+
+            lockwatch.enable()
         # None -> the engine resolves min(4, cores); the property default
         # matches, so an unset config and a default config agree
         self.engine = TpuEngine(

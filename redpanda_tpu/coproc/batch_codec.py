@@ -48,7 +48,9 @@ class Arena:
     MAX_FREE = 8
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        from redpanda_tpu.coproc import lockwatch
+
+        self._lock = lockwatch.wrap(threading.Lock(), "Arena._lock")
         self._free: list[np.ndarray] = []
         self._allocs = 0
         self._reuses = 0

@@ -58,7 +58,7 @@ from redpanda_tpu.ops.pipeline import IN_META, make_packed_pipeline, unpack_resu
 
 logger = logging.getLogger("rptpu.coproc.engine")
 from redpanda_tpu.ops.transforms import TransformSpec
-from redpanda_tpu.coproc import batch_codec, faults, governor, host_pool
+from redpanda_tpu.coproc import batch_codec, faults, governor, host_pool, lockwatch
 from redpanda_tpu.coproc.column_plan import ColumnarPlan, HostPlan, PayloadPlan, plan_spec
 
 
@@ -234,7 +234,7 @@ class _Launch:
         self._mat = None
         self._gather_mat = None
         self._framed = None
-        self._lock = threading.Lock()
+        self._lock = lockwatch.wrap(threading.Lock(), "_Launch._lock")
         self._shards: list[_HostShard] | None = None
         # fault-domain fallbacks: predicate columns / staged payload rows
         # retained until their device result lands, so an exhausted device
@@ -292,7 +292,7 @@ class _Launch:
         exactly like any unrecoverable script failure)."""
         import jax
 
-        staged = self._staged_np
+        staged = self._staged_np  # pandalint: disable=RAC1102 -- the unlocked caller is _dispatch_payload, which runs BEFORE the launch is published to tickets (thread-local construction phase); every harvest-time caller reaches here under _Launch._lock via _materialize_locked
         eng = self.engine
         if staged is None or eng is None:
             raise RuntimeError(
@@ -864,9 +864,19 @@ class TpuEngine:
     """
 
     # process-wide probed decision: the link physics don't change per
-    # engine instance ("device" | "host" | None = not yet probed)
+    # engine instance ("device" | "host" | None = not yet probed).
+    # Two locks with distinct jobs (pandaraces RAC1101 fix): the RUN lock
+    # serializes probe EXECUTION — two concurrent first columnar launches
+    # used to BOTH run the expensive device probe (the PR-3 duplicate-
+    # jit-trace shape); the loser blocks here and adopts the winner's
+    # pick. The short field lock guards the two-field backend/record
+    # write and every read — it is never held across the probe itself,
+    # so stats()/status readers cannot hang behind a wedged 120s device
+    # leg.
     _columnar_backend: str | None = None
     _columnar_probe: dict | None = None
+    _columnar_probe_run_lock = threading.Lock()
+    _columnar_probe_lock = threading.Lock()
 
     def __init__(
         self,
@@ -978,7 +988,9 @@ class TpuEngine:
             # config pin, not a measurement — posture only, no journal
             # entry (a decision the operator made is not an adaptive one)
             self.governor.note_posture(governor.HOST_POOL, "sharded")
-        self._pool_decision_lock = threading.Lock()
+        self._pool_decision_lock = lockwatch.wrap(
+            threading.Lock(), "TpuEngine._pool_decision_lock"
+        )
         # set while a periodic re-calibration is pending, so the next
         # calibration journals itself as a recal rather than a first probe
         self._recal_pending = False
@@ -1007,7 +1019,9 @@ class TpuEngine:
         self._pipelines: dict[int, tuple] = {}  # payload: script_id -> (fn, r_out)
         self._plans: dict[int, object] = {}  # script_id -> execution plan
         self._stats: dict[str, float] = defaultdict(float)
-        self._stats_lock = threading.Lock()
+        self._stats_lock = lockwatch.wrap(
+            threading.Lock(), "TpuEngine._stats_lock"
+        )
         # mask harvester: one daemon thread pays the D2H confirmation round
         # trip per launch while the caller keeps doing host work (~10 ms of
         # tunnel RTT per harvest otherwise lands on the critical path)
@@ -1067,11 +1081,18 @@ class TpuEngine:
                     launch._mask_np = None
                 elif dev is not None:
                     def leg(dev=dev):
+                        t0 = time.perf_counter()
                         faults.inject(faults.HARVEST)
                         # the fetch worker pays the D2H sync; this thread
                         # only coordinates, so a wedged link can no longer
                         # freeze every later launch's mask behind it
-                        return np.asarray(dev)
+                        out = np.asarray(dev)
+                        # success-only adaptive-deadline sample (a raise
+                        # or abandonment never reaches this line)
+                        self.governor.observe_leg(
+                            faults.HARVEST, time.perf_counter() - t0
+                        )
+                        return out
 
                     launch._mask_np = faults.retry_call(
                         leg, self.governor.policy_for(faults.HARVEST),
@@ -1241,6 +1262,10 @@ class TpuEngine:
         out["breakers"] = self.governor.breakers_snapshot()
         out["governor"] = self.governor.snapshot()
         out["arena"] = self._arena.stats()
+        if lockwatch.enabled():
+            # debug mode only: the observed lock-order edge count rides
+            # stats() into /v1/coproc/status, rpk debug coproc and BENCH
+            out["lockwatch"] = lockwatch.snapshot()
         if self._host_pool_probe is not None:
             out["host_pool_probe"] = dict(self._host_pool_probe)
         if self._host_pool_probe_prev is not None:
@@ -1250,10 +1275,21 @@ class TpuEngine:
                 "interval": self._recal_interval if self._probe_enabled else 0,
                 "launches_since": self._launches_since_cal,
             }
-        if TpuEngine._columnar_probe is not None:
-            out["columnar_backend"] = TpuEngine._columnar_backend
-            out["columnar_probe"] = dict(TpuEngine._columnar_probe)
+        with TpuEngine._columnar_probe_lock:  # coherent two-field snapshot
+            backend = TpuEngine._columnar_backend
+            probe = TpuEngine._columnar_probe
+        if probe is not None:
+            out["columnar_backend"] = backend
+            out["columnar_probe"] = dict(probe)
         return out
+
+    @classmethod
+    def sticky_columnar_backend(cls) -> str | None:
+        """The process-wide probed backend, read under the probe lock —
+        call sites take ONE coherent snapshot instead of re-reading the
+        class attribute around a concurrent probe's two-field write."""
+        with cls._columnar_probe_lock:
+            return cls._columnar_backend
 
     @classmethod
     def reset_columnar_probe(cls) -> None:
@@ -1263,8 +1299,9 @@ class TpuEngine:
         tests that construct engines under a different ``force_mode`` or a
         different link must be able to re-measure instead of inheriting a
         stale decision."""
-        cls._columnar_backend = None
-        cls._columnar_probe = None
+        with cls._columnar_probe_lock:
+            cls._columnar_backend = None
+            cls._columnar_probe = None
 
     def reset_arenas(self) -> None:
         """Swap in a fresh harvest scratch arena. The arena is deliberately
@@ -1328,9 +1365,11 @@ class TpuEngine:
                 return exc
 
         pool = self._host_pool
+        with self._pool_decision_lock:  # coherent read vs concurrent recal
+            decision = self._pool_decision
         if (
             pool is not None
-            and self._pool_decision == "sharded"
+            and decision == "sharded"
             and len(jobs) >= _SEAL_MIN_BATCHES
         ):
             # chunks balance by payload bytes: recompression cost tracks
@@ -1410,15 +1449,30 @@ class TpuEngine:
         verdict (harvest/fetch legs), records the success. Every leg
         returns an array, so None is an unambiguous sentinel. This is THE
         shape of a fault-tolerant device interaction; keeping it in one
-        place keeps the breaker verdicts exhaustive."""
+        place keeps the breaker verdicts exhaustive.
+
+        Each SUCCESSFUL attempt's wall time feeds the governor's
+        success-only device-leg histogram — the adaptive-deadline source.
+        The timing wraps the leg itself, so a failed or abandoned attempt
+        records nothing (a wedge that completes late on its abandoned
+        worker still records its true wall time — an honest, rare
+        completion, not a timeout artifact)."""
+        gov = self.governor
+
+        def timed_leg():
+            t0 = time.perf_counter()
+            out = leg()
+            gov.observe_leg(domain, time.perf_counter() - t0)
+            return out
+
         try:
             return faults.retry_call(
-                leg, self.governor.policy_for(domain), domain,
+                timed_leg, gov.policy_for(domain), domain,
                 count=self._stat_add,
             )
         except Exception as exc:
             faults.note_failure(domain, exc, reraise_programming=True)
-            self.governor.breaker_for(domain).record_failure()
+            gov.breaker_for(domain).record_failure()
             return None
 
     def heartbeat(self) -> int:
@@ -1632,51 +1686,54 @@ class TpuEngine:
             # caller thread, so t_sharded ~= t_inline and the pool would be
             # demoted process-wide off a meaningless measurement
             return False
-        if (
-            self._probe_enabled
-            and self._recal_interval > 0
-            and self._pool_decision is not None
-        ):
-            # periodic re-calibration: after N shardable launches the
-            # pinned decision is archived and THIS launch re-measures —
-            # burstable hosts that gained (or lost) capacity re-pin.
-            # Counted under the decision lock: concurrent submitters race
-            # the += and the archive swap otherwise.
-            with self._pool_decision_lock:
-                if self._pool_decision is not None:
-                    self._launches_since_cal += 1
-                    if self._launches_since_cal >= self._recal_interval:
-                        if self._host_pool_probe is not None:
-                            self._host_pool_probe_prev = dict(
-                                self._host_pool_probe
-                            )
-                        self._pool_decision = None
-                        self._launches_since_cal = 0
-                        # the calibration this triggers journals itself as
-                        # a recal (read + cleared in _calibrate_host_pool)
-                        self._recal_pending = True
-        if self._pool_decision is None:
-            # double-checked: concurrent first submits (two script fibers
-            # on the coproc-tick executor) must not calibrate against each
-            # other's measurement load — the contention would depress the
-            # sharded ratio below PROBE_MARGIN on boxes where it truly wins
-            with self._pool_decision_lock:
-                if self._pool_decision is None:
-                    self._calibrate_host_pool(plan, all_batches, counts)
-        if self._pool_decision != "sharded":
+        # ONE locked region owns the recal counter, the calibrate-once
+        # double-check AND the decision read this launch acts on: the old
+        # shape re-read self._pool_decision unlocked after the calibrate
+        # block (pandaraces RAC1101 — a concurrent recal archiving the
+        # probe could flip the value between the calibration and its use).
+        # Serializing concurrent first submits here also keeps them from
+        # calibrating against each other's measurement load, which would
+        # depress the sharded ratio below PROBE_MARGIN on boxes where the
+        # pool truly wins.
+        with self._pool_decision_lock:
+            decision = self._pool_decision
+            if (
+                self._probe_enabled
+                and self._recal_interval > 0
+                and decision is not None
+            ):
+                # periodic re-calibration: after N shardable launches the
+                # pinned decision is archived and THIS launch re-measures —
+                # burstable hosts that gained (or lost) capacity re-pin
+                self._launches_since_cal += 1
+                if self._launches_since_cal >= self._recal_interval:
+                    if self._host_pool_probe is not None:
+                        self._host_pool_probe_prev = dict(
+                            self._host_pool_probe
+                        )
+                    decision = self._pool_decision = None
+                    self._launches_since_cal = 0
+                    # the calibration this triggers journals itself as
+                    # a recal (read + cleared in _calibrate_host_pool)
+                    self._recal_pending = True
+            if decision is None:
+                self._calibrate_host_pool(plan, all_batches, counts)
+                decision = self._pool_decision
+        if decision != "sharded":
             return False  # calibration: no real win on this box
         use_host = None
         if plan.mode == "columnar" and plan.dev_cols:
             if self._mesh is not None:
                 return False  # SPMD predicate stays one launch over the mesh
+            backend = TpuEngine.sticky_columnar_backend()
             if self._force_mode == "columnar_host":
                 use_host = True
             elif self._force_mode == "columnar_device":
                 use_host = False
-            elif TpuEngine._columnar_backend is not None:
-                use_host = TpuEngine._columnar_backend == "host"
+            elif backend is not None:
+                use_host = backend == "host"
                 self.governor.note_posture(
-                    governor.COLUMNAR_BACKEND, TpuEngine._columnar_backend
+                    governor.COLUMNAR_BACKEND, backend
                 )
             else:
                 return False
@@ -1952,24 +2009,33 @@ class TpuEngine:
             )
             self._stat_add("t_extract_pred", time.perf_counter() - t0)
             use_host = self._force_mode == "columnar_host"
+            backend = TpuEngine.sticky_columnar_backend()
             if self._force_mode is None and self._mesh is None:
-                if TpuEngine._columnar_backend is None:
+                if backend is None:
                     if n_pad >= _PROBE_MIN_ROWS:
-                        self._probe_columnar_backend(plan, cols)
-                        use_host = TpuEngine._columnar_backend == "host"
+                        # double-checked under the probe RUN lock:
+                        # concurrent first launches must not each pay the
+                        # device probe (or tear the backend/probe-record
+                        # pair) — the loser waits here and adopts the
+                        # winner's pick. Readers never take this lock.
+                        with TpuEngine._columnar_probe_run_lock:
+                            if TpuEngine.sticky_columnar_backend() is None:
+                                self._probe_columnar_backend(plan, cols)
+                        backend = TpuEngine.sticky_columnar_backend()
+                        use_host = backend == "host"
                     else:
                         # too small to be representative of steady state:
                         # don't pin the process-wide choice on a trickle
                         # batch — numpy is the cheap safe pick at this size
                         use_host = True
                 else:
-                    use_host = TpuEngine._columnar_backend == "host"
-            if TpuEngine._columnar_backend is not None:
+                    use_host = backend == "host"
+            if backend is not None:
                 # this engine runs the sticky process-wide pick (probed by
                 # us just above, or inherited): posture only — the probe
                 # that made the decision already journaled it
                 self.governor.note_posture(
-                    governor.COLUMNAR_BACKEND, TpuEngine._columnar_backend
+                    governor.COLUMNAR_BACKEND, backend
                 )
             breaker_demoted = False
             if not use_host and not self._breaker.allow_device():
@@ -2055,18 +2121,21 @@ class TpuEngine:
             # probe, and the reason lands in coproc_failures_total
             faults.note_failure("columnar_probe", exc)
             t_dev = float("inf")
-        TpuEngine._columnar_backend = (
-            "device" if t_dev * _PROBE_DEVICE_MARGIN < t_host else "host"
-        )
-        TpuEngine._columnar_probe = {
-            "t_host_s": round(t_host, 6),
-            "t_device_s": round(t_dev, 6) if t_dev != float("inf") else None,
-            "margin": _PROBE_DEVICE_MARGIN,
-            "chosen": TpuEngine._columnar_backend,
-        }
+        chosen = "device" if t_dev * _PROBE_DEVICE_MARGIN < t_host else "host"
+        # the two-field publish is the only region under the SHORT field
+        # lock — readers (stats, dispatch snapshots) contend with a dict
+        # assignment, never with the 120s probe envelope above
+        with TpuEngine._columnar_probe_lock:
+            TpuEngine._columnar_backend = chosen
+            TpuEngine._columnar_probe = {
+                "t_host_s": round(t_host, 6),
+                "t_device_s": round(t_dev, 6) if t_dev != float("inf") else None,
+                "margin": _PROBE_DEVICE_MARGIN,
+                "chosen": chosen,
+            }
         self.governor.record(
             governor.COLUMNAR_BACKEND,
-            TpuEngine._columnar_backend,
+            chosen,
             "measured predicate leg: host "
             f"{t_host * 1e3:.3f} ms vs device "
             + ("unavailable" if t_dev == float("inf")

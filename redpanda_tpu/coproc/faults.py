@@ -319,7 +319,9 @@ class CircuitBreaker:
             else self.cooldown_s
         )
         self._clock = clock
-        self._lock = threading.Lock()
+        from redpanda_tpu.coproc import lockwatch
+
+        self._lock = lockwatch.wrap(threading.Lock(), "CircuitBreaker._lock")
         self._state = STATE_CLOSED
         self._consecutive = 0
         self._opened_at = 0.0

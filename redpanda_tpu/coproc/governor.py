@@ -62,20 +62,25 @@ BREAKER = "breaker"
 HARVEST_PATH = "harvest_path"
 SHARDED_SEAL = "sharded_seal"
 DEADLINE = "deadline"
+# coproc_lockwatch: each newly observed runtime lock-order edge journals
+# here (coproc/lockwatch.py) — the dynamic validation trail of the
+# pandaraces static acquisition graph
+LOCKWATCH = "lockwatch"
 
 DOMAINS = (
     HOST_POOL, COLUMNAR_BACKEND, DEVICE_LZ4, BREAKER, HARVEST_PATH,
-    SHARDED_SEAL, DEADLINE,
+    SHARDED_SEAL, DEADLINE, LOCKWATCH,
 )
 
-# fault domains that get their own breaker + adaptive deadline, and the
-# coproc_stage_latency_us stage whose observed tail drives each deadline
+# fault domains that get their own breaker + adaptive deadline. Each
+# deadline derives from the domain's SUCCESS-ONLY device-leg histogram
+# (coproc_device_leg_latency_us{domain=}, fed by Governor.observe_leg at
+# every successful leg completion) — NOT from the fetch-stage
+# coproc_stage_latency_us histogram: the stage clock keeps running
+# through abandoned attempts and envelope waits, so a burst of timeouts
+# used to inflate the very tail the next deadline was derived from (the
+# 8x cap bounded that feedback; the success-only source removes it).
 BREAKER_DOMAINS = (faults.DEVICE_DISPATCH, faults.MASK_FETCH, faults.HARVEST)
-_DOMAIN_STAGE = {
-    faults.DEVICE_DISPATCH: "dispatch",
-    faults.MASK_FETCH: "fetch",
-    faults.HARVEST: "fetch",
-}
 
 # Adaptive-deadline shape: derived = clamp(margin * p99.9, floor, cap_x *
 # floor). The cap bounds every waiter sized off envelope_s() (the tick
@@ -191,6 +196,14 @@ class DecisionJournal:
 # The process journal (metrics-registry posture: one per process).
 journal = DecisionJournal()
 
+# Serializes device-leg histogram records PROCESS-wide: the default
+# deadline source (probes.coproc_device_leg_hist) is one histogram per
+# domain shared by every engine's governor, so a per-Governor lock would
+# let two engines' legs interleave the same HdrHist read-modify-write —
+# exactly the HST1001 contract. Leg completions are per-launch cadence;
+# one module lock is plenty.
+_leg_record_lock = threading.Lock()
+
 # coproc_governor_decisions_total{domain,verdict}: lazy check-then-create
 # under a lock, same reason as probes.coproc_failure_counter.
 _decision_counters: dict[tuple[str, str], Counter] = {}
@@ -268,15 +281,19 @@ class Governor:
         self._margin = max(1.0, float(deadline_margin))
         self._cap_x = max(1.0, float(deadline_cap_x))
         self._min_samples = max(1, int(deadline_min_samples))
-        # injectable histogram source: stage name -> object with
-        # .count/.percentile (the process registry's HdrHist by default;
-        # tests inject their own so the derivation is provable without
-        # polluting the live series)
+        # injectable histogram source: FAULT DOMAIN -> object with
+        # .count/.percentile/.record (the process registry's success-only
+        # device-leg HdrHist by default; tests inject their own so the
+        # derivation is provable without polluting the live series).
+        # observe_leg records into the same source, so injected tests see
+        # a closed loop.
         self._stage_hist = stage_hist or (
-            lambda stage: probes.coproc_stage_hist(stage).hist
+            lambda domain: probes.coproc_device_leg_hist(domain).hist
         )
         self.engine_tag = engine_tag or f"engine-{next(_engine_tags)}"
-        self._lock = threading.Lock()
+        from redpanda_tpu.coproc import lockwatch
+
+        self._lock = lockwatch.wrap(threading.Lock(), "Governor._lock")
         # benches/tests inject a private journal so scratch governors never
         # write the live process journal or its counters
         self._journal = journal_override if journal_override is not None else journal
@@ -477,10 +494,22 @@ class Governor:
         }
 
     # ------------------------------------------------------------ deadlines
+    def observe_leg(self, fault_domain: str, dt_s: float) -> None:
+        """Record one SUCCESSFUL device-leg wall time — the only samples
+        the adaptive deadline derives from. Abandoned attempts never call
+        this (the leg raised or never returned), so a burst of timeouts
+        cannot inflate the tail that sizes the next deadline. Locked on
+        the MODULE lock: the default histograms are process-wide per
+        domain (shared across engines), and legs complete on fetch
+        workers, the harvester and the tick executor concurrently."""
+        hist = self._stage_hist(fault_domain)
+        with _leg_record_lock:
+            hist.record(int(dt_s * 1e6))
+
     def deadline_s(self, fault_domain: str) -> float:
         """Effective per-attempt deadline for one device fault domain.
 
-        ``clamp(margin * observed_stage_p99.9, floor, cap_x * floor)``;
+        ``clamp(margin * observed_leg_p99.9, floor, cap_x * floor)``;
         the static floor is the fallback below ``min_samples`` and the
         derivation may only RAISE the deadline above it. Recomputed only
         after DEADLINE_RECOMPUTE_SAMPLES new observations (the common path
@@ -498,11 +527,12 @@ class Governor:
                 fault_domain, st["stage"], hist, hist.count
             )
         floor = self._policy.deadline_s
-        stage = _DOMAIN_STAGE.get(fault_domain)
-        if not self._adaptive or stage is None:
+        if not self._adaptive or fault_domain not in BREAKER_DOMAINS:
             return floor
-        hist = self._stage_hist(stage)
-        return self._recompute_deadline(fault_domain, stage, hist, hist.count)
+        hist = self._stage_hist(fault_domain)
+        return self._recompute_deadline(
+            fault_domain, fault_domain, hist, hist.count
+        )
 
     def _recompute_deadline(self, fault_domain, stage, hist, count) -> float:
         floor = self._policy.deadline_s
@@ -552,13 +582,14 @@ class Governor:
             self._emit(
                 DEADLINE,
                 verdict,
-                f"{fault_domain}: stage '{stage}' p99.9 = {p999_us} us over "
-                f"{count} samples -> deadline {derived * 1e3:.1f} ms "
+                f"{fault_domain}: success-only device-leg p99.9 = "
+                f"{p999_us} us over {count} samples -> deadline "
+                f"{derived * 1e3:.1f} ms "
                 f"(floor {floor * 1e3:.1f} ms, margin {self._margin}x, "
                 f"cap {cap * 1e3:.1f} ms)",
                 {
                     "fault_domain": fault_domain,
-                    "stage": stage,
+                    "source": f"coproc_device_leg_latency_us[{stage}]",
                     "p999_us": int(p999_us),
                     "samples": int(count),
                     "floor_ms": round(floor * 1e3, 3),

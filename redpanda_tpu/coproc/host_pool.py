@@ -138,9 +138,11 @@ class HostStagePool:
     """
 
     def __init__(self, workers: int):
+        from redpanda_tpu.coproc import lockwatch
+
         self.workers = int(workers)
         self._executor: ThreadPoolExecutor | None = None
-        self._lock = threading.Lock()
+        self._lock = lockwatch.wrap(threading.Lock(), "HostStagePool._lock")
 
     def _ensure_executor(self) -> ThreadPoolExecutor:
         # locked check-then-create: concurrent first launches must not

@@ -71,10 +71,10 @@ class HoneyBadger:
         self.wedge_max_s = 2.0
 
     def enable(self) -> None:
-        self._enabled = True
+        self._enabled = True  # pandalint: disable=RAC1101 -- benign monotonic bool: probe sites read one attribute lock-free BY DESIGN (hbadger.h's compiled-out posture); arming happens before the faulted traffic, and a racy read costs one missed/extra injection, never corruption
 
     def disable(self) -> None:
-        self._enabled = False
+        self._enabled = False  # pandalint: disable=RAC1101 -- same single-flag design as enable(); count-limited claims take _claim_lock, the flag itself is a benign gate
         for m in self._modules.values():
             m.armed.clear()
             m.counts.clear()

@@ -124,7 +124,7 @@ class Crc32c:
         self._state = 0xFFFFFFFF
 
     def extend(self, data) -> "Crc32c":
-        self._state = crc32c_update(self._state, data)
+        self._state = crc32c_update(self._state, data)  # pandalint: disable=RAC1101 -- Crc32c instances are per-call locals (built, extended, read, dropped inside one function); the multi-context affinity comes from callers in different contexts each using their OWN instance
         return self
 
     def extend_le(self, fmt: str, *values) -> "Crc32c":

@@ -9,6 +9,7 @@ live state (partition counts, HWMs) is sampled at scrape time.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -23,9 +24,18 @@ class Counter:
     help: str
     labels: tuple[tuple[str, str], ...] = ()
     value: float = 0
+    # `value += n` is a read-modify-write across bytecodes; counters are
+    # shared by the harvester daemon, fetch workers, host-pool shards and
+    # the tick executor, and unlocked concurrent incs LOSE updates
+    # (pandaraces RAC1101). Scrape-side reads of the single float stay
+    # lock-free: a read observes one consistent published value.
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def inc(self, n: float = 1) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
 
 @dataclass

@@ -117,6 +117,10 @@ coproc_fallback_rows = registry.counter(
     "coproc_fallback_rows_total",
     "Records whose transform stages re-executed on the pure-host fallback",
 )
+coproc_lockwatch_edges = registry.counter(
+    "coproc_lockwatch_edges_total",
+    "Distinct lock-order edges observed by the coproc_lockwatch recorder",
+)
 
 # Breaker-state gauges moved to the governor (coproc/governor.py): they
 # are per-DOMAIN labeled series (coproc_breaker_state{domain=...}) owned by
@@ -151,6 +155,35 @@ coproc_host_pool_busy = registry.gauge(
     lambda: float(_host_pool_busy),
     "Host-stage pool workers currently running a shard task",
 )
+
+# Success-only device-leg latency per fault domain — THE adaptive-deadline
+# source (governor.observe_leg records a sample only when a leg COMPLETES;
+# abandoned/timed-out attempts contribute nothing, so timeout bursts can't
+# inflate the tail the next deadline derives from the way the fetch-stage
+# histogram could).
+_device_leg: dict[str, Histogram] = {}
+_device_leg_lock = threading.Lock()
+
+
+def coproc_device_leg_hist(domain: str) -> Histogram:
+    """Histogram for one fault domain's successful device legs. Locked
+    check-then-create (same rationale as coproc_stage_hist); callers
+    serialize record() themselves (the governor records under its own
+    lock)."""
+    h = _device_leg.get(domain)
+    if h is None:
+        with _device_leg_lock:
+            h = _device_leg.get(domain)
+            if h is None:
+                h = registry.histogram(
+                    "coproc_device_leg_latency_us",
+                    "Successful device-leg wall time per fault domain "
+                    "(adaptive-deadline source; success-only)",
+                    domain=domain,
+                )
+                _device_leg[domain] = h
+    return h
+
 
 _coproc_stage: dict[str, Histogram] = {}
 _coproc_stage_lock = threading.Lock()
@@ -313,6 +346,7 @@ __all__ = [
     "reset_exemplars",
     "coproc_breaker_trips",
     "coproc_d2h_bytes",
+    "coproc_device_leg_hist",
     "coproc_failure_counter",
     "coproc_fallback_rows",
     "coproc_h2d_bytes",
@@ -320,6 +354,7 @@ __all__ = [
     "coproc_harvest_padded",
     "coproc_host_pool_busy",
     "coproc_launch_rows_hist",
+    "coproc_lockwatch_edges",
     "coproc_retries_total",
     "coproc_shard_rows_hist",
     "coproc_stage_hist",

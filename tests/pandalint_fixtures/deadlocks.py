@@ -1,0 +1,35 @@
+"""Fixture: lock-order cycle (DLK1201) + unbounded blocking under a
+lock (DLK1202).
+
+`forward` nests a then b; `backward` nests b then a — the global
+acquisition graph gains the cycle a -> b -> a, flagged at both inner
+acquisitions. `stall` blocks without a timeout while holding a lock;
+the bounded wait and the lock-free join stay clean.
+"""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._done = threading.Event()
+        self._t = threading.Thread(target=self.forward)
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+
+    def stall(self):
+        with self._a_lock:
+            self._done.wait()
+            self._done.wait(1.0)
+            self._t.join()
+        self._t.join()

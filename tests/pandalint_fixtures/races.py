@@ -1,0 +1,41 @@
+"""Fixture: cross-context attribute races (RAC1101/RAC1102).
+
+`serve` runs on the event loop (async def); `work` is seeded onto the
+executor by the run_in_executor spawn site. `_mode` is written from both
+contexts with no lock (RAC1101 at each write); `_probe` is written under
+the lock but read bare (RAC1102 at the read); `_count` is locked on both
+sides and must NOT flag; `_other` is written under one lock and read
+under a DIFFERENT one — one defect, blamed once at the write (RAC1101),
+never again at the read.
+"""
+
+import asyncio
+import threading
+
+
+class Shared:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._mode = "idle"
+        self._probe = None
+        self._count = 0
+        self._other = 0
+
+    async def serve(self):
+        loop = asyncio.get_event_loop()
+        self._mode = "serving"
+        with self._lock:
+            self._probe = {"speedup": 2.0}
+            self._count += 1
+            self._other = 1
+        loop.run_in_executor(None, self.work)
+
+    def work(self):
+        self._mode = "working"
+        probe = self._probe
+        with self._lock:
+            self._count += 1
+        with self._b_lock:
+            other = self._other
+        return probe, other

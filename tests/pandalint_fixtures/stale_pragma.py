@@ -1,0 +1,17 @@
+"""Fixture: stale suppressions (SUP002).
+
+The first pragma still silences a live RCT101 finding; the second
+suppresses a rule that no longer fires on its line and is itself
+reported.
+"""
+
+import time
+
+
+async def genuinely_slow():
+    time.sleep(1)  # pandalint: disable=RCT101 -- live suppression: the sleep is the fixture's point
+
+
+async def cleaned_up_long_ago():
+    x = 1  # pandalint: disable=RCT101 -- nothing blocks here any more
+    return x

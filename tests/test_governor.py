@@ -280,12 +280,14 @@ class FakeClock:
 
 
 def _gov(floor_s=0.05, **kw):
-    hists = {"dispatch": HdrHist(), "fetch": HdrHist()}
+    # the injected source is keyed by FAULT DOMAIN since the deadline
+    # moved to the success-only device-leg histograms (one per domain)
+    hists = {d: HdrHist() for d in governor.BREAKER_DOMAINS}
     kw.setdefault("deadline_min_samples", 64)
     kw.setdefault("deadline_margin", 4.0)
     gov = governor.Governor(
         fault_policy=faults.FaultPolicy(deadline_s=floor_s, retries=1),
-        stage_hist=lambda s: hists[s],
+        stage_hist=lambda d: hists[d],
         register_gauges=False,
         clock=FakeClock(),
         **kw,
@@ -296,7 +298,7 @@ def _gov(floor_s=0.05, **kw):
 def test_adaptive_deadline_falls_back_to_floor_below_min_samples():
     gov, hists = _gov()
     for _ in range(20):  # < min_samples
-        hists["dispatch"].record(5_000_000)
+        hists[faults.DEVICE_DISPATCH].record(5_000_000)
     assert gov.deadline_s(faults.DEVICE_DISPATCH) == 0.05
     assert gov.policy_for(faults.DEVICE_DISPATCH).deadline_s == 0.05
 
@@ -304,7 +306,7 @@ def test_adaptive_deadline_falls_back_to_floor_below_min_samples():
 def test_adaptive_deadline_tracks_observed_p999():
     gov, hists = _gov()
     for _ in range(1000):
-        hists["dispatch"].record(30_000)  # 30ms tail
+        hists[faults.DEVICE_DISPATCH].record(30_000)  # 30ms tail
     d = gov.deadline_s(faults.DEVICE_DISPATCH)
     # margin 4x over a ~30ms p99.9 (log-bucket upper bound <= 19% error):
     # well above the 50ms floor, nowhere near the 8x cap
@@ -324,7 +326,7 @@ def test_adaptive_deadline_tracks_observed_p999():
 def test_adaptive_deadline_never_undercuts_static_floor():
     gov, hists = _gov()
     for _ in range(5000):
-        hists["fetch"].record(10)  # 10us tail: margin * p99.9 << floor
+        hists[faults.HARVEST].record(10)  # 10us tail: margin * p99.9 << floor
     assert gov.deadline_s(faults.MASK_FETCH) == 0.05
     assert gov.deadline_s(faults.HARVEST) == 0.05
     assert governor.journal.entries(domain=governor.DEADLINE) == []
@@ -333,7 +335,7 @@ def test_adaptive_deadline_never_undercuts_static_floor():
 def test_adaptive_deadline_caps_at_multiple_of_floor():
     gov, hists = _gov()
     for _ in range(1000):
-        hists["dispatch"].record(60_000_000)  # 60s tail (wedge-polluted)
+        hists[faults.DEVICE_DISPATCH].record(60_000_000)  # 60s tail (wedge-polluted)
     d = gov.deadline_s(faults.DEVICE_DISPATCH)
     assert d == pytest.approx(8.0 * 0.05)  # deadline_cap_x * floor
     (entry,) = governor.journal.entries(domain=governor.DEADLINE)
@@ -343,7 +345,7 @@ def test_adaptive_deadline_caps_at_multiple_of_floor():
 def test_adaptive_deadline_disabled_pins_static_knob():
     gov, hists = _gov(adaptive_deadline=False)
     for _ in range(1000):
-        hists["dispatch"].record(30_000_000)
+        hists[faults.DEVICE_DISPATCH].record(30_000_000)
     assert gov.deadline_s(faults.DEVICE_DISPATCH) == 0.05
 
 
@@ -357,7 +359,7 @@ def test_envelope_bound_tracks_max_issued_deadline():
     gov, hists = _gov()
     assert gov.envelope_bound_s(faults.HARVEST) == pytest.approx(static_env)
     for _ in range(1000):
-        hists["fetch"].record(60_000_000)  # raise to the cap
+        hists[faults.HARVEST].record(60_000_000)  # raise to the cap
     raised_env = gov.policy_for(faults.HARVEST).envelope_s()
     assert raised_env > static_env
     bound = gov.envelope_bound_s(faults.HARVEST)
@@ -365,7 +367,7 @@ def test_envelope_bound_tracks_max_issued_deadline():
     # monotonic: a later derivation dropping back toward the floor never
     # shrinks the bound below a deadline that was already handed out
     for _ in range(5000):
-        hists["fetch"].record(10)
+        hists[faults.HARVEST].record(10)
     gov.policy_for(faults.HARVEST)
     assert gov.envelope_bound_s(faults.HARVEST) == bound
     # the pacemaker backstop derives from the same bounds
@@ -383,24 +385,99 @@ def test_adaptive_raise_grows_breaker_probe_timeout():
     b = gov.breaker_for(faults.HARVEST)
     before = b.probe_timeout_s
     for _ in range(1000):
-        hists["fetch"].record(60_000_000)  # raise toward the cap
+        hists[faults.HARVEST].record(60_000_000)  # raise toward the cap
     assert gov.policy_for(faults.HARVEST).envelope_s() > 0
     assert b.probe_timeout_s >= 2.0 * gov.policy_for(faults.HARVEST).envelope_s()
     assert b.probe_timeout_s >= before
 
 
+def test_deadline_source_ignores_timeout_inflated_stage_histogram():
+    """ISSUE 9 satellite (ROADMAP item 5 follow-on): the adaptive
+    deadline derives from the SUCCESS-ONLY device-leg histogram, not the
+    fetch-stage coproc_stage_latency_us — whose clock keeps running
+    through abandoned attempts and envelope waits, so a burst of
+    timeouts used to inflate the very tail the next deadline derived
+    from. Injected timeout-inflated stage samples must leave the
+    deadline at the floor; successful legs raise it; the 8x cap stays."""
+    from redpanda_tpu.observability import probes
+
+    # wiring: the DEFAULT source is the per-domain device-leg histogram,
+    # not the fetch/dispatch stage histograms (asserted on the resolved
+    # objects so the claim survives whatever other tests recorded into
+    # the process-global series)
+    gov = governor.Governor(
+        fault_policy=faults.FaultPolicy(deadline_s=0.05, retries=1),
+        register_gauges=False,
+        journal_override=governor.DecisionJournal(),
+    )
+    for domain in governor.BREAKER_DOMAINS:
+        src = gov._stage_hist(domain)
+        assert src is probes.coproc_device_leg_hist(domain).hist
+        assert src is not probes.coproc_stage_hist("fetch").hist
+        assert src is not probes.coproc_stage_hist("dispatch").hist
+
+    # behavior, on an injected source: timeout-scale samples landing in
+    # the STAGE histograms move nothing (they are simply not consulted)...
+    gov2, hists = _gov()
+    stage_fetch = probes.coproc_stage_hist("fetch").hist
+    stage_dispatch = probes.coproc_stage_hist("dispatch").hist
+    for _ in range(1000):
+        stage_fetch.record(60_000_000)     # 60s abandoned-wait artifacts
+        stage_dispatch.record(60_000_000)
+    assert gov2.deadline_s(faults.MASK_FETCH) == 0.05
+    assert gov2.deadline_s(faults.HARVEST) == 0.05
+    assert gov2.deadline_s(faults.DEVICE_DISPATCH) == 0.05
+
+    # ...while successful legs ARE the source: observe_leg records into
+    # the same histogram the derivation reads (closed loop)
+    for _ in range(1000):
+        gov2.observe_leg(faults.MASK_FETCH, 0.030)  # healthy 30ms legs
+    assert hists[faults.MASK_FETCH].count == 1000
+    d = gov2.deadline_s(faults.MASK_FETCH)
+    assert 0.1 <= d <= 0.2  # margin 4x over ~30ms, above the 50ms floor
+    # the 8x-of-floor cap survives the source change
+    for _ in range(2000):
+        gov2.observe_leg(faults.MASK_FETCH, 60.0)
+    assert gov2.deadline_s(faults.MASK_FETCH) == pytest.approx(8.0 * 0.05)
+
+
+def test_engine_device_legs_feed_success_only_histogram():
+    """A real device-leg success records exactly one sample into the
+    domain's device-leg histogram; an injected failure records none."""
+    from redpanda_tpu.observability import probes
+
+    engine = _engine(
+        force_mode="columnar_device", launch_retries=0,
+        device_deadline_ms=10_000, adaptive_deadline=False,
+    )
+    hist = probes.coproc_device_leg_hist(faults.DEVICE_DISPATCH).hist
+    before = hist.count
+    engine.process_batch(_req())
+    after_success = hist.count
+    assert after_success > before
+    honey_badger.enable()
+    honey_badger.set_exception(faults.MODULE, faults.DEVICE_DISPATCH)
+    try:
+        engine.process_batch(_req())
+    finally:
+        honey_badger.unset(faults.MODULE, faults.DEVICE_DISPATCH)
+        honey_badger.disable()
+    # the faulted leg raised before completing: no new success sample
+    assert hist.count == after_success
+
+
 def test_adaptive_deadline_recomputes_after_new_samples():
     gov, hists = _gov()
     for _ in range(1000):
-        hists["dispatch"].record(30_000)
+        hists[faults.DEVICE_DISPATCH].record(30_000)
     d1 = gov.deadline_s(faults.DEVICE_DISPATCH)
     # fewer than DEADLINE_RECOMPUTE_SAMPLES new observations: cached
     for _ in range(governor.DEADLINE_RECOMPUTE_SAMPLES - 1):
-        hists["dispatch"].record(300_000)
+        hists[faults.DEVICE_DISPATCH].record(300_000)
     assert gov.deadline_s(faults.DEVICE_DISPATCH) == d1
     # enough new tail mass shifts p99.9 up and the deadline follows
     for _ in range(1000):
-        hists["dispatch"].record(80_000)
+        hists[faults.DEVICE_DISPATCH].record(80_000)
     d2 = gov.deadline_s(faults.DEVICE_DISPATCH)
     assert d2 > d1
 

@@ -245,6 +245,100 @@ def test_iobuf_rules_exact_lines():
     ]
 
 
+def test_races_rules_exact_lines():
+    """RAC1101 at both unlocked cross-context writes and at the
+    disjoint-lock-pair write (blamed ONCE, never again at its read),
+    RAC1102 at the bare read of the locked-write attribute; the
+    dual-locked counter and the locked probe write stay clean."""
+    got = _active(_lint(os.path.join(FIXTURES, "races.py")))
+    assert got == [
+        ("RAC1101", 27),  # loop-side unlocked write of _mode
+        ("RAC1101", 31),  # _other: write under _lock vs _b_lock read —
+        #                   one defect, one finding, at the write
+        ("RAC1101", 35),  # executor-side unlocked write of _mode
+        ("RAC1102", 36),  # torn read of _probe (writes are locked)
+    ]
+
+
+def test_races_scope_is_package_wide(tmp_path):
+    """Execution contexts and shared attributes exist anywhere in the
+    broker; a race injected in ANY subtree must fail the gate."""
+    for sub in ("raft", "kafka", "storage"):
+        pkg = tmp_path / "redpanda_tpu" / sub
+        pkg.mkdir(parents=True)
+        dst = pkg / "racy.py"
+        shutil.copyfile(os.path.join(FIXTURES, "races.py"), dst)
+        report = LintEngine(Config()).lint_file(
+            str(dst), f"redpanda_tpu/{sub}/racy.py"
+        )
+        assert any(f.rule.startswith("RAC") for f in report.findings), sub
+
+
+def test_deadlock_rules_exact_lines():
+    """DLK1201 at both inner acquisitions of the a/b cycle; DLK1202 at
+    the unbounded wait and join under the lock — the bounded wait and
+    the lock-free join stay clean."""
+    got = _active(_lint(os.path.join(FIXTURES, "deadlocks.py")))
+    assert got == [
+        ("DLK1201", 22),  # a -> b edge
+        ("DLK1201", 27),  # b -> a edge completes the cycle
+        ("DLK1202", 32),  # Event.wait() with no timeout under _a_lock
+        ("DLK1202", 34),  # Thread.join() with no timeout under _a_lock
+    ]
+
+
+def test_race_affinity_sees_through_helper_chains(tmp_path):
+    """The lockset at an access includes the caller's held locks (entry
+    lockset): a write reached only via a helper called under the lock
+    must not flag."""
+    src = (
+        "import asyncio\n"
+        "import threading\n\n\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._state = 0\n\n"
+        "    async def a_side(self):\n"
+        "        with self._lock:\n"
+        "            self._bump()\n"
+        "        asyncio.get_event_loop().run_in_executor(None, self.b_side)\n\n"
+        "    def b_side(self):\n"
+        "        with self._lock:\n"
+        "            self._bump()\n\n"
+        "    def _bump(self):\n"
+        "        self._state += 1\n"
+    )
+    p = tmp_path / "chain.py"
+    p.write_text(src)
+    assert _active(_lint(str(p))) == []
+    # ...and removing one caller's lock makes the helper's write racy
+    p2 = tmp_path / "chain_bad.py"
+    p2.write_text(src.replace(
+        "    def b_side(self):\n        with self._lock:\n            self._bump()\n",
+        "    def b_side(self):\n        self._bump()\n",
+    ))
+    got = _active(_lint(str(p2)))
+    assert ("RAC1101", 19) in got  # the write inside _bump
+
+
+def test_stale_suppression_reported():
+    findings = _lint(os.path.join(FIXTURES, "stale_pragma.py"))
+    got = _active(findings)
+    assert got == [("SUP002", 16)]
+    # the live pragma still suppresses and is NOT stale
+    assert [(f.rule, f.line) for f in findings if f.suppressed] == [
+        ("RCT101", 12)
+    ]
+
+
+def test_stale_suppression_skipped_under_rule_filter():
+    """A --rules subset must not make every other pragma look stale."""
+    findings = _lint(
+        os.path.join(FIXTURES, "stale_pragma.py"), rules={"RCT102"}
+    )
+    assert not any(f.rule == "SUP002" for f in findings)
+
+
 # --------------------------------------------------------------- suppression
 def test_reasoned_pragmas_silence_findings():
     findings = _lint(os.path.join(FIXTURES, "suppressed_ok.py"))
@@ -396,6 +490,140 @@ def test_cli_usage_errors(capsys):
     assert pandalint_main([]) == 2
     assert pandalint_main(["/nonexistent/path"]) == 2
     assert pandalint_main(["--rules", "NOPE99", FIXTURES]) == 2
+
+
+def test_cli_sarif_matches_golden(capsys):
+    """SARIF output is a committed contract: CI annotation pipelines
+    parse it, so any change must be a deliberate golden-file update."""
+    cwd = os.getcwd()
+    os.chdir(REPO)
+    try:
+        rc = pandalint_main(
+            [
+                os.path.join("tests", "pandalint_fixtures", "copy_loop.py"),
+                "--format",
+                "sarif",
+                "--no-cache",
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert rc == 0
+    got = json.loads(capsys.readouterr().out)
+    with open(
+        os.path.join(FIXTURES, "golden", "copy_loop.sarif.json"),
+        encoding="utf-8",
+    ) as fh:
+        want = json.load(fh)
+    assert got == want
+    # structural sanity independent of the golden bytes
+    run = got["runs"][0]
+    assert run["tool"]["driver"]["name"] == "pandalint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert set(rule_catalog()) <= rule_ids
+    for res in run["results"]:
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("copy_loop.py")
+        assert loc["region"]["startLine"] >= 1
+
+
+def test_cli_list_suppressions(capsys):
+    rc = pandalint_main(
+        [
+            os.path.join(FIXTURES, "stale_pragma.py"),
+            os.path.join(FIXTURES, "suppressed_ok.py"),
+            "--list-suppressions",
+            "--no-cache",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "[STALE]" in out
+    assert "live suppression: the sleep is the fixture's point" in out
+    # the inventory counts every pragma, stale ones flagged
+    assert "1 stale" in out
+
+
+# --------------------------------------------------------------- speed
+def test_cache_roundtrip_and_invalidation(tmp_path):
+    """Second run over unchanged bytes serves per-file findings from the
+    cache (identical results, from_cache set); an edit invalidates only
+    that file."""
+    from tools.pandalint.engine import LintEngine as Eng
+
+    src_dir = tmp_path / "pkg"
+    src_dir.mkdir()
+    for name in ("reactor_stall.py", "lost_task.py", "copy_loop.py"):
+        shutil.copyfile(os.path.join(FIXTURES, name), src_dir / name)
+    cache = tmp_path / "cache.json"
+
+    eng = Eng(cache_path=str(cache))
+    first, states1 = eng.lint_paths_with_states([str(src_dir)])
+    assert not any(s.from_cache for s in states1)
+    assert cache.exists()
+
+    eng2 = Eng(cache_path=str(cache))
+    second, states2 = eng2.lint_paths_with_states([str(src_dir)])
+    assert all(s.from_cache for s in states2 if s.ctx is not None)
+    key = lambda rs: [
+        (r.path.rsplit("/", 1)[-1], f.rule, f.line, f.fingerprint())
+        for r in rs
+        for f in r.findings
+    ]
+    assert key(first) == key(second)
+
+    # edit one file: only it re-lints, and its new finding appears
+    mutated = src_dir / "reactor_stall.py"
+    mutated.write_text(
+        mutated.read_text() + "\n\nasync def fresh():\n    time.sleep(1)\n"
+    )
+    eng3 = Eng(cache_path=str(cache))
+    third, states3 = eng3.lint_paths_with_states([str(src_dir)])
+    by_name = {s.rel.rsplit("/", 1)[-1]: s for s in states3}
+    assert not by_name["reactor_stall.py"].from_cache
+    assert by_name["copy_loop.py"].from_cache
+    fresh = [
+        (f.rule, f.line)
+        for r in third
+        for f in r.findings
+        if r.path.endswith("reactor_stall.py")
+    ]
+    assert ("RCT101", 26) in fresh
+
+
+def test_parallel_jobs_match_serial(tmp_path):
+    """--jobs is a pure speed knob: findings must be byte-identical to
+    the serial path (the pool re-runs only per-file checkers; program
+    checkers always run in-process)."""
+    from tools.pandalint.engine import LintEngine as Eng
+
+    serial = Eng(jobs=1).lint_paths([FIXTURES])
+    parallel = Eng(jobs=4).lint_paths([FIXTURES])
+    key = lambda rs: [
+        (r.path, f.rule, f.line, f.col, f.suppressed, f.fingerprint())
+        for r in rs
+        for f in r.findings
+    ]
+    assert key(serial) == key(parallel)
+
+
+def test_package_single_run_wall_time_budget():
+    """The gate runs in every tier-1: a whole-package single run (cold
+    cache, default jobs) must stay well inside the budget — catches an
+    accidentally quadratic checker or analysis blow-up."""
+    import time
+
+    from tools.pandalint.engine import LintEngine as Eng, default_jobs
+
+    cwd = os.getcwd()
+    os.chdir(REPO)
+    t0 = time.perf_counter()
+    try:
+        Eng(jobs=default_jobs()).lint_paths(["redpanda_tpu/"])
+    finally:
+        os.chdir(cwd)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 90.0, f"package lint took {elapsed:.1f}s (budget 90s)"
 
 
 def test_module_entrypoint_runs():

@@ -17,6 +17,8 @@ from tools.pandalint.checkers.locks import LockRpcChecker
 from tools.pandalint.checkers.sleeps import SleepAsyncChecker
 from tools.pandalint.checkers.excepts import BareExceptChecker
 from tools.pandalint.checkers.hdrrecord import HdrRecordChecker
+from tools.pandalint.checkers.races import RaceChecker
+from tools.pandalint.checkers.deadlocks import DeadlockChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     ReactorChecker,
@@ -31,6 +33,8 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     SleepAsyncChecker,
     BareExceptChecker,
     HdrRecordChecker,
+    RaceChecker,
+    DeadlockChecker,
 )
 
 

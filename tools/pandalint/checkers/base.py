@@ -46,12 +46,24 @@ class FileContext:
 
 
 class Checker:
-    """Base class: subclasses set `name` + `rules` and implement check()."""
+    """Base class: subclasses set `name` + `rules` and implement check().
+
+    A checker with ``program_level = True`` implements ``check_program``
+    instead: it sees the WHOLE parsed program (every file of the run) plus
+    the affinity/lock analyses, and yields ``(relpath, RawFinding)`` pairs
+    — the executor-affinity and lock-order rules reason about spawn sites
+    in one file and the functions they execute in another."""
 
     name: str = ""
     rules: dict[str, str] = {}
+    program_level: bool = False
 
     def check(self, ctx: FileContext) -> Iterator[RawFinding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def check_program(self, program, locks):  # pragma: no cover
+        """program: affinity.Program; locks: lockgraph.LockGraph.
+        Yields (relpath, RawFinding)."""
         raise NotImplementedError
 
 

@@ -1,0 +1,95 @@
+"""Lock-order analysis (DLK12xx) over the global acquisition graph.
+
+DLK1201 — a nested lock acquisition that completes a CYCLE in the
+program-wide acquisition-order graph (lockgraph.py: lexical nestings plus
+``held -> may_acquire(callee)`` edges through resolved calls). Two
+threads entering a cycle from different ends deadlock; with the coproc
+tick deadline and raft election timers above them, even a *near* miss is
+a latency cliff. Only unambiguous edges (lock identity pinned to one
+owner, call resolution unique) participate — a false cycle from smeared
+``_lock`` names would breed pragmas and erode trust in the real ones.
+
+DLK1202 — unbounded blocking while holding a lock: ``.join()`` /
+``.result()`` / ``.wait()`` / zero-arg ``.get()`` with **no timeout**
+inside a held ``with <lock>`` region (directly or via the entry
+lockset). A wedged peer — the failure mode the whole fault-domain layer
+exists for — then convoys every waiter of that lock forever. The remedy
+is the same discipline the engine's waiters follow: a timeout sized off
+the fault envelope (``FaultPolicy.envelope_s`` / the governor's
+``envelope_bound_s``), with the fallback decision made by the caller.
+
+``str.join(iterable)`` and ``dict.get(key)`` take arguments and are
+naturally exempt; only the zero-positional-arg, no-``timeout`` shapes of
+the blocking APIs match.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.affinity import Program
+from tools.pandalint.checkers.base import Checker, RawFinding
+from tools.pandalint.lockgraph import LockGraph
+
+_BLOCKING_METHODS = {"join", "result", "wait", "get"}
+
+
+class DeadlockChecker(Checker):
+    name = "deadlocks"
+    program_level = True
+    rules = {
+        "DLK1201": (
+            "nested lock acquisition completes a lock-order cycle "
+            "(potential deadlock)"
+        ),
+        "DLK1202": (
+            "unbounded blocking call (join/result/wait/get without "
+            "timeout) while holding a lock"
+        ),
+    }
+
+    def check_program(
+        self, program: Program, locks: LockGraph
+    ) -> Iterator[tuple[str, RawFinding]]:
+        for src, dst, site, witness in locks.cycle_edges():
+            cycle = " -> ".join([src, *witness])
+            yield (
+                site.relpath,
+                RawFinding(
+                    "DLK1201",
+                    site.lineno,
+                    site.col,
+                    f"acquiring {dst} while holding {src} completes the "
+                    f"lock-order cycle {cycle}; two threads entering from "
+                    f"different ends deadlock — impose one global order "
+                    f"or drop {src} before this acquisition",
+                ),
+            )
+        for fn in program.funcs.values():
+            for call in locks.calls_of(fn):
+                held = locks.held_at(fn, call)
+                if not held:
+                    continue
+                f = call.func
+                if not isinstance(f, ast.Attribute):
+                    continue
+                if f.attr not in _BLOCKING_METHODS:
+                    continue
+                if call.args:
+                    continue  # str.join(x) / dict.get(k) / wait(t) shapes
+                if any(kw.arg == "timeout" for kw in call.keywords):
+                    continue
+                yield (
+                    fn.relpath,
+                    RawFinding(
+                        "DLK1202",
+                        call.lineno,
+                        call.col_offset,
+                        f"{fn.qualname}() blocks in .{f.attr}() with no "
+                        f"timeout while holding {sorted(held)}; a wedged "
+                        f"peer convoys every waiter of the lock — size a "
+                        f"timeout off the fault envelope, or move the "
+                        f"wait outside the critical section",
+                    ),
+                )

@@ -1,0 +1,241 @@
+"""Eraser-style lockset analysis over executor affinity (RAC11xx).
+
+The engine's execution-context zoo (asyncio loop, coproc-tick executor,
+harvester/fetch daemons, host-pool shard workers, finalizers) makes
+"which thread touches this attribute" the question behind four of the
+last five review-round bugs (breaker ``_notify`` re-read, duplicate jit
+trace, mask-slot claim protocol, waiter/envelope double-fetch). This
+checker asks it mechanically, per class attribute:
+
+1. every ``self.<attr>`` / ``Cls.<attr>`` access site in the class's
+   methods is collected with the **contexts** that can execute the
+   enclosing function (affinity.Program) and the **lockset** held there
+   (lockgraph: lexical ``with`` stack + the function's entry lockset, so
+   "caller holds self._lock" contracts are seen through);
+2. construction (``__init__``/``__post_init__``/``__new__``) is exempt —
+   the object is not yet published;
+3. two sites *race* when their context sets contain distinct contexts,
+   or share a pool-backed context (executor / pool_worker — pools race
+   themselves; the duplicate-jit-trace shape);
+4. a **write** whose lockset shares nothing with some racing access is
+   RAC1101; an **unlocked read** racing writes that are themselves
+   consistently locked is RAC1102 (the torn-snapshot shape: ``stats()``
+   reading multi-field probe state the calibrator updates under a lock).
+
+Like every rule here, findings are silenced only by a reasoned pragma —
+an attribute genuinely published by a queue/Event handoff (a
+happens-before edge the lockset model cannot see) carries its
+justification in the source instead of silently passing.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterator
+
+from tools.pandalint.affinity import (
+    LIFECYCLE,
+    Program,
+    ProgFunc,
+    contexts_race,
+)
+from tools.pandalint.checkers.base import Checker, RawFinding
+from tools.pandalint.lockgraph import LockGraph
+
+_CTOR_METHODS = {"__init__", "__post_init__", "__new__"}
+
+
+@dataclass
+class _Site:
+    fn: ProgFunc
+    node: ast.AST
+    lineno: int
+    col: int
+    write: bool
+    contexts: frozenset
+    lockset: frozenset
+
+    def where(self) -> str:
+        return f"{self.fn.relpath}:{self.lineno}"
+
+
+def _ctx_label(ctxs: frozenset) -> str:
+    return "{" + ",".join(sorted(ctxs)) + "}"
+
+
+class RaceChecker(Checker):
+    name = "races"
+    program_level = True
+    rules = {
+        "RAC1101": (
+            "attribute written without any lock shared with a concurrent "
+            "access from another execution context"
+        ),
+        "RAC1102": (
+            "unlocked read of an attribute whose concurrent writes are "
+            "consistently locked (torn-snapshot read)"
+        ),
+    }
+
+    def check_program(
+        self, program: Program, locks: LockGraph
+    ) -> Iterator[tuple[str, RawFinding]]:
+        # (modkey, class) -> attr -> [sites]
+        buckets: dict[tuple[str, str], dict[str, list[_Site]]] = {}
+        for fn in program.funcs.values():
+            if fn.cls is None or not fn.contexts:
+                continue
+            if fn.name in _CTOR_METHODS or LIFECYCLE.search(fn.name):
+                continue
+            attrs = buckets.setdefault((fn.modkey, fn.cls), {})
+            for node, write in self._attr_accesses(program, fn):
+                attrs.setdefault(node.attr, []).append(
+                    _Site(
+                        fn,
+                        node,
+                        node.lineno,
+                        node.col_offset,
+                        write,
+                        frozenset(fn.contexts),
+                        locks.held_at(fn, node),
+                    )
+                )
+        findings: list[tuple[str, RawFinding]] = []
+        for (modkey, cls), attrs in sorted(buckets.items()):
+            for attr, sites in sorted(attrs.items()):
+                findings.extend(self._judge(cls, attr, sites))
+        # stable order; the engine re-sorts per file anyway
+        for item in sorted(findings, key=lambda kv: (kv[0], kv[1].line)):
+            yield item
+
+    # ------------------------------------------------------------ collection
+    def _attr_accesses(
+        self, program: Program, fn: ProgFunc
+    ) -> Iterator[tuple[ast.Attribute, bool]]:
+        """(attribute node, is_write) for self./cls./ClassName. receivers,
+        skipping method references (``self.helper(...)`` is a call, not
+        shared data) and nested function bodies (their own ProgFuncs)."""
+        # the receiver must be the function's actual first parameter (or
+        # the class name for ClassVar writes): a classmethod constructor
+        # rebinding `self = cls.__new__(cls)` mutates a LOCAL instance
+        # that nothing can race yet
+        args = getattr(fn.node, "args", None)
+        first_param = ""
+        if args is not None:
+            pos = args.posonlyargs + args.args
+            if pos:
+                first_param = pos[0].arg
+        if first_param not in ("self", "cls"):
+            return
+        stack = list(ast.iter_child_nodes(fn.node))
+        aug_targets: set[int] = set()
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Attribute
+            ):
+                aug_targets.add(id(node.target))
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name
+            ):
+                recv = node.value.id
+                if recv == first_param or recv == fn.cls:
+                    is_method = bool(
+                        program._methods.get((fn.cls, node.attr))
+                    )
+                    if not is_method:
+                        write = isinstance(
+                            node.ctx, (ast.Store, ast.Del)
+                        ) or id(node) in aug_targets
+                        yield node, write
+            stack.extend(ast.iter_child_nodes(node))
+
+    # ------------------------------------------------------------ judgement
+    def _judge(
+        self, cls: str, attr: str, sites: list[_Site]
+    ) -> Iterator[tuple[str, RawFinding]]:
+        writes = [s for s in sites if s.write]
+        if not writes:
+            return
+        # blame the DEFICIENT side of each racing disjoint-lockset pair:
+        # an unlocked (or differently-locked) write is RAC1101 at the
+        # write; a lone unlocked read against disciplined locked writes
+        # is RAC1102 at the read (the stats()-style torn snapshot)
+        flagged_writes: set[int] = set()
+        for w in writes:
+            partner = next(
+                (
+                    s
+                    for s in sites
+                    if (s is not w or contexts_race(w.contexts, w.contexts))
+                    and contexts_race(w.contexts, s.contexts)
+                    and not (w.lockset & s.lockset)
+                    and (not w.lockset or s.lockset)
+                ),
+                None,
+            )
+            if partner is not None:
+                flagged_writes.add(id(w))
+                held = (
+                    f"holding {sorted(w.lockset)}"
+                    if w.lockset
+                    else "with no lock held"
+                )
+                yield (
+                    w.fn.relpath,
+                    RawFinding(
+                        "RAC1101",
+                        w.lineno,
+                        w.col,
+                        f"{w.fn.qualname}() writes {cls}.{attr} "
+                        f"{held} in context {_ctx_label(w.contexts)}, "
+                        f"racing the access at {partner.where()} in "
+                        f"{_ctx_label(partner.contexts)} with no common "
+                        f"lock — serialize both sites on one lock, or "
+                        f"suppress with the happens-before reason "
+                        f"(queue/Event handoff)",
+                    ),
+                )
+        for r in sites:
+            if r.write:
+                continue
+            racing = [
+                w
+                for w in writes
+                if contexts_race(r.contexts, w.contexts)
+            ]
+            if not racing:
+                continue
+            # RAC1102 only when the write side is disciplined (every
+            # racing write holds a lock AND none was already blamed as
+            # RAC1101 — a write under lock A racing a read under
+            # disjoint lock B is ONE defect, blamed once at the write):
+            # double-flagging every reader would bury the real finding
+            if any(
+                not w.lockset or id(w) in flagged_writes for w in racing
+            ):
+                continue
+            miss = next(
+                (w for w in racing if not (r.lockset & w.lockset)), None
+            )
+            if miss is not None:
+                yield (
+                    r.fn.relpath,
+                    RawFinding(
+                        "RAC1102",
+                        r.lineno,
+                        r.col,
+                        f"{r.fn.qualname}() reads {cls}.{attr} without "
+                        f"{sorted(miss.lockset)} in context "
+                        f"{_ctx_label(r.contexts)} while "
+                        f"{miss.fn.qualname}() ({miss.where()}) writes it "
+                        f"under that lock — take the lock for the read "
+                        f"(torn multi-field snapshots) or suppress with "
+                        f"the reason a stale value is acceptable",
+                    ),
+                )

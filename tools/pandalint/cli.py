@@ -14,14 +14,15 @@ import sys
 from tools.pandalint.baseline import load_baseline, write_baseline
 from tools.pandalint.checkers import rule_catalog
 from tools.pandalint.config import Config
-from tools.pandalint.engine import LintEngine
+from tools.pandalint.engine import LintEngine, default_cache_path, default_jobs
 
 
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="pandalint",
         description="AST invariant checker: reactor stalls, TPU tracer "
-        "leaks, lost tasks, iobuf copies.",
+        "leaks, lost tasks, iobuf copies, cross-context races, lock-order "
+        "cycles.",
     )
     p.add_argument("paths", nargs="*", help="files or directories to lint")
     p.add_argument(
@@ -31,9 +32,9 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "sarif"),
         default="text",
-        help="output format (default: text)",
+        help="output format (default: text; sarif renders as CI annotations)",
     )
     p.add_argument(
         "--rules",
@@ -59,6 +60,31 @@ def _build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="print the rule catalog and exit",
     )
+    p.add_argument(
+        "--list-suppressions",
+        action="store_true",
+        help="print every suppression pragma (with staleness) and exit",
+    )
+    p.add_argument(
+        "--jobs",
+        type=int,
+        default=default_jobs(),
+        metavar="N",
+        help="parallel per-file analysis workers (default: min(4, cpus); "
+        "the whole-program phase always runs in-process)",
+    )
+    p.add_argument(
+        "--cache-file",
+        metavar="FILE",
+        default=None,
+        help="content-hash findings cache (default: a per-checkout file "
+        "in the system temp dir)",
+    )
+    p.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="disable the findings cache for this run",
+    )
     return p
 
 
@@ -69,6 +95,7 @@ def main(argv: list[str] | None = None) -> int:
         for rule, (checker, desc) in sorted(rule_catalog().items()):
             print(f"{rule}  [{checker}] {desc}")
         print("SUP001  [engine] suppression pragma without a reason")
+        print("SUP002  [engine] stale suppression: pragma matches no finding")
         print("SYN001  [engine] file fails to parse")
         return 0
 
@@ -84,14 +111,57 @@ def main(argv: list[str] | None = None) -> int:
     rules = None
     if args.rules:
         rules = {r.strip() for r in args.rules.split(",") if r.strip()}
-        unknown = rules - set(rule_catalog()) - {"SUP001", "SYN001"}
+        unknown = rules - set(rule_catalog()) - {"SUP001", "SUP002", "SYN001"}
         if unknown:
             print(f"pandalint: unknown rules: {', '.join(sorted(unknown))}", file=sys.stderr)
             return 2
 
+    if args.jobs < 1:
+        print("pandalint: --jobs must be >= 1", file=sys.stderr)
+        return 2
+
+    cache_path = None if args.no_cache else (
+        args.cache_file or default_cache_path()
+    )
     config = Config.load("pyproject.toml" if os.path.exists("pyproject.toml") else None)
-    engine = LintEngine(config, rules)
-    reports = engine.lint_paths(args.paths)
+    engine = LintEngine(config, rules, jobs=args.jobs, cache_path=cache_path)
+    reports, states = engine.lint_paths_with_states(args.paths)
+
+    if args.list_suppressions:
+        inventory = engine.suppression_inventory(states)
+        if rules is not None:
+            # staleness derives from SUP002, which only runs with every
+            # rule active — under a subset a pragma for any other rule
+            # would LOOK stale; don't report a trustworthy-looking zero
+            for s in inventory:
+                s["stale"] = None
+            print(
+                "pandalint: staleness not evaluated under --rules "
+                "(needs a full-rule run)",
+                file=sys.stderr,
+            )
+        if args.format == "json":
+            print(json.dumps(inventory, indent=2))
+        else:
+            for s in inventory:
+                kind = "file" if s["file_level"] else "line"
+                stale = "  [STALE]" if s["stale"] else ""
+                print(
+                    f"{s['path']}:{s['line']}: [{kind}] "
+                    f"disable={','.join(s['rules'])} -- {s['reason']}{stale}"
+                )
+            if rules is None:
+                n_stale = sum(1 for s in inventory if s["stale"])
+                print(
+                    f"pandalint: {len(inventory)} suppression(s), "
+                    f"{n_stale} stale"
+                )
+            else:
+                print(
+                    f"pandalint: {len(inventory)} suppression(s), "
+                    f"staleness unknown (--rules subset)"
+                )
+        return 0
 
     all_findings = [f for r in reports for f in r.findings]
 
@@ -135,6 +205,10 @@ def main(argv: list[str] | None = None) -> int:
                 indent=2,
             )
         )
+    elif args.format == "sarif":
+        from tools.pandalint.sarif import to_sarif
+
+        print(json.dumps(to_sarif(active + suppressed), indent=2))
     else:
         for f in active:
             print(f.render())

@@ -55,6 +55,12 @@ DEFAULT_SCOPES: dict[str, tuple[str, ...]] = {
     # Dispatch-layer records elsewhere run on the owning event loop by
     # construction, so package-wide the rule would only breed pragmas.
     "hdr-record": ("redpanda_tpu/coproc",),
+    # The pandaraces whole-program analyses: execution contexts (spawn
+    # sites) and locks exist across the whole broker — the affinity call
+    # graph is built package-wide regardless, and a race injected in any
+    # subtree must fail the gate.
+    "races": (),
+    "deadlocks": (),
 }
 
 DEFAULT_PACKAGE_ROOT = "redpanda_tpu"

@@ -1,16 +1,44 @@
-"""Lint engine: walk files, parse, run checkers, apply suppressions."""
+"""Lint engine: walk files, parse, run checkers, apply suppressions.
+
+Two checker phases since pandaraces:
+
+1. **Per-file checkers** (reactor, hotpath, ...) see one parsed file.
+   This phase is embarrassingly parallel (``jobs``) and content-cacheable
+   (``cache_path``): a file whose bytes didn't change since the last run
+   re-uses its recorded findings — the gate runs in every tier-1, so the
+   steady-state cost is one hash per file.
+2. **Program checkers** (races, deadlocks) see the WHOLE parsed program —
+   affinity seeds in one file classify functions in another. They run
+   once per invocation, in-process, after the per-file phase; their
+   findings flow through the same per-file suppression tables.
+
+After both phases, well-formed pragmas that matched **no** finding are
+themselves reported (SUP002): a stale suppression is a claim about the
+code that stopped being true. Stale detection only runs when the full
+rule set is active (a ``--rules`` subset would make every other pragma
+look stale).
+"""
 
 from __future__ import annotations
 
 import ast
+import hashlib
+import json
 import os
+import tempfile
+from dataclasses import dataclass, field
 
-from tools.pandalint.checkers import ALL_CHECKERS, FileContext
+from tools.pandalint.affinity import Program
+from tools.pandalint.checkers import ALL_CHECKERS, FileContext, rule_catalog
 from tools.pandalint.config import Config
 from tools.pandalint.finding import FileReport, Finding
+from tools.pandalint.lockgraph import LockGraph
 from tools.pandalint.suppress import SuppressionTable
 
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+# bump when a change invalidates cached per-file findings wholesale
+_CACHE_FORMAT = 2
 
 
 def iter_python_files(paths: list[str]) -> list[str]:
@@ -28,46 +56,136 @@ def iter_python_files(paths: list[str]) -> list[str]:
     return out
 
 
+def default_jobs() -> int:
+    return min(4, os.cpu_count() or 1)
+
+
+def default_cache_path() -> str:
+    """Per-checkout cache file under the USER's cache dir (the repo tree
+    must not grow derived state the gate then has to ignore, and a
+    world-writable /tmp path would let another local user pre-poison the
+    gate's findings cache)."""
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    if base.startswith("~"):  # no resolvable home: per-uid tempdir
+        base = os.path.join(
+            tempfile.gettempdir(), f"pandalint-{os.getuid()}"
+        )
+    tag = hashlib.sha256(os.getcwd().encode()).hexdigest()[:12]
+    return os.path.join(base, "pandalint", f"cache-{tag}.json")
+
+
+@dataclass
+class _FileState:
+    """Everything the engine holds per file between phases."""
+
+    path: str
+    rel: str
+    report: FileReport
+    ctx: FileContext | None = None
+    table: SuppressionTable | None = None
+    source_hash: str = ""
+    from_cache: bool = False
+    file_findings: list[Finding] = field(default_factory=list)
+
+
+# --------------------------------------------------------------- worker side
+# Module-level so ProcessPoolExecutor (spawn) can import it; the worker
+# re-runs only the per-file checkers and ships Finding dicts back.
+_worker_engine: "LintEngine | None" = None
+
+
+def _worker_init(config: Config, rules: set[str] | None) -> None:
+    global _worker_engine
+    _worker_engine = LintEngine(config, rules)
+
+
+def _worker_lint(args: tuple[str, str]) -> tuple[str, list[dict], str | None]:
+    path, rel = args
+    assert _worker_engine is not None
+    state = _worker_engine._parse(path, rel)
+    if state.ctx is not None:
+        _worker_engine._run_file_checkers(state)
+    findings = [f.to_dict() for f in state.file_findings]
+    return rel, findings, state.report.parse_error
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(
+        d["rule"],
+        d["path"],
+        d["line"],
+        d["col"],
+        d["message"],
+        d["checker"],
+        source_line=d.get("source_line", ""),
+        suppressed=d.get("suppressed", False),
+        suppress_reason=d.get("suppress_reason", ""),
+    )
+
+
 class LintEngine:
-    def __init__(self, config: Config | None = None, rules: set[str] | None = None):
+    def __init__(
+        self,
+        config: Config | None = None,
+        rules: set[str] | None = None,
+        jobs: int = 1,
+        cache_path: str | None = None,
+    ):
         self.config = config or Config()
         self.rules = rules  # None = all
+        self.jobs = max(1, int(jobs))
+        self.cache_path = cache_path
         self.checkers = [cls() for cls in ALL_CHECKERS]
+        self.file_checkers = [c for c in self.checkers if not c.program_level]
+        self.program_checkers = [c for c in self.checkers if c.program_level]
 
-    # ------------------------------------------------------------ one file
-    def lint_file(self, path: str, relpath: str | None = None) -> FileReport:
+    # ------------------------------------------------------------ plumbing
+    def _salt(self) -> str:
+        """Cache invalidation scope: engine format, rule set, config."""
+        h = hashlib.sha256()
+        h.update(str(_CACHE_FORMAT).encode())
+        h.update(",".join(sorted(rule_catalog())).encode())
+        h.update(str(sorted(self.rules)) .encode() if self.rules else b"all")
+        h.update(self.config.package_root.encode())
+        h.update(str(sorted(self.config.scopes.items())).encode())
+        return h.hexdigest()
+
+    def _parse(self, path: str, relpath: str | None = None) -> _FileState:
         rel = (relpath or path).replace(os.sep, "/")
         report = FileReport(path=rel)
+        state = _FileState(path=path, rel=rel, report=report)
         try:
             with open(path, encoding="utf-8", errors="replace") as fh:
                 source = fh.read()
         except OSError as e:
             report.parse_error = str(e)
-            report.findings.append(
-                Finding("SYN001", rel, 1, 0, f"cannot read file: {e}", "engine")
-            )
-            return report
+            f = Finding("SYN001", rel, 1, 0, f"cannot read file: {e}", "engine")
+            report.findings.append(f)
+            state.file_findings.append(f)
+            return state
+        state.source_hash = hashlib.sha256(source.encode()).hexdigest()
         try:
             tree = ast.parse(source, filename=path)
         except SyntaxError as e:
             report.parse_error = str(e)
-            report.findings.append(
-                Finding(
-                    "SYN001",
-                    rel,
-                    e.lineno or 1,
-                    (e.offset or 1) - 1,
-                    f"syntax error: {e.msg} (file cannot import on this "
-                    f"interpreter)",
-                    "engine",
-                    source_line=(e.text or "").strip(),
-                )
+            f = Finding(
+                "SYN001",
+                rel,
+                e.lineno or 1,
+                (e.offset or 1) - 1,
+                f"syntax error: {e.msg} (file cannot import on this "
+                f"interpreter)",
+                "engine",
+                source_line=(e.text or "").strip(),
             )
-            return report
-
-        ctx = FileContext(relpath=rel, tree=tree, source=source)
-        table = SuppressionTable(source)
-        for pragma in table.malformed:
+            report.findings.append(f)
+            state.file_findings.append(f)
+            return state
+        state.ctx = FileContext(relpath=rel, tree=tree, source=source)
+        state.table = SuppressionTable(source)
+        for pragma in state.table.malformed:
             report.findings.append(
                 Finding(
                     "SUP001",
@@ -77,47 +195,286 @@ class LintEngine:
                     "pandalint pragma without a `-- reason` (or disable-file "
                     "below the file header): nothing is suppressed",
                     "engine",
-                    source_line=ctx.line_text(pragma.line),
+                    source_line=state.ctx.line_text(pragma.line),
+                )
+            )
+        return state
+
+    def _make_finding(
+        self, state: _FileState, raw, checker_name: str
+    ) -> Finding:
+        # a pragma may sit on the finding's line or on the first line of
+        # the enclosing logical statement (one line up for wrapped exprs)
+        pragma = state.table.lookup(raw.rule, (raw.line, raw.line - 1))
+        return Finding(
+            raw.rule,
+            state.rel,
+            raw.line,
+            raw.col,
+            raw.message,
+            checker_name,
+            source_line=state.ctx.line_text(raw.line),
+            suppressed=pragma is not None,
+            suppress_reason=pragma.reason if pragma else "",
+        )
+
+    def _run_file_checkers(self, state: _FileState) -> None:
+        for checker in self.file_checkers:
+            if not self.config.checker_applies(checker.name, state.rel):
+                continue
+            for raw in checker.check(state.ctx):
+                if self.rules is not None and raw.rule not in self.rules:
+                    continue
+                f = self._make_finding(state, raw, checker.name)
+                state.file_findings.append(f)
+                state.report.findings.append(f)
+
+    def _run_program_checkers(self, states: list[_FileState]) -> None:
+        parsed = [s for s in states if s.ctx is not None]
+        if not parsed:
+            return
+        by_rel = {s.rel: s for s in parsed}
+        program = Program([(s.rel, s.ctx.tree) for s in parsed])
+        locks = LockGraph(program)
+        for checker in self.program_checkers:
+            for rel, raw in checker.check_program(program, locks):
+                state = by_rel.get(rel)
+                if state is None:
+                    continue
+                if not self.config.checker_applies(checker.name, rel):
+                    continue
+                if self.rules is not None and raw.rule not in self.rules:
+                    continue
+                state.report.findings.append(
+                    self._make_finding(state, raw, checker.name)
+                )
+
+    def _stale_pragmas(self, state: _FileState) -> None:
+        """SUP002: a well-formed pragma that silenced nothing. Only
+        meaningful when every rule ran (a --rules subset would make the
+        other pragmas look stale), enforced by the caller."""
+        if state.table is None or state.ctx is None:
+            return
+        used: set[int] = set()
+        for f in state.report.findings:
+            p = state.table.lookup(f.rule, (f.line, f.line - 1))
+            if p is not None:
+                used.add(id(p))
+        pragmas = list(state.table.line_pragmas.values()) + list(
+            state.table.file_pragmas
+        )
+        for p in pragmas:
+            if id(p) in used:
+                continue
+            rules = ",".join(p.rules)
+            state.report.findings.append(
+                Finding(
+                    "SUP002",
+                    state.rel,
+                    p.line,
+                    0,
+                    f"stale suppression: `disable={rules}` no longer "
+                    f"matches any finding "
+                    f"{'in this file' if p.file_level else 'on this line'} "
+                    f"— the claim it documents stopped being true; remove "
+                    f"the pragma (or fix the rule id)",
+                    "engine",
+                    source_line=state.ctx.line_text(p.line),
                 )
             )
 
-        for checker in self.checkers:
-            if not self.config.checker_applies(checker.name, rel):
+    def suppression_inventory(
+        self, states: list[_FileState]
+    ) -> list[dict]:
+        out = []
+        for state in states:
+            if state.table is None:
                 continue
-            for raw in checker.check(ctx):
-                if self.rules is not None and raw.rule not in self.rules:
-                    continue
-                # a pragma may sit on the finding's line or on the first
-                # line of the enclosing logical statement (one line up for
-                # wrapped expressions)
-                candidates = (raw.line, raw.line - 1)
-                pragma = table.lookup(raw.rule, candidates)
-                report.findings.append(
-                    Finding(
-                        raw.rule,
-                        rel,
-                        raw.line,
-                        raw.col,
-                        raw.message,
-                        checker.name,
-                        source_line=ctx.line_text(raw.line),
-                        suppressed=pragma is not None,
-                        suppress_reason=pragma.reason if pragma else "",
-                    )
+            stale_lines = {
+                f.line
+                for f in state.report.findings
+                if f.rule == "SUP002"
+            }
+            pragmas = list(state.table.line_pragmas.values()) + list(
+                state.table.file_pragmas
+            )
+            for p in sorted(pragmas, key=lambda p: p.line):
+                out.append(
+                    {
+                        "path": state.rel,
+                        "line": p.line,
+                        "rules": list(p.rules),
+                        "reason": p.reason,
+                        "file_level": p.file_level,
+                        "stale": p.line in stale_lines,
+                    }
                 )
-        report.findings.sort(key=lambda f: (f.line, f.col, f.rule))
-        return report
+        return out
+
+    # ------------------------------------------------------------ one file
+    def lint_file(self, path: str, relpath: str | None = None) -> FileReport:
+        state = self._parse(path, relpath)
+        if state.ctx is not None:
+            self._run_file_checkers(state)
+            self._run_program_checkers([state])
+            if self.rules is None:
+                self._stale_pragmas(state)
+        state.report.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        return state.report
 
     # ------------------------------------------------------------ many files
-    def lint_paths(self, paths: list[str], root: str | None = None) -> list[FileReport]:
+    def lint_paths(
+        self, paths: list[str], root: str | None = None
+    ) -> list[FileReport]:
+        reports, _states = self.lint_paths_with_states(paths, root)
+        return reports
+
+    def lint_paths_with_states(
+        self, paths: list[str], root: str | None = None
+    ) -> tuple[list[FileReport], list[_FileState]]:
         root = root or os.getcwd()
-        reports = []
+        states: list[_FileState] = []
         for path in iter_python_files(paths):
             rel = os.path.relpath(path, root)
             if rel.startswith(".."):
                 rel = path
-            reports.append(self.lint_file(path, rel))
-        return reports
+            # parse in-process always: the program phase needs every tree
+            states.append(self._parse(path, rel))
+
+        cache = self._load_cache()
+        salt = self._salt()
+        pending: list[_FileState] = []
+        for state in states:
+            if state.ctx is None:
+                continue
+            hit = cache.get(state.rel) if cache is not None else None
+            if hit is not None and hit.get("hash") == state.source_hash:
+                state.from_cache = True
+                state.file_findings = [
+                    _finding_from_dict(d) for d in hit["findings"]
+                ]
+                state.report.findings.extend(state.file_findings)
+            else:
+                pending.append(state)
+
+        if self.jobs > 1 and len(pending) >= 8:
+            self._run_parallel(pending)
+        else:
+            for state in pending:
+                self._run_file_checkers(state)
+
+        self._run_program_checkers(states)
+        if self.rules is None:
+            for state in states:
+                self._stale_pragmas(state)
+        for state in states:
+            state.report.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        self._store_cache(states, salt)
+        return [s.report for s in states], states
+
+    def _run_parallel(self, pending: list[_FileState]) -> None:
+        """Per-file phase on a process pool. Fork when the process is
+        still single-threaded (cheap workers, no re-import); spawn when
+        threads exist — the gate runs inside pytest processes that own
+        daemon threads (harvesters, fetch workers), and forking a
+        threaded process can inherit held locks mid-critical-section.
+        Any pool failure falls back to the serial path — parallelism is
+        an optimization, never a correctness dependency."""
+        import concurrent.futures as cf
+        import multiprocessing as mp
+        import threading
+
+        method = (
+            "fork"
+            if "fork" in mp.get_all_start_methods()
+            and threading.active_count() == 1
+            else "spawn"
+        )
+        try:
+            with cf.ProcessPoolExecutor(
+                max_workers=min(self.jobs, len(pending)),
+                mp_context=mp.get_context(method),
+                initializer=_worker_init,
+                initargs=(self.config, self.rules),
+            ) as pool:
+                by_rel = {s.rel: s for s in pending}
+                for rel, findings, _err in pool.map(
+                    _worker_lint,
+                    [(s.path, s.rel) for s in pending],
+                    chunksize=max(1, len(pending) // (self.jobs * 4)),
+                ):
+                    state = by_rel[rel]
+                    state.file_findings = [
+                        _finding_from_dict(d) for d in findings
+                    ]
+                    state.report.findings.extend(state.file_findings)
+        except Exception:
+            for state in pending:
+                if not state.file_findings:
+                    self._run_file_checkers(state)
+
+    # ------------------------------------------------------------ cache
+    # cache document: {"format": N, "salts": {salt: {rel: entry}}} — one
+    # bucket per engine configuration, so alternating a --rules subset
+    # with the full gate doesn't thrash the other's entries wholesale.
+    _MAX_CACHE_SALTS = 4
+
+    def _load_cache(self) -> dict | None:
+        if not self.cache_path:
+            return None
+        try:
+            with open(self.cache_path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return {}
+        if doc.get("format") != _CACHE_FORMAT:
+            return {}
+        files = doc.get("salts", {}).get(self._salt())
+        return files if isinstance(files, dict) else {}
+
+    def _store_cache(self, states: list[_FileState], salt: str) -> None:
+        if not self.cache_path:
+            return
+        files = {
+            s.rel: {
+                "hash": s.source_hash,
+                "findings": [f.to_dict() for f in s.file_findings],
+            }
+            for s in states
+            if s.ctx is not None and s.source_hash
+        }
+        try:
+            try:
+                with open(self.cache_path, encoding="utf-8") as fh:
+                    doc = json.load(fh)
+                if doc.get("format") != _CACHE_FORMAT:
+                    doc = {}
+            except (OSError, ValueError):
+                doc = {}
+            salts = doc.get("salts")
+            if not isinstance(salts, dict):
+                salts = {}
+            bucket = salts.pop(salt, None)
+            if not isinstance(bucket, dict):
+                bucket = {}
+            # MERGE into the bucket: a narrow spot-check run (one file)
+            # must not evict the gate run's 160+ entries — stale entries
+            # for edited files are harmless (their hash misses)
+            bucket.update(files)
+            salts[salt] = bucket  # re-insert last: insertion order = LRU
+            while len(salts) > self._MAX_CACHE_SALTS:
+                salts.pop(next(iter(salts)))
+            doc = {"format": _CACHE_FORMAT, "salts": salts}
+            cache_dir = os.path.dirname(self.cache_path) or "."
+            os.makedirs(cache_dir, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=cache_dir, prefix=".pandalint-cache-"
+            )
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, self.cache_path)
+        except OSError:
+            pass  # cache is best-effort; the lint result stands
 
 
 def lint_paths(

@@ -40,6 +40,9 @@ class Finding:
             "col": self.col,
             "message": self.message,
             "checker": self.checker,
+            # round-trips through the engine cache; fingerprints derive
+            # from it, so dropping it would reshuffle baselines
+            "source_line": self.source_line,
             "suppressed": self.suppressed,
             "suppress_reason": self.suppress_reason,
             "fingerprint": self.fingerprint(),

@@ -1,0 +1,524 @@
+"""Lock identity, locksets, and the global acquisition-order graph.
+
+Shared by the RAC11xx lockset checker and the DLK12xx lock-order checker,
+and by the runtime cross-check (``tests`` assert the ``coproc_lockwatch``
+recorder's observed acquisition edges are a SUBGRAPH of the static graph
+built here — the analyzer is itself verified, not just shipped).
+
+**Lock identity.** Python has no lock declarations, so identity is
+name-based and canonicalized:
+
+- ``self._X`` inside class ``C`` → ``"C._X"``;
+- a bare module-global ``NAME`` → ``"<module>.NAME"`` (resolved through
+  ``from``-imports to the defining module);
+- ``obj._X`` on anything else → the set of classes known to OWN a lock
+  attribute ``_X`` (discovered from ``self._X = threading.Lock()``-style
+  assignments); a unique owner resolves cleanly, several owners make the
+  site *ambiguous* (kept for the superset graph, excluded from cycle
+  reporting — a false cycle from smeared identity would breed pragmas).
+
+A ``with`` item counts as a lock acquisition when its context
+expression's dotted name mentions ``lock``/``mutex`` (the same lexical
+heuristic the LCK checker uses; ``.acquire()``-style manual acquisition
+is out of scope and noted in the README).
+
+**Locksets.** The effective lockset at a node is the lexical ``with``
+stack PLUS the function's *entry lockset*: the intersection over every
+resolved call site of the locks held there (a fixpoint, so
+``framed() -> _materialize_locked() -> _mat_columnar()`` chains carry
+``_Launch._lock`` all the way down — the engine documents such contracts
+as "caller holds self._lock", and the analysis must see through them).
+Entry locksets only shrink as more call sites are discovered; an
+unresolvable caller is treated as absent, which UNDER-approximates held
+locks and therefore over-reports races — the safe direction for a gate.
+
+**Acquisition graph.** Edges ``held -> acquired`` from every lexical
+nesting, plus ``held -> may_acquire(callee)`` for every call made while
+holding a lock, where ``may_acquire`` is the transitive closure of locks
+a function can take (fixpoint over the call graph). Cycles in the
+unambiguous sub-graph are DLK1201 findings; the full (superset) graph is
+what the lockwatch runtime edges are checked against.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.pandalint.affinity import (
+    AMBIG_LIMIT,
+    Program,
+    ProgFunc,
+    dotted,
+    modbase,
+)
+
+_LOCK_CTORS = {"Lock", "RLock"}
+
+
+def _is_lock_name(name: str) -> bool:
+    low = name.lower()
+    return "lock" in low or "mutex" in low
+
+
+def _lock_ctor_in(value: ast.expr) -> bool:
+    """Does this assigned value construct a lock (possibly wrapped, e.g.
+    ``lockwatch.wrap(threading.Lock(), ...)``)?"""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            name = dotted(sub.func).rsplit(".", 1)[-1]
+            if name in _LOCK_CTORS:
+                return True
+    return False
+
+
+@dataclass(frozen=True)
+class LockRef:
+    """One syntactic lock reference, canonicalized.
+
+    ``ids`` lists every candidate canonical identity; ``ambiguous`` is
+    True when the owner could not be pinned to exactly one."""
+
+    ids: tuple[str, ...]
+    ambiguous: bool
+
+    @property
+    def primary(self) -> str:
+        return self.ids[0]
+
+
+@dataclass
+class Acquisition:
+    """One ``with <lock>`` acquisition site."""
+
+    ref: LockRef
+    fn: ProgFunc
+    lineno: int
+    col: int
+    held: frozenset[str] = frozenset()      # lexical only; finalized later
+
+
+@dataclass
+class EdgeSite:
+    relpath: str
+    lineno: int
+    col: int
+    ambiguous: bool
+    via: str  # "nesting" | "call:<callee>"
+
+
+class LockGraph:
+    """Locksets + acquisition graph for one affinity Program."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        # attr name -> class names owning a lock attribute of that name
+        self._lock_attr_owners: dict[str, set[str]] = {}
+        # modkey -> module-global lock names
+        self._module_locks: dict[str, set[str]] = {}
+        self._collect_lock_defs()
+
+        # per-function: lexical acquisitions, lexical held-at for every
+        # Attribute/Call node, and the calls made (node -> held set)
+        self.acquisitions: list[Acquisition] = []
+        self._held_lex: dict[int, frozenset[str]] = {}   # id(node) -> held
+        self._call_sites: dict[int, list[tuple[ProgFunc, ast.Call]]] = {}
+        self._fn_calls: dict[int, list[ast.Call]] = {}
+        for fn in program.funcs.values():
+            self._walk_function(fn)
+        self.entry: dict[int, frozenset[str]] = {}
+        self._solve_entry_locksets()
+        self.may_acquire: dict[int, frozenset[str]] = {}
+        self._solve_may_acquire()
+        # (src, dst) -> [EdgeSite, ...]
+        self.edges: dict[tuple[str, str], list[EdgeSite]] = {}
+        self._build_edges()
+
+    # ------------------------------------------------------------ definitions
+    def _collect_lock_defs(self) -> None:
+        from tools.pandalint.affinity import modkey_of
+
+        for relpath, tree in self.program.modules:
+            modkey = modkey_of(relpath)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not _lock_ctor_in(node.value):
+                    continue
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        info = self._enclosing_class(tree, node)
+                        if info:
+                            self._lock_attr_owners.setdefault(
+                                tgt.attr, set()
+                            ).add(info)
+                    elif isinstance(tgt, ast.Name):
+                        # class-body assignment = a class-level lock (its
+                        # canonical id is Class.attr); module-level = a
+                        # module-global lock
+                        cls = self._enclosing_class(tree, node)
+                        if cls:
+                            self._lock_attr_owners.setdefault(
+                                tgt.id, set()
+                            ).add(cls)
+                        else:
+                            self._module_locks.setdefault(
+                                modkey, set()
+                            ).add(tgt.id)
+
+    @staticmethod
+    def _enclosing_class(tree: ast.Module, target: ast.AST) -> str | None:
+        """Class lexically containing ``target`` (one linear scan per
+        lookup; lock definitions are rare)."""
+        found: list[str] = []
+
+        def visit(node: ast.AST, cls: str | None) -> bool:
+            if node is target:
+                if cls:
+                    found.append(cls)
+                return True
+            for child in ast.iter_child_nodes(node):
+                nxt = node.name if isinstance(node, ast.ClassDef) else cls
+                if visit(child, nxt):
+                    return True
+            return False
+
+        visit(tree, None)
+        return found[0] if found else None
+
+    # ------------------------------------------------------------ identity
+    def lock_ref(self, fn: ProgFunc, ctx: ast.expr) -> LockRef | None:
+        """Canonical identity for a ``with`` context expression, or None
+        when it does not look like a lock."""
+        if isinstance(ctx, ast.Call):
+            ctx = ctx.func
+        chain = dotted(ctx)
+        if not chain or not _is_lock_name(chain):
+            return None
+        parts = chain.split(".")
+        if parts[0] in ("self", "cls") and len(parts) == 2 and fn.cls:
+            return LockRef((f"{fn.cls}.{parts[1]}",), False)
+        if parts[0] in self.program.classes and len(parts) == 2:
+            # ClassName._lock: a class-level lock addressed explicitly
+            # (TpuEngine._columnar_probe_lock style)
+            return LockRef((f"{parts[0]}.{parts[1]}",), False)
+        if len(parts) == 1:
+            name = parts[0]
+            alias = self.program._aliases.get(fn.modkey, {}).get(name)
+            if alias is not None and alias[0] == "symbol":
+                return LockRef((f"{modbase(alias[1])}.{name}",), False)
+            return LockRef((f"{modbase(fn.modkey)}.{name}",), False)
+        # module-attr lock: `engine_mod._mask_claim_lock`
+        alias = self.program._aliases.get(fn.modkey, {}).get(parts[0])
+        if alias is not None and alias[0] == "module" and len(parts) == 2:
+            return LockRef((f"{modbase(alias[1])}.{parts[1]}",), False)
+        attr = parts[-1]
+        owners = sorted(self._lock_attr_owners.get(attr, ()))
+        if len(owners) == 1:
+            return LockRef((f"{owners[0]}.{attr}",), False)
+        if owners:
+            return LockRef(
+                tuple(f"{o}.{attr}" for o in owners), True
+            )
+        return LockRef((f"?.{attr}",), True)
+
+    # ------------------------------------------------------------ per function
+    def _walk_function(self, fn: ProgFunc) -> None:
+        calls: list[ast.Call] = []
+
+        def walk(node: ast.AST, held: frozenset[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue  # separate ProgFuncs with their own walks
+                child_held = held
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    for item in child.items:
+                        ref = self.lock_ref(fn, item.context_expr)
+                        if ref is None:
+                            continue
+                        self.acquisitions.append(
+                            Acquisition(
+                                ref,
+                                fn,
+                                item.context_expr.lineno,
+                                item.context_expr.col_offset,
+                                child_held,
+                            )
+                        )
+                        # ambiguous holds contribute ALL candidates: a
+                        # lockset that MIGHT hold the lock is treated as
+                        # holding it (fewer false race positives)
+                        child_held = child_held | frozenset(ref.ids)
+                if isinstance(child, (ast.Attribute, ast.Call)):
+                    self._held_lex[id(child)] = child_held
+                    if isinstance(child, ast.Call):
+                        calls.append(child)
+                walk(child, child_held)
+
+        walk(fn.node, frozenset())
+        self._fn_calls[id(fn.node)] = calls
+        for call in calls:
+            callees, _amb = self.program.resolve_call(fn, call)
+            for callee in callees:
+                self._call_sites.setdefault(id(callee.node), []).append(
+                    (fn, call)
+                )
+
+    # ------------------------------------------------------------ fixpoints
+    def _solve_entry_locksets(self) -> None:
+        """entry(f) = ∩ over call sites of (entry(caller) ∪ held at the
+        call), seeded EMPTY and grown to the least fixpoint. The ∅ seed
+        matters: a ⊤ seed leaves call cycles with no outside caller
+        pinned at "every lock held", exploding the edge graph; the least
+        fixpoint UNDER-approximates held locks instead, which over-reports
+        races — the safe direction for a lint gate."""
+        entry: dict[int, frozenset[str]] = {
+            id(fn.node): frozenset() for fn in self.program.funcs.values()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.program.funcs.values():
+                sites = self._call_sites.get(id(fn.node))
+                if not sites:
+                    continue
+                acc: frozenset[str] | None = None
+                for caller, call in sites:
+                    held = self._held_lex.get(id(call), frozenset())
+                    held = held | entry[id(caller.node)]
+                    acc = held if acc is None else (acc & held)
+                acc = acc or frozenset()
+                if acc != entry[id(fn.node)]:
+                    entry[id(fn.node)] = acc
+                    changed = True
+        self.entry = entry
+
+    def _may_fixpoint(self, unique_methods: bool, clean_lex: bool):
+        """may(f) = locks acquired lexically in f ∪ may(every callee).
+
+        Two instantiations: the CLEAN closure (unique call resolution,
+        unambiguous lock identities only) feeds cycle detection — one
+        smeared ``.read()`` resolving into an unrelated class would
+        manufacture false deadlock cycles; the FULL closure (candidates
+        up to AMBIG_LIMIT, every lock id) makes the graph a SUPERSET,
+        which is what the runtime lockwatch subgraph check needs."""
+        lex: dict[int, set[str]] = {
+            id(fn.node): set() for fn in self.program.funcs.values()
+        }
+        for acq in self.acquisitions:
+            if clean_lex and acq.ref.ambiguous:
+                continue
+            lex[id(acq.fn.node)].update(acq.ref.ids)
+        may = {k: frozenset(v) for k, v in lex.items()}
+        callee_map: dict[int, list[ProgFunc]] = {}
+        for fn in self.program.funcs.values():
+            outs: list[ProgFunc] = []
+            for call in self._fn_calls.get(id(fn.node), []):
+                cands, amb = self.program.resolve_call(
+                    fn, call, unique_methods=unique_methods
+                )
+                if unique_methods and amb:
+                    continue
+                outs.extend(cands)
+            callee_map[id(fn.node)] = outs
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.program.funcs.values():
+                cur = may[id(fn.node)]
+                nxt = set(cur)
+                for callee in callee_map[id(fn.node)]:
+                    nxt |= may.get(id(callee.node), frozenset())
+                if len(nxt) != len(cur):
+                    may[id(fn.node)] = frozenset(nxt)
+                    changed = True
+        return may, callee_map
+
+    def _solve_may_acquire(self) -> None:
+        self.may_clean, self._clean_callees = self._may_fixpoint(
+            unique_methods=True, clean_lex=True
+        )
+        self.may_acquire, self._full_callees = self._may_fixpoint(
+            unique_methods=False, clean_lex=False
+        )
+
+    # ------------------------------------------------------------ graph
+    def held_at(self, fn: ProgFunc, node: ast.AST) -> frozenset[str]:
+        """Effective lockset at a node: lexical stack + entry lockset."""
+        return self._held_lex.get(id(node), frozenset()) | self.entry.get(
+            id(fn.node), frozenset()
+        )
+
+    def calls_of(self, fn: ProgFunc) -> list[ast.Call]:
+        """The call nodes in fn's own body (no nested defs) — the public
+        face of the per-function call index checkers iterate."""
+        return self._fn_calls.get(id(fn.node), [])
+
+    def _add_edge(
+        self, src: str, dst: str, site: EdgeSite
+    ) -> None:
+        if src == dst:
+            # a self-edge from name-smearing is noise; REAL reentrant
+            # acquisition of a non-reentrant lock is out of scope here
+            # (the runtime lockwatch would deadlock on it immediately)
+            return
+        self.edges.setdefault((src, dst), []).append(site)
+
+    def _build_edges(self) -> None:
+        # clean edges first (cycle detection trusts only these), then the
+        # full superset extras flagged ambiguous (subgraph cross-check)
+        for acq in self.acquisitions:
+            held = acq.held | self.entry.get(id(acq.fn.node), frozenset())
+            for h in held:
+                for lid in acq.ref.ids:
+                    self._add_edge(
+                        h,
+                        lid,
+                        EdgeSite(
+                            acq.fn.relpath,
+                            acq.lineno,
+                            acq.col,
+                            acq.ref.ambiguous,
+                            "nesting",
+                        ),
+                    )
+        for fn in self.program.funcs.values():
+            for call in self._fn_calls.get(id(fn.node), []):
+                held = self.held_at(fn, call)
+                if not held:
+                    continue
+                clean, amb = self.program.resolve_call(
+                    fn, call, unique_methods=True
+                )
+                full, _ = self.program.resolve_call(
+                    fn, call, unique_methods=False
+                )
+                passes = []
+                if not amb:
+                    passes.append((clean, self.may_clean, False))
+                passes.append((full, self.may_acquire, True))
+                for callees, may, ambiguous in passes:
+                    for callee in callees:
+                        for lid in may.get(id(callee.node), frozenset()):
+                            for h in held:
+                                self._add_edge(
+                                    h,
+                                    lid,
+                                    EdgeSite(
+                                        fn.relpath,
+                                        call.lineno,
+                                        call.col_offset,
+                                        ambiguous or lid.startswith("?."),
+                                        f"call:{callee.qualname}",
+                                    ),
+                                )
+
+    def edge_set(self) -> set[tuple[str, str]]:
+        """Every (src, dst) in the superset graph — what the runtime
+        lockwatch edge set must be a subgraph of."""
+        return set(self.edges)
+
+    def unambiguous_edges(self) -> dict[tuple[str, str], EdgeSite]:
+        out: dict[tuple[str, str], EdgeSite] = {}
+        for key, sites in self.edges.items():
+            clean = [s for s in sites if not s.ambiguous]
+            if clean and not any(i.startswith("?.") for i in key):
+                out[key] = clean[0]
+        return out
+
+    def cycle_edges(self) -> list[tuple[str, str, EdgeSite, list[str]]]:
+        """Edges participating in a cycle of the unambiguous graph, each
+        with one witness cycle (src -> ... -> src) for the message."""
+        clean = self.unambiguous_edges()
+        adj: dict[str, set[str]] = {}
+        for (src, dst) in clean:
+            adj.setdefault(src, set()).add(dst)
+        # SCCs via iterative Tarjan
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on_stack: set[str] = set()
+        stack: list[str] = []
+        sccs: dict[str, int] = {}
+        counter = [0]
+        scc_id = [0]
+
+        def strongconnect(v0: str) -> None:
+            work = [(v0, iter(sorted(adj.get(v0, ()))))]
+            index[v0] = low[v0] = counter[0]
+            counter[0] += 1
+            stack.append(v0)
+            on_stack.add(v0)
+            while work:
+                v, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(adj.get(w, ())))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[v] = min(low[v], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    pv = work[-1][0]
+                    low[pv] = min(low[pv], low[v])
+                if low[v] == index[v]:
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        sccs[w] = scc_id[0]
+                        if w == v:
+                            break
+                    scc_id[0] += 1
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+
+        scc_size: dict[int, int] = {}
+        for v, s in sccs.items():
+            scc_size[s] = scc_size.get(s, 0) + 1
+
+        out = []
+        for (src, dst), site in sorted(clean.items()):
+            if (
+                src in sccs
+                and dst in sccs
+                and sccs[src] == sccs[dst]
+                and scc_size[sccs[src]] > 1
+            ):
+                out.append((src, dst, site, self._witness(adj, dst, src)))
+        return out
+
+    @staticmethod
+    def _witness(
+        adj: dict[str, set[str]], start: str, goal: str
+    ) -> list[str]:
+        """Shortest path start -> goal (BFS) to render one cycle."""
+        if start == goal:
+            return [start]
+        seen = {start}
+        frontier = [[start]]
+        while frontier:
+            nxt = []
+            for path in frontier:
+                for w in sorted(adj.get(path[-1], ())):
+                    if w == goal:
+                        return path + [w]
+                    if w not in seen:
+                        seen.add(w)
+                        nxt.append(path + [w])
+            frontier = nxt
+        return [start, goal]

@@ -1,0 +1,114 @@
+"""SARIF 2.1.0 output: findings as CI annotations.
+
+The Static Analysis Results Interchange Format is what code hosts ingest
+to render inline PR annotations; ``pandalint --format sarif`` emits one
+run with the full rule catalog as ``tool.driver.rules`` and one result
+per ACTIVE finding (suppressed findings ride along with a
+``suppressions`` entry so the host shows them struck through, matching
+the in-tree reasoned-pragma convention).
+
+Kept deliberately minimal and deterministic (stable rule ordering, no
+timestamps): the golden-file test diffs the whole document.
+"""
+
+from __future__ import annotations
+
+from tools.pandalint.checkers import rule_catalog
+
+_ENGINE_RULES = {
+    "SUP001": "suppression pragma without a reason",
+    "SUP002": "stale suppression: pragma matches no finding",
+    "SYN001": "file fails to parse",
+}
+
+
+def _rule_index() -> dict[str, int]:
+    rules = sorted(rule_catalog()) + sorted(_ENGINE_RULES)
+    return {rule: i for i, rule in enumerate(rules)}
+
+
+def _rules_array() -> list[dict]:
+    cat = rule_catalog()
+    out = []
+    for rule in sorted(cat):
+        checker, desc = cat[rule]
+        out.append(
+            {
+                "id": rule,
+                "shortDescription": {"text": desc},
+                "properties": {"checker": checker},
+            }
+        )
+    for rule in sorted(_ENGINE_RULES):
+        out.append(
+            {
+                "id": rule,
+                "shortDescription": {"text": _ENGINE_RULES[rule]},
+                "properties": {"checker": "engine"},
+            }
+        )
+    return out
+
+
+def _result(finding, index: dict[str, int]) -> dict:
+    res = {
+        "ruleId": finding.rule,
+        "ruleIndex": index.get(finding.rule, -1),
+        "level": "error" if finding.rule == "SYN001" else "warning",
+        "message": {"text": finding.message},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": finding.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": finding.line,
+                        # SARIF columns are 1-based; Finding.col is 0-based
+                        "startColumn": finding.col + 1,
+                    },
+                }
+            }
+        ],
+        "partialFingerprints": {"pandalint/v1": finding.fingerprint()},
+    }
+    if finding.suppressed:
+        res["suppressions"] = [
+            {
+                "kind": "inSource",
+                "justification": finding.suppress_reason,
+            }
+        ]
+    return res
+
+
+def to_sarif(findings: list, *, include_suppressed: bool = True) -> dict:
+    """findings: Finding objects (active first is NOT required; order is
+    preserved as given — callers pass a deterministically sorted list)."""
+    index = _rule_index()
+    results = [
+        _result(f, index)
+        for f in findings
+        if include_suppressed or not f.suppressed
+    ]
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+            "Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "pandalint",
+                        "informationUri": "tools/pandalint/README.md",
+                        "rules": _rules_array(),
+                    }
+                },
+                "columnKind": "utf16CodeUnits",
+                "results": results,
+            }
+        ],
+    }
