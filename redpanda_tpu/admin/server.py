@@ -23,6 +23,15 @@ Parity with redpanda/admin_server.cc:
 - GET  /v1/trace/recent, /v1/trace/slow (pandaprobe span traces; no
   reference analogue — seastar requests never leave their shard, ours
   cross the engine's harvester thread)
+- GET  /v1/trace/id/{tid}              (this node's spans for one trace)
+- GET  /v1/trace/cluster[/{tid}]       (pandascope: the trace assembled
+  across every broker it touched — fan-out over each node's admin; no id
+  = assemble the local slow ring's traces; rpk debug trace --cluster)
+- GET  /v1/federation/metrics          (merged multi-node /metrics scrape,
+  HdrHists merged bucket-by-bucket, node label preserved)
+- GET  /v1/slo?federated=1             (the SLO spec judged over the
+  federated scrape; POST /v1/slo/mark?federated=1 brackets cluster-wide
+  incident windows; rpk debug slo --federated)
 - GET  /v1/status/ready
 Served on the owned HTTP server (the reference uses seastar httpd with swagger routes).
 """
@@ -74,6 +83,7 @@ class AdminServer:
         self.auth_token = auth_token
         self._runner: web.AppRunner | None = None
         self._log_level_restores: dict[str, tuple[int, asyncio.TimerHandle]] = {}
+        self._federated_slo = None  # lazy: observability.federation
 
     # ------------------------------------------------------------ auth
     _OPEN_PATHS = ("/metrics", "/v1/status/ready")
@@ -141,6 +151,10 @@ class AdminServer:
             web.get("/metrics", self._metrics),
             web.get("/v1/trace/recent", self._trace_recent),
             web.get("/v1/trace/slow", self._trace_slow),
+            web.get("/v1/trace/id/{trace_id}", self._trace_by_id),
+            web.get("/v1/trace/cluster", self._trace_cluster_slow),
+            web.get("/v1/trace/cluster/{trace_id}", self._trace_cluster),
+            web.get("/v1/federation/metrics", self._federation_metrics),
             web.get("/v1/status/ready", self._ready),
         ])
         from redpanda_tpu.utils.http_server import start_site
@@ -475,7 +489,24 @@ class AdminServer:
                               f"{req.query['count']!r}"},
                     status=400,
                 )
+        delay_ms = None
+        if "delay_ms" in req.query:
+            # the injected-delay knob is process-local state; a REMOTE
+            # chaos driver (multi-process loadgen, rpk) has no other way
+            # to size the fault it is arming in this broker
+            try:
+                delay_ms = int(req.query["delay_ms"])
+                if delay_ms < 1:
+                    raise ValueError(delay_ms)
+            except ValueError:
+                return web.json_response(
+                    {"error": f"delay_ms must be a positive integer, got "
+                              f"{req.query['delay_ms']!r}"},
+                    status=400,
+                )
         honey_badger.enable()
+        if delay_ms is not None:
+            honey_badger.delay_ms = delay_ms
         if typ == "exception":
             honey_badger.set_exception(module, probe, count)
         elif typ == "delay":
@@ -489,6 +520,8 @@ class AdminServer:
         body = {"armed": f"{module}.{probe}", "type": typ}
         if count is not None:
             body["count"] = count
+        if delay_ms is not None:
+            body["delay_ms"] = delay_ms
         return web.json_response(body)
 
     async def _unset_probe(self, req: web.Request) -> web.Response:
@@ -580,6 +613,22 @@ class AdminServer:
         from redpanda_tpu.observability.slo import slo
 
         mark = req.query.get("mark")
+        if req.query.get("federated", "").lower() in ("1", "true", "yes"):
+            # judge the active spec over the MERGED multi-node scrape
+            # instead of this process's registry — `rpk debug slo
+            # --federated`; marks live in the federated engine, so a
+            # federated mark brackets a cluster-wide incident window
+            fed = self._federation()
+            try:
+                report = await fed.evaluate(slo.spec, mark=mark)
+            except KeyError:
+                return web.json_response(
+                    {"error": f"unknown federated mark {mark!r}",
+                     "marks": fed.marks()},
+                    status=404,
+                )
+            report["marks"] = fed.marks()
+            return web.json_response(report)
         try:
             report = slo.evaluate(mark=mark)
         except KeyError:
@@ -594,10 +643,18 @@ class AdminServer:
     async def _slo_mark(self, req: web.Request) -> web.Response:
         """Snapshot every histogram as a named baseline, so a later
         GET /v1/slo?mark=NAME judges only what happened since — the
-        bracket an operator (or the chaos suite) puts around an incident."""
+        bracket an operator (or the chaos suite) puts around an incident.
+        ``?federated=1`` snapshots the merged cluster scrape instead."""
         from redpanda_tpu.observability.slo import slo
 
         name = req.query.get("name", "default")
+        if req.query.get("federated", "").lower() in ("1", "true", "yes"):
+            meta = await self._federation().set_mark(name)
+            return web.json_response({
+                "mark": name, "federated": True,
+                "nodes": meta.get("nodes", []),
+                "unreachable": meta.get("unreachable", []),
+            })
         series = slo.set_mark(name)
         return web.json_response({"mark": name, "series": series})
 
@@ -636,4 +693,144 @@ class AdminServer:
             "enabled": tracer.enabled,
             "threshold_ms": tracer.slow_threshold_us / 1000.0,
             "spans": tracer.slow(limit),
+        })
+
+    # ---------------------------------------------------- cluster traces
+    def _admin_targets(self) -> list[tuple[int, str | None]]:
+        """[(node_id, admin_base_url | None)] for every active broker —
+        the fan-out set of the pandascope plane. Self always dials its own
+        listener (uniform HTTP path, no special case); a peer that never
+        advertised an admin port (pre-pandascope log entry) maps to None
+        and is reported unreachable rather than silently skipped."""
+        me = self.broker.config.node_id
+        self_url = f"http://{self.host}:{self.port}"
+        if self.controller is None:
+            return [(me, self_url)]
+        out: list[tuple[int, str | None]] = []
+        for b in self.controller.members.all_brokers():
+            if b.node_id == me:
+                out.append((b.node_id, self_url))
+            elif getattr(b, "admin_port", 0):
+                out.append((b.node_id, f"http://{b.host}:{b.admin_port}"))
+            else:
+                out.append((b.node_id, None))
+        return out or [(me, self_url)]
+
+    def _peer_headers(self) -> dict[str, str] | None:
+        """Credentials the pandascope fan-out presents to PEER admins.
+        Under auth every /v1/trace/* and federated route requires them —
+        without this, enabling admin_api_require_auth would silently turn
+        every cluster view into a one-node 'partial' (each peer 401s and
+        reads as unreachable). The bearer token is cluster-wide by
+        operational convention (one token in the deploy config); a
+        cluster running per-node tokens degrades to the visible partial
+        view rather than anything silent."""
+        if self.require_auth and self.auth_token:
+            return {"Authorization": f"Bearer {self.auth_token}"}
+        return None
+
+    async def _trace_by_id(self, req: web.Request) -> web.Response:
+        """THIS node's surviving spans for one trace id — the per-node leg
+        the cluster assembler fans out to."""
+        from redpanda_tpu.observability import tracer
+
+        try:
+            tid = int(req.match_info["trace_id"])
+        except ValueError:
+            return web.json_response(
+                {"error": "trace_id must be an int"}, status=400
+            )
+        spans = tracer.spans_for(tid)
+        me = self.broker.config.node_id
+        return web.json_response({
+            "trace_id": tid,
+            "node": me,
+            "epoch": tracer.epoch_wall,
+            "spans": spans,
+        })
+
+    async def _trace_cluster(self, req: web.Request) -> web.Response:
+        """ONE trace assembled cluster-wide: fan out to every node's
+        /v1/trace/id/<tid>, merge by trace id — produce → raft replicate →
+        follower append → coproc dispatch as a single multi-node trace."""
+        from redpanda_tpu.observability import federation
+
+        try:
+            tid = int(req.match_info["trace_id"])
+        except ValueError:
+            return web.json_response(
+                {"error": "trace_id must be an int"}, status=400
+            )
+        trace = await federation.assemble_cluster_trace(
+            self._admin_targets(), tid, headers=self._peer_headers()
+        )
+        return web.json_response(trace)
+
+    async def _trace_cluster_slow(self, req: web.Request) -> web.Response:
+        """Assembled cluster traces for the LOCAL slow ring's newest trace
+        ids — what the debug bundle captures as cluster_traces.json: the
+        requests that breached, stitched across every broker they touched."""
+        from redpanda_tpu.observability import federation, tracer
+
+        try:
+            limit = max(1, min(16, int(req.query.get("limit", "5"))))
+        except ValueError:
+            return web.json_response({"error": "limit must be an int"}, status=400)
+        tids: list[int] = []
+        for s in tracer.slow(limit=200):
+            if s["trace_id"] not in tids:
+                tids.append(s["trace_id"])
+            if len(tids) >= limit:
+                break
+        targets = self._admin_targets()
+        headers = self._peer_headers()
+        # concurrent per-trace fan-outs: the assemblies are independent,
+        # and awaiting them serially would multiply an unreachable node's
+        # timeout by the trace count (a dead peer must cost ONE timeout,
+        # not one per bundle entry)
+        traces = list(
+            await asyncio.gather(
+                *(
+                    federation.assemble_cluster_trace(
+                        targets, tid, headers=headers
+                    )
+                    for tid in tids
+                )
+            )
+        )
+        return web.json_response({
+            "enabled": tracer.enabled,
+            "targets": [
+                {"node": n, "url": u, "reachable": u is not None}
+                for n, u in targets
+            ],
+            "traces": traces,
+        })
+
+    # ---------------------------------------------------- federation
+    def _federation(self):
+        if self._federated_slo is None:
+            from redpanda_tpu.observability.federation import FederatedSlo
+
+            self._federated_slo = FederatedSlo(
+                self._admin_targets, headers_fn=self._peer_headers
+            )
+        return self._federated_slo
+
+    async def _federation_metrics(self, req: web.Request) -> web.Response:
+        """The merged cluster window in JSON registry form: every series
+        scraped off every node's /metrics, HdrHists merged additively with
+        the per-node contributions preserved under the node label —
+        federated_metrics.json in the debug bundle."""
+        from redpanda_tpu.observability import federation
+
+        snap = await federation.federated_snapshot(
+            self._admin_targets(), headers=self._peer_headers()
+        )
+        meta = snap.pop("__meta__", {})
+        return web.json_response({
+            "nodes": meta.get("nodes", []),
+            "unreachable": meta.get("unreachable", []),
+            "partial": bool(meta.get("unreachable")),
+            "series": snap,
         })

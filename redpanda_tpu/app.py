@@ -107,6 +107,9 @@ class Application:
             enabled=c.trace_enabled,
             capacity=c.trace_ring_capacity,
             slow_threshold_ms=float(c.trace_slow_threshold_ms),
+            # namespace trace/span ids by node: cluster-assembled traces
+            # merge by trace id across brokers, so ids must never collide
+            node_id=c.node_id,
         )
         # SLO engine: operator objectives (or the lenient broker defaults)
         # judged at GET /v1/slo; loading arms per-metric breach thresholds
@@ -173,6 +176,27 @@ class Application:
             "admin": self.admin_tls,
         }
         self._stop_order.append(self.admin)
+
+        if is_clustered:
+            # announce ourselves AFTER the admin server is up so the
+            # register_node command can advertise the real (possibly
+            # ephemeral) admin port — the cluster observability plane
+            # (trace fan-out, /metrics federation) dials peers by it.
+            # In a real multi-process cluster the first election only
+            # completes after a MAJORITY of seed brokers finish interpreter
+            # startup (~10s each), so registration must outwait peers, not
+            # give up in the default few retries (tests/chaos drives this
+            # path with SIGKILLed real processes).
+            from redpanda_tpu.cluster import commands as ccmds
+
+            await self._dispatcher.replicate(
+                ccmds.register_node_cmd(
+                    c.node_id, c.rpc_server_host, self.rpc_server.port,
+                    c.advertised_kafka_api_host, c.advertised_kafka_api_port,
+                    admin_port=self.admin.port,
+                ),
+                retries=300,
+            )
 
         if c.coproc_enable:
             await self._start_coproc()
@@ -255,7 +279,7 @@ class Application:
                 )
 
         self.group_manager.register_leadership_notification(_on_leadership)
-        proto = rpc.SimpleProtocol()
+        proto = rpc.SimpleProtocol(node_id=c.node_id)
         self.group_manager.register_service(proto)
         ClusterService(self.controller, dispatcher).register(proto)
         # tx gateway: cross-node marker fan-out + staged-offset routing
@@ -312,19 +336,9 @@ class Application:
         self.broker.tx_coordinator.router = TxRouter(
             self.broker, self.broker.metadata_cache, self.connections
         )
-        # announce ourselves through the controller once a leader exists.
-        # In a real multi-process cluster the first election only completes
-        # after a MAJORITY of seed brokers finish interpreter startup (~10s
-        # each), so registration must outwait peers, not give up in the
-        # default few retries (tests/chaos drives this path with SIGKILLed
-        # real processes; raft_availability_test.py posture).
-        await dispatcher.replicate(
-            ccmds.register_node_cmd(
-                c.node_id, c.rpc_server_host, self.rpc_server.port,
-                c.advertised_kafka_api_host, c.advertised_kafka_api_port,
-            ),
-            retries=300,
-        )
+        # node registration happens in start() once the admin server is up
+        # (its port rides the register_node command for pandascope fan-out)
+        self._dispatcher = dispatcher
 
     async def _start_coproc(self) -> None:
         from redpanda_tpu.coproc.api import CoprocApi

@@ -326,6 +326,48 @@ async def cmd_debug(args) -> int:
     import time
 
     if args.debug_cmd == "trace":
+        if getattr(args, "cluster", False):
+            # pandascope: the cluster-assembled view — one trace stitched
+            # across every broker it touched (admin fans out to peers)
+            path = (
+                f"/v1/trace/cluster/{args.id}"
+                if args.id is not None
+                else f"/v1/trace/cluster?limit={args.limit}"
+            )
+            status, body = await _admin_request(args, "GET", path)
+            if status != 200:
+                print(f"admin api returned {status}: {body}")
+                return 1
+            if args.json:
+                print(json.dumps(body, indent=2))
+                return 0
+            try:
+                from tools.traceview import render_report, render_trace
+            except ImportError:
+                print(json.dumps(body, indent=2))
+                return 0
+            if args.id is not None:
+                if body.get("unreachable"):
+                    print(
+                        f"(partial view: nodes {body['unreachable']} "
+                        f"unreachable)"
+                    )
+                print(render_trace(body))
+                return 0
+            unreachable = [
+                t["node"] for t in body.get("targets", [])
+                if not t.get("reachable")
+            ]
+            if unreachable:
+                print(f"(partial view: nodes {unreachable} unreachable)")
+            if not body.get("traces"):
+                print(
+                    "no assembled cluster traces (slow ring empty — "
+                    "nothing breached the slow threshold yet)"
+                )
+                return 0
+            print(render_report(body, max_traces=args.limit))
+            return 0
         path = (
             f"/v1/trace/slow?limit={args.limit}"
             if args.slow
@@ -459,17 +501,36 @@ async def cmd_debug(args) -> int:
         # with '&'/'=' must not split the query; pre-quoting into the path
         # would get '%' re-encoded by _admin_request)
         if args.set_mark is not None:
+            query = {"name": args.set_mark}
+            if getattr(args, "federated", False):
+                query["federated"] = "1"
             status, body = await _admin_request(
-                args, "POST", "/v1/slo/mark", query={"name": args.set_mark}
+                args, "POST", "/v1/slo/mark", query=query
             )
             if status != 200:
                 print(f"admin api returned {status}: {body}")
                 return 1
-            print(f"mark {body['mark']!r} set over {body['series']} series")
+            if body.get("federated"):
+                print(
+                    f"federated mark {body['mark']!r} set over nodes "
+                    f"{body.get('nodes')}"
+                    + (
+                        f" (unreachable: {body['unreachable']})"
+                        if body.get("unreachable") else ""
+                    )
+                )
+            else:
+                print(
+                    f"mark {body['mark']!r} set over {body['series']} series"
+                )
             return 0
+        query = {}
+        if args.mark:
+            query["mark"] = args.mark
+        if getattr(args, "federated", False):
+            query["federated"] = "1"
         status, body = await _admin_request(
-            args, "GET", "/v1/slo",
-            query={"mark": args.mark} if args.mark else None,
+            args, "GET", "/v1/slo", query=query or None,
         )
         if status != 200:
             print(f"admin api returned {status}: {body}")
@@ -483,6 +544,16 @@ async def cmd_debug(args) -> int:
             f"({body.get('failed', 0)} failed, {body.get('no_data', 0)} no-data; "
             f"window {body.get('window')})"
         )
+        fed_meta = body.get("federation")
+        if fed_meta is not None:
+            line = (
+                f"federated over nodes {fed_meta.get('nodes')}"
+            )
+            if fed_meta.get("unreachable"):
+                line += (
+                    f" — PARTIAL: {fed_meta['unreachable']} unreachable"
+                )
+            print(line)
         print(
             f"{'OBJECTIVE':<24}{'METRIC':<30}{'Q':>5}{'OBSERVED':>12}"
             f"{'THRESHOLD':>12}{'SAMPLES':>9}  STATUS"
@@ -501,9 +572,19 @@ async def cmd_debug(args) -> int:
                     f"    breach exemplar: trace={ex['trace_id']} "
                     f"{ex['value_us'] / 1000.0:.2f}ms "
                     f"(bucket <= {ex['bucket_us'] / 1000.0:.2f}ms) — "
-                    f"`rpk debug trace --slow` resolves it"
+                    f"`rpk debug trace --slow` resolves it "
+                    f"(--cluster --id {ex['trace_id']} assembles it)"
                 )
-        if not body.get("exemplars_enabled", False):
+            for node, nv in sorted((o.get("per_node") or {}).items()):
+                obs_n = nv.get("observed_ms")
+                print(
+                    f"    node {node}: "
+                    f"{(('%.2fms' % obs_n) if obs_n is not None else '-')} "
+                    f"({nv.get('samples', 0)} samples, {nv.get('status')})"
+                )
+        if body.get("exemplars_enabled") is False:
+            # local reports only: the federated report has no exemplar
+            # layer at all (exemplar rings are per-process)
             print(
                 "note: tracer disabled — breaches carry no exemplars "
                 "(set trace_enabled: true)"
@@ -529,9 +610,14 @@ async def cmd_debug(args) -> int:
             return 0
         if args.fp_cmd == "arm":
             path = f"/v1/failure-probes/{args.module}/{args.probe}/{args.type}"
+            query = {}
             if args.count is not None:
-                path += f"?count={args.count}"
-            status, body = await _admin_request(args, "PUT", path)
+                query["count"] = str(args.count)
+            if getattr(args, "delay_ms", None) is not None:
+                query["delay_ms"] = str(args.delay_ms)
+            status, body = await _admin_request(
+                args, "PUT", path, query=query or None
+            )
         else:  # disarm
             status, body = await _admin_request(
                 args, "DELETE",
@@ -550,6 +636,10 @@ async def cmd_debug(args) -> int:
         ("partitions.json", "/v1/partitions"),
         ("metrics.txt", "/metrics"),
         ("traces.json", "/v1/trace/recent"),
+        # pandascope cluster view: the slow ring's traces assembled across
+        # every broker they touched + the merged multi-node scrape
+        ("cluster_traces.json", "/v1/trace/cluster"),
+        ("federated_metrics.json", "/v1/federation/metrics"),
         ("coproc.json", "/v1/coproc/status"),
         ("governor.json", "/v1/governor"),
         ("slo.json", "/v1/slo"),
@@ -748,6 +838,16 @@ def build_parser() -> argparse.ArgumentParser:
     dt.add_argument("--slow", action="store_true", help="slow-request log only")
     dt.add_argument("--limit", type=int, default=10, help="traces/spans to fetch")
     dt.add_argument("--json", action="store_true", help="raw JSON, no rendering")
+    dt.add_argument(
+        "--cluster", action="store_true",
+        help="pandascope: assemble traces across every broker they "
+             "touched (admin fans out to peers; no --id = the slow "
+             "ring's traces)",
+    )
+    dt.add_argument(
+        "--id", type=int, default=None, metavar="TRACE_ID",
+        help="with --cluster: assemble this one trace id",
+    )
     dc = dsub.add_parser(
         "coproc", help="engine breaker + fault-domain + stage stats"
     )
@@ -776,6 +876,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--set-mark", default=None, metavar="NAME",
         help="snapshot a named baseline instead of evaluating",
     )
+    dslo.add_argument(
+        "--federated", action="store_true",
+        help="judge the objectives over the merged multi-node /metrics "
+             "scrape (node-labeled drill-down) instead of this broker's "
+             "registry",
+    )
     dfp = dsub.add_parser(
         "failpoints", help="list/arm/disarm honey-badger failure probes"
     )
@@ -790,6 +896,11 @@ def build_parser() -> argparse.ArgumentParser:
     fpa.add_argument(
         "--count", type=int, default=None,
         help="auto-disarm after N injections (1 = one-shot)",
+    )
+    fpa.add_argument(
+        "--delay-ms", type=int, default=None, dest="delay_ms",
+        help="size the injected delay (the knob lives in the broker "
+             "process; remote chaos drivers have no other way to set it)",
     )
     fpd = fpsub.add_parser("disarm")
     fpd.add_argument("module")

@@ -161,12 +161,18 @@ def create_non_replicable_topic_cmd(
 
 
 def register_node_cmd(
-    node_id: NodeId, host: str, port: int, kafka_host: str, kafka_port: int
+    node_id: NodeId, host: str, port: int, kafka_host: str, kafka_port: int,
+    admin_port: int = 0,
 ) -> Command:
+    """``admin_port`` (0 = not advertised) lets peers dial this node's
+    admin API for the cluster observability plane — trace fan-out and
+    /metrics federation; old replicated log entries simply lack the key
+    and decode to 0 (admin-unreachable, a partial-merge degradation)."""
     return Command(
         CommandType.register_node,
         {"node_id": node_id, "host": host, "port": port,
-         "kafka_host": kafka_host, "kafka_port": kafka_port},
+         "kafka_host": kafka_host, "kafka_port": kafka_port,
+         "admin_port": admin_port},
     )
 
 

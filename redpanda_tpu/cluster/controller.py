@@ -274,6 +274,7 @@ class Controller:
                 Broker(
                     d["node_id"], d["host"], d["port"],
                     d.get("kafka_host", d["host"]), d.get("kafka_port", 9092),
+                    admin_port=d.get("admin_port", 0),
                 )
             )
             self.allocator.register_node(d["node_id"])
@@ -393,7 +394,10 @@ class Controller:
     # ------------------------------------------------------------ members frontend
     async def register_broker(self, b: Broker) -> None:
         await self.replicate_and_wait(
-            cmds.register_node_cmd(b.node_id, b.host, b.port, b.kafka_host, b.kafka_port)
+            cmds.register_node_cmd(
+                b.node_id, b.host, b.port, b.kafka_host, b.kafka_port,
+                admin_port=b.admin_port,
+            )
         )
 
     async def decommission_node(self, node_id: NodeId) -> None:

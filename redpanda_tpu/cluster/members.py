@@ -27,6 +27,7 @@ class Broker:
     port: int  # internal rpc
     kafka_host: str = "127.0.0.1"
     kafka_port: int = 9092
+    admin_port: int = 0  # 0 = not advertised (pre-pandascope log entries)
     state: MembershipState = MembershipState.active
 
 
@@ -51,6 +52,7 @@ class MembersTable:
             # re-join of a live node: update address only
             existing.host, existing.port = b.host, b.port
             existing.kafka_host, existing.kafka_port = b.kafka_host, b.kafka_port
+            existing.admin_port = b.admin_port
             self._notify(existing)
             return
         self._brokers[b.node_id] = b

@@ -38,6 +38,8 @@ JOIN_NODE_REQUEST = serde.S(
     ("port", serde.I32),
     ("kafka_host", serde.STRING),
     ("kafka_port", serde.I32),
+    # pandascope: peers dial this for trace fan-out + /metrics federation
+    ("admin_port", serde.I32),
 )
 JOIN_NODE_REPLY = REPLICATE_CMD_REPLY
 # Topic ops need LEADER-side logic (partition allocation, group ids), so
@@ -151,6 +153,7 @@ class ClusterService:
         cmd = cmds.register_node_cmd(
             req["node_id"], req["host"], req["port"],
             req["kafka_host"], req["kafka_port"],
+            admin_port=req.get("admin_port", 0),
         )
         try:
             if self.dispatcher is not None:
@@ -285,6 +288,7 @@ async def join_cluster(
                 "port": broker.port,
                 "kafka_host": broker.kafka_host,
                 "kafka_port": broker.kafka_port,
+                "admin_port": broker.admin_port,
             },
             timeout=5.0,
         )

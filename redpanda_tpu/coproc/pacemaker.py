@@ -128,7 +128,10 @@ class ScriptContext:
             return False
         # One trace per productive tick (idle ticks would drown the ring);
         # the read phase is back-dated into it once we know work exists.
-        with tracer.span("coproc.tick", root=True) as tick_span:
+        with tracer.span(
+            "coproc.tick", root=True,
+            node=self.pacemaker.broker.config.node_id,
+        ) as tick_span:
             tracer.record(
                 "coproc.read",
                 (time.perf_counter() - t_read0) * 1e6,

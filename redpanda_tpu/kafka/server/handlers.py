@@ -230,7 +230,9 @@ async def handle_produce(ctx) -> dict | None:
     # storage.append spans below join it via the ambient id. The latency
     # HISTOGRAM is recorded once at the dispatch layer (protocol._dispatch
     # → probes.kafka_produce_hist), which also covers decode/encode.
-    with tracer.span("kafka.produce", root=True) as sp:
+    with tracer.span(
+        "kafka.produce", root=True, node=ctx.broker.config.node_id
+    ) as sp:
         # carried out to the dispatch layer so the histogram record there
         # can attach a trace exemplar when this request breaches
         ctx.trace_id = sp.trace_id
@@ -387,7 +389,10 @@ async def handle_fetch(ctx) -> dict:
     # latency) but is exempt from the slow-request log: an empty long poll
     # hitting max_wait_ms is intentional waiting, and would otherwise bury
     # genuinely slow work in the slow ring. Histogram: protocol._dispatch.
-    with tracer.span("kafka.fetch", root=True, no_slow=True) as sp:
+    with tracer.span(
+        "kafka.fetch", root=True, no_slow=True,
+        node=ctx.broker.config.node_id,
+    ) as sp:
         ctx.trace_id = sp.trace_id
         return await _do_handle_fetch(ctx)
 

@@ -319,6 +319,78 @@ def window_delta(after: dict, before: dict | None) -> dict:
     }
 
 
+def judge_objective(
+    o: Objective,
+    after: dict | None,
+    before: dict | None = None,
+    *,
+    hdr_layout: bool = True,
+) -> dict:
+    """Judge ONE objective over a snapshot window — the shared core of the
+    process-local SloEngine and the cluster federation plane
+    (observability/federation.py), which judges the same objectives over a
+    merged multi-node scrape. ``after``/``before`` are ``_hist_window``-
+    shaped dicts for the objective's series (``after=None`` = metric not
+    registered). Returns the report entry WITHOUT exemplars — exemplar
+    attachment is a process-local concern (the federation plane has no
+    in-process exemplar ring to consult)."""
+    if after is None:
+        return {
+            **o.to_dict(),
+            "status": "NO_DATA",
+            "samples": 0,
+            "detail": "metric not registered",
+        }
+    w = window_delta(after, before)
+    samples = w["count"]
+    threshold_us = o.threshold_ms * 1000.0
+    if samples < max(1, o.min_samples):
+        return {**o.to_dict(), "status": "NO_DATA", "samples": samples}
+    observed_us = interpolate_quantile(
+        w["buckets"], samples, o.quantile, observed_max=w.get("max"),
+        hdr_layout=hdr_layout,
+    )
+    breach_pct = 100.0 * breach_fraction(
+        w["buckets"], samples, threshold_us, hdr_layout=hdr_layout
+    )
+    budget = o.effective_budget_pct
+    # An explicit budget_pct makes the error budget the verdict
+    # (e.g. "5% of fetches may long-poll past the bar"); otherwise
+    # the interpolated quantile judges the threshold directly.
+    if o.budget_pct is not None:
+        failed = breach_pct > budget
+    else:
+        failed = observed_us is not None and observed_us > threshold_us
+    return {
+        **o.to_dict(),
+        "status": "FAIL" if failed else "PASS",
+        "samples": samples,
+        "observed_ms": (
+            round(observed_us / 1000.0, 3) if observed_us is not None else None
+        ),
+        "mean_ms": round(w["sum"] / samples / 1000.0, 3),
+        "max_ms": round((w.get("max") or 0) / 1000.0, 3),
+        "breach_pct": round(breach_pct, 4),
+        "budget_pct": budget,
+    }
+
+
+def build_report(spec: SloSpec, results: list[dict], window: str,
+                 mark: str | None = None) -> dict:
+    """The /v1/slo and SLO_r0N.json report envelope around judged
+    objectives — shared by the local engine and the federation plane."""
+    n_fail = sum(1 for r in results if r["status"] == "FAIL")
+    return {
+        "scenario": spec.name,
+        "pass": n_fail == 0,
+        "objectives": results,
+        "failed": n_fail,
+        "no_data": sum(1 for r in results if r["status"] == "NO_DATA"),
+        "window": window,
+        **({"mark": mark} if mark else {}),
+    }
+
+
 class SloEngine:
     """Evaluates the active spec over the registry, with named baseline
     marks for windowed judgments. One process-wide instance (``slo``
@@ -418,72 +490,24 @@ class SloEngine:
         current = self.snapshot()
         results = []
         for o in spec.objectives:
-            after = current.get(o.series)
-            if after is None:
-                results.append({
-                    **o.to_dict(),
-                    "status": "NO_DATA",
-                    "samples": 0,
-                    "detail": "metric not registered",
-                })
-                continue
-            w = window_delta(after, (baseline or {}).get(o.series))
-            samples = w["count"]
-            threshold_us = o.threshold_ms * 1000.0
-            if samples < max(1, o.min_samples):
-                results.append({
-                    **o.to_dict(),
-                    "status": "NO_DATA",
-                    "samples": samples,
-                })
-                continue
             # hdr_layout=True: these windows come straight from the
             # registry's HdrHists, so the layout's bucket lower bounds are
             # authoritative (no auto-detect ambiguity)
-            observed_us = interpolate_quantile(
-                w["buckets"], samples, o.quantile, observed_max=w["max"],
+            entry = judge_objective(
+                o, current.get(o.series), (baseline or {}).get(o.series),
                 hdr_layout=True,
             )
-            breach_pct = 100.0 * breach_fraction(
-                w["buckets"], samples, threshold_us, hdr_layout=True
-            )
-            budget = o.effective_budget_pct
-            # An explicit budget_pct makes the error budget the verdict
-            # (e.g. "5% of fetches may long-poll past the bar"); otherwise
-            # the interpolated quantile judges the threshold directly.
-            if o.budget_pct is not None:
-                failed = breach_pct > budget
-            else:
-                failed = observed_us is not None and observed_us > threshold_us
-            entry = {
-                **o.to_dict(),
-                "status": "FAIL" if failed else "PASS",
-                "samples": samples,
-                "observed_ms": (
-                    round(observed_us / 1000.0, 3)
-                    if observed_us is not None else None
-                ),
-                "mean_ms": round(w["sum"] / samples / 1000.0, 3),
-                "max_ms": round(w["max"] / 1000.0, 3),
-                "breach_pct": round(breach_pct, 4),
-                "budget_pct": budget,
-            }
-            if failed and exemplars:
+            if entry["status"] == "FAIL" and exemplars:
                 entry["exemplars"] = [
                     e for e in probes.exemplars_for(o.series)
                     if since_ts is None or e.get("ts", 0) >= since_ts
                 ]
             results.append(entry)
-        n_fail = sum(1 for r in results if r["status"] == "FAIL")
-        return {
-            "scenario": spec.name,
-            "pass": n_fail == 0,
-            "objectives": results,
-            "failed": n_fail,
-            "no_data": sum(1 for r in results if r["status"] == "NO_DATA"),
-            "window": "since_mark" if (baseline or mark) else "process_lifetime",
-            **({"mark": mark} if mark else {}),
-        }
+        return build_report(
+            spec, results,
+            "since_mark" if (baseline or mark) else "process_lifetime",
+            mark,
+        )
 
 
 # Process-wide engine over the process-wide registry, like the tracer and
@@ -496,7 +520,9 @@ __all__ = [
     "SloEngine",
     "SloSpec",
     "breach_fraction",
+    "build_report",
     "interpolate_quantile",
+    "judge_objective",
     "slo",
     "window_delta",
 ]

@@ -37,6 +37,7 @@ from __future__ import annotations
 import collections
 import itertools
 import logging
+import os
 import threading
 import time
 from contextvars import ContextVar
@@ -46,6 +47,15 @@ logger = logging.getLogger("rptpu.observability.trace")
 # Ambient trace id for the current asyncio task / thread.
 _current_trace: ContextVar[int | None] = ContextVar("rptpu_trace_id", default=None)
 
+# Ambient NODE id: which broker's work this task is doing. Only entry-point
+# spans set it (``span(..., node=N)``) — the kafka handlers, the rpc server's
+# join span, the raft append_entries send — and child spans inherit it, so a
+# single process hosting several in-process brokers (the loadgen cluster
+# stack, the cluster test fixtures) still attributes each span to the right
+# node. A real one-broker-per-process deployment falls back to the tracer's
+# configured node id.
+_current_node: ContextVar[int | None] = ContextVar("rptpu_trace_node", default=None)
+
 _UNSET = object()
 
 
@@ -54,6 +64,7 @@ class _NoopSpan:
 
     __slots__ = ()
     trace_id = None
+    span_id = None
 
     def __enter__(self) -> "_NoopSpan":
         return self
@@ -66,6 +77,19 @@ class _NoopSpan:
 
 
 _NOOP = _NoopSpan()
+
+# Per-thread cached name: threading.current_thread().name walks the active
+# registry on every call (~2x a plain local lookup); one enabled span pays
+# it once per commit, and the propagation microbench prices that against
+# the <1%-of-an-rpc budget. Thread names here never change after spawn.
+_thread_name = threading.local()
+
+
+def _current_thread_name() -> str:
+    name = getattr(_thread_name, "v", None)
+    if name is None:
+        name = _thread_name.v = threading.current_thread().name
+    return name
 
 
 class _Detached:
@@ -83,19 +107,23 @@ class _Detached:
 
 
 class _Span:
-    __slots__ = ("_tracer", "name", "trace_id", "_token", "_t0", "extras",
-                 "_no_slow")
+    __slots__ = ("_tracer", "name", "trace_id", "span_id", "_token", "_t0",
+                 "extras", "_no_slow", "_node", "_ntoken")
 
     def __init__(
-        self, tracer: "Tracer", name: str, trace_id: int, no_slow: bool
+        self, tracer: "Tracer", name: str, trace_id: int, no_slow: bool,
+        node: int | None = None,
     ) -> None:
         self._tracer = tracer
         self.name = name
         self.trace_id = trace_id
+        self.span_id = tracer.new_span_id()
         self._token = None
         self._t0 = 0.0
         self.extras: dict | None = None
         self._no_slow = no_slow
+        self._node = node
+        self._ntoken = None
 
     def set(self, key: str, value) -> None:
         """Attach an extra (queue_us, device_us, bytes, ...) to this span."""
@@ -105,19 +133,31 @@ class _Span:
 
     def __enter__(self) -> "_Span":
         self._token = _current_trace.set(self.trace_id)
+        if self._node is not None:
+            # entry-point span: publish the node for every child span
+            self._ntoken = _current_node.set(self._node)
+        else:
+            self._node = _current_node.get()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc) -> bool:
         t1 = time.perf_counter()
         _current_trace.reset(self._token)
+        if self._ntoken is not None:
+            _current_node.reset(self._ntoken)
+            self._ntoken = None
+        # positional call: one enabled span commits per sampled rpc, and
+        # kwargs marshalling is measurable against the propagation budget
         self._tracer._commit(
             self.name,
             self.trace_id,
             self._t0,
             (t1 - self._t0) * 1e6,
             self.extras,
-            no_slow=self._no_slow,
+            self._no_slow,
+            self.span_id,
+            self._node,
         )
         return False
 
@@ -140,10 +180,12 @@ class Tracer:
         self._slow: collections.deque = collections.deque(maxlen=slow_capacity)
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
-        self._recorded = 0
+        self._span_ids = itertools.count(1)
+        self._node_id: int | None = None
         # wall-clock anchor so start_us is meaningful across processes
         self._epoch_wall = time.time()
         self._epoch_perf = time.perf_counter()
+        self._recorded = 0
 
     # ------------------------------------------------------------ config
     def configure(
@@ -152,12 +194,31 @@ class Tracer:
         enabled: bool | None = None,
         capacity: int | None = None,
         slow_threshold_ms: float | None = None,
+        node_id: int | None = None,
     ) -> None:
         with self._lock:
             if capacity is not None and capacity != self._ring.maxlen:
                 self._ring = collections.deque(self._ring, maxlen=capacity)
             if slow_threshold_ms is not None:
                 self.slow_threshold_us = float(slow_threshold_ms) * 1000.0
+            if node_id is not None and node_id != self._node_id:
+                # Namespace trace/span ids by node so a trace assembled
+                # across broker processes never merges two nodes' unrelated
+                # traces that happened to share a small counter value, and
+                # salt the counter start with per-INCARNATION entropy: a
+                # SIGKILLed-and-restarted broker seeding deterministically
+                # would reuse its previous life's exact ids, and peers'
+                # rings (which outlive the restart) would stitch both
+                # incarnations into one bogus cluster trace. 36 random
+                # bits leave 2^36+ spans of headroom inside the 40-bit
+                # counter field before a wrap could touch the node bits.
+                # The counters only ever RESEED on an actual node change —
+                # reconfiguring other knobs must not rewind ids.
+                self._node_id = int(node_id)
+                base = ((self._node_id + 1) & 0xFFFF) << 40
+                salt = int.from_bytes(os.urandom(5), "big") >> 4  # 36 bits
+                self._ids = itertools.count(base | salt | 1)
+                self._span_ids = itertools.count(base | salt | 1)
         if enabled is not None:
             self.enabled = enabled  # last: spans only start once ring is sized
 
@@ -171,6 +232,13 @@ class Tracer:
     def new_trace_id(self) -> int:
         return next(self._ids)
 
+    def new_span_id(self) -> int:
+        return next(self._span_ids)
+
+    @property
+    def node_id(self) -> int | None:
+        return self._node_id
+
     def current_trace(self) -> int | None:
         """Ambient trace id (None when disabled or outside any span) —
         what cross-thread hops stamp onto their request objects."""
@@ -178,14 +246,30 @@ class Tracer:
             return None
         return _current_trace.get()
 
+    def current_node(self) -> int | None:
+        """Ambient node id set by the nearest entry-point span, or the
+        tracer's configured node (None when neither is known)."""
+        n = _current_node.get()
+        return n if n is not None else self._node_id
+
     @property
     def spans_recorded(self) -> int:
         return self._recorded
 
+    @property
+    def epoch_wall(self) -> float:
+        """Wall-clock time perf-epoch 0 corresponds to — what lets the
+        cluster assembler align span start_us across processes."""
+        return self._epoch_wall
+
+    @property
+    def epoch_perf(self) -> float:
+        return self._epoch_perf
+
     # ------------------------------------------------------------ spans
     def span(
         self, name: str, trace_id=_UNSET, *, root: bool = False,
-        no_slow: bool = False,
+        no_slow: bool = False, node: int | None = None,
     ):
         """Context manager timing one stage.
 
@@ -202,6 +286,9 @@ class Tracer:
         - ``no_slow=True``: exempt from the slow-request log — for spans
           whose duration is INTENTIONAL waiting (a fetch long poll), which
           would otherwise bury real slow work.
+        - ``node=N``: entry-point spans stamp which broker's work this is
+          and publish it to child spans (see ``_current_node``); child
+          spans inherit the ambient node automatically.
         """
         if not self.enabled:
             return _NOOP
@@ -215,7 +302,7 @@ class Tracer:
             return _NOOP
         else:
             tid = trace_id
-        return _Span(self, name, tid, no_slow)
+        return _Span(self, name, tid, no_slow, node=node)
 
     def detached(self):
         """Wrap creation of LONG-LIVED tasks (a replicate batcher's flush
@@ -250,16 +337,29 @@ class Tracer:
         t0: float,
         dur_us: float,
         extras: dict | None,
-        *,
         no_slow: bool = False,
+        span_id: int | None = None,
+        node: int | None = None,
     ) -> None:
         span = {
             "trace_id": trace_id,
             "name": name,
             "start_us": int((t0 - self._epoch_perf) * 1e6),
             "dur_us": int(dur_us),
-            "thread": threading.current_thread().name,
+            "thread": _current_thread_name(),
         }
+        if span_id is None:
+            span_id = self.new_span_id()  # manual record(): still unique
+        span["span_id"] = span_id
+        if node is None:
+            # ambient first: tracer.record() calls inside an entry-point
+            # span (pacemaker's back-dated read phase) belong to THAT
+            # broker, not to whichever in-process app configured last
+            node = _current_node.get()
+            if node is None:
+                node = self._node_id
+        if node is not None:
+            span["node"] = node
         if extras:
             span.update(extras)
         with self._lock:
@@ -310,6 +410,19 @@ class Tracer:
         """Newest-first spans that crossed the slow threshold."""
         with self._lock:
             return list(self._slow)[-limit:][::-1]
+
+    def spans_for(self, trace_id: int) -> list[dict]:
+        """Every surviving span of ONE trace, time-ordered — what the
+        cluster-trace assembler (GET /v1/trace/id/<tid> per node, merged by
+        admin fan-out) pulls. Ring and slow-ring hold the same dict objects,
+        so the union dedupes by identity: a slow span whose trace fell off
+        the main ring is still returned."""
+        with self._lock:
+            seen: dict[int, dict] = {}
+            for s in list(self._ring) + list(self._slow):
+                if s["trace_id"] == trace_id:
+                    seen[id(s)] = s
+        return sorted(seen.values(), key=lambda s: s["start_us"])
 
 
 # Process-wide tracer, like the metrics registry singleton: subsystems

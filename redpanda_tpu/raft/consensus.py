@@ -146,10 +146,15 @@ class Consensus:
         # tracer.detached() by span-hygiene design, so their rpc.send spans
         # (and SLO breach exemplars) carried no trace id at all. One
         # submitter's ambient trace id per coalesced flush round is sampled
-        # here and CONSUMED by the first append_entries send, so a breach
-        # report on the replicate path resolves to a real trace without
-        # re-attributing the long-lived tasks wholesale.
+        # here and consumed ONCE PER FOLLOWER (seq-tracked below) by the
+        # next append_entries send to that follower — so the sampled
+        # produce's cluster trace gains a JOINed leg on EVERY replica
+        # (pandascope wire propagation rides those sends) while the
+        # long-lived tasks are never re-attributed wholesale: a follower
+        # that already consumed this round's owner sends untraced again.
         self._replicate_owner: int | None = None
+        self._replicate_owner_seq = 0
+        self._owner_consumed: dict[int, int] = {}  # follower id -> seq
         self._snapshots = SnapshotManager(log.dir, name="raft_snapshot")
         self._snapshot_rx: dict | None = None  # in-progress chunked install
         self._transferring = False
@@ -549,15 +554,35 @@ class Consensus:
                         "batches": blob,
                         "flush": True,
                     }
-                    # consume-once owner trace: the span JOINS the sampled
-                    # submitter's trace for exactly one send (trace_id=None
-                    # = the usual untraced no-op), so the rpc.send
-                    # histogram record inside — and any exemplar a breach
-                    # captures — resolves to a real trace
-                    owner, self._replicate_owner = self._replicate_owner, None
+                    # consume-once-per-follower owner trace: the span JOINS
+                    # the sampled submitter's trace for exactly one send to
+                    # THIS follower per sampled round (trace_id=None = the
+                    # usual untraced no-op), so the rpc.send histogram
+                    # record inside — and any exemplar a breach captures —
+                    # resolves to a real trace, and the propagated context
+                    # lands a JOINed leg on every replica of the round.
+                    # Once every CURRENT follower consumed the round the
+                    # owner is cleared — without that, a follower added
+                    # (or rejoining) hours later would join an arbitrarily
+                    # stale trace and propagate it over the wire into an
+                    # unrelated, possibly recycled cluster view.
+                    owner = None
+                    seq = self._replicate_owner_seq
+                    if (
+                        self._replicate_owner is not None
+                        and self._owner_consumed.get(f.node.id, 0) < seq
+                    ):
+                        owner = self._replicate_owner
+                        self._owner_consumed[f.node.id] = seq
+                        if all(
+                            self._owner_consumed.get(fid, 0) >= seq
+                            for fid in self._followers
+                        ):
+                            self._replicate_owner = None
                     try:
                         with tracer.span(
-                            "raft.append_entries.send", trace_id=owner
+                            "raft.append_entries.send", trace_id=owner,
+                            node=self.self_node.id,
                         ):
                             reply = await self._client_for(f.node.id).append_entries(
                                 req, timeout=self.timings.rpc_timeout_s
@@ -975,6 +1000,7 @@ class _ReplicateBatcher:
         tid = tracer.current_trace()
         if tid is not None:
             self._c._replicate_owner = tid
+            self._c._replicate_owner_seq += 1
         self._pending.append((batches, enqueued, replicated, timeout))
         if self._flush_task is None or self._flush_task.done():
             # detached: under sustained load this task loops across MANY

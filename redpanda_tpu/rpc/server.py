@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from redpanda_tpu.observability.trace import tracer
 from redpanda_tpu.rpc import wire
 from redpanda_tpu.rpc.service import ServiceHandler
 
@@ -24,12 +25,17 @@ logger = logging.getLogger("rpc.server")
 
 
 class SimpleProtocol:
-    """Method-id dispatch over registered services."""
+    """Method-id dispatch over registered services.
+
+    ``node_id`` stamps the JOINed per-request span (pandascope): a
+    process hosting several in-process brokers shares one tracer, so the
+    span itself must say which broker served the request."""
 
     name = "vectorized internal rpc protocol"
 
-    def __init__(self) -> None:
+    def __init__(self, node_id: int | None = None) -> None:
         self._methods: dict[int, ServiceHandler] = {}
+        self.node_id = node_id
 
     def register_service(self, handler: ServiceHandler) -> None:
         for mid in handler.method_ids():
@@ -43,16 +49,13 @@ class SimpleProtocol:
         try:
             while True:
                 try:
-                    raw = await reader.readexactly(wire.HEADER_SIZE)
+                    h, ctx, body = await wire.read_message(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
-                h = wire.Header.decode(raw)
-                payload = await reader.readexactly(h.payload_size)
-                body = wire.open_payload(h, payload)
                 # Handlers overlap across requests on one connection; each
                 # response is written atomically under the lock.
                 t = asyncio.ensure_future(
-                    self._handle_one(h, body, writer, write_lock)
+                    self._handle_one(h, body, writer, write_lock, ctx)
                 )
                 pending.add(t)
                 t.add_done_callback(pending.discard)
@@ -60,14 +63,31 @@ class SimpleProtocol:
             for t in pending:
                 t.cancel()
 
-    async def _handle_one(self, h: wire.Header, body: bytes, writer, write_lock) -> None:
+    async def _handle_one(
+        self, h: wire.Header, body: bytes, writer, write_lock,
+        ctx: wire.TraceContext | None = None,
+    ) -> None:
         status = wire.STATUS_SUCCESS
         handler = self._methods.get(h.meta)
         if handler is None:
             status, reply = wire.STATUS_METHOD_NOT_FOUND, b""
         else:
             try:
-                reply = await handler.dispatch(h.meta, body)
+                # JOINed, never root: an inbound request without wire
+                # context (unsampled peer, tracer off) must not mint
+                # orphan traces — span(trace_id=None) is the usual no-op.
+                # Everything the handler awaits under this span (follower
+                # storage.append, coproc dispatch, nested sends) inherits
+                # the submitter's trace id and this broker's node stamp.
+                with tracer.span(
+                    "rpc.handle",
+                    trace_id=ctx.trace_id if ctx is not None else None,
+                    node=self.node_id,
+                ) as sp:
+                    if ctx is not None:
+                        sp.set("method_id", h.meta)
+                        sp.set("parent_span", ctx.parent_span_id)
+                    reply = await handler.dispatch(h.meta, body)
             except asyncio.CancelledError:
                 raise
             except SystemExit:

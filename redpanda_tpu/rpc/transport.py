@@ -69,10 +69,7 @@ class Transport:
         assert self._reader is not None
         try:
             while True:
-                raw = await self._reader.readexactly(wire.HEADER_SIZE)
-                h = wire.Header.decode(raw)
-                payload = await self._reader.readexactly(h.payload_size)
-                body = wire.open_payload(h, payload)
+                h, _ctx, body = await wire.read_message(self._reader)
                 fut = self._inflight.pop(h.correlation_id, None)
                 if fut is None or fut.done():
                     continue
@@ -113,8 +110,19 @@ class Transport:
                 corr = next(self._corr)
                 fut: asyncio.Future = asyncio.get_event_loop().create_future()
                 self._inflight[corr] = fut
+                # pandascope: a sampled request (live span joining an
+                # ambient trace) carries its context on the wire so the
+                # peer's handler span JOINs the same trace; an unsampled
+                # one (tracer off, no ambient trace — heartbeats) stays a
+                # version-0 frame with zero extra bytes
+                ctx = None
+                if sp.trace_id is not None:
+                    ctx = wire.TraceContext(sp.trace_id, sp.span_id, True)
                 self._writer.write(
-                    wire.frame(payload, method_id, corr, compress=self.compress)
+                    wire.frame(
+                        payload, method_id, corr, compress=self.compress,
+                        trace_ctx=ctx,
+                    )
                 )
                 await self._writer.drain()
                 try:

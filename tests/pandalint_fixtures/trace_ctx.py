@@ -1,0 +1,41 @@
+"""Fixture: ctx-less wire framing inside traced regions (TRC12xx)."""
+from redpanda_tpu.observability.trace import tracer
+from redpanda_tpu.rpc import wire
+from redpanda_tpu.rpc.wire import frame as mkframe
+
+
+async def send_unpropagated(writer, payload):
+    with tracer.span("rpc.send") as sp:
+        writer.write(wire.frame(payload, 1, 2))
+        writer.write(mkframe(payload, 1, 3))
+        h = wire.Header(payload_size=len(payload))
+        writer.write(h.encode() + payload)
+        await writer.drain()
+        return sp
+
+
+async def send_nested_block(writer, payload):
+    with tracer.span("outer"):
+        if payload:
+            # still lexically inside the span block
+            writer.write(wire.frame(payload, 1, 4))
+
+
+async def send_propagated(writer, payload):
+    with tracer.span("rpc.send") as sp:
+        ctx = wire.TraceContext(sp.trace_id, 0) if sp.trace_id else None
+        writer.write(wire.frame(payload, 1, 5, trace_ctx=ctx))  # clean: explicit
+        await writer.drain()
+
+
+def frame_outside_span(payload):
+    # clean: no live span scope, version-0 frame is the right call
+    return wire.frame(payload, 1, 6) + wire.Header().encode()
+
+
+async def helper_escapes(writer, payload):
+    with tracer.span("rpc.send"):
+        def build():
+            # nested def runs in its own scope: not flagged here
+            return wire.frame(payload, 1, 7)
+        writer.write(build())

@@ -1,0 +1,241 @@
+"""pandascope cross-node e2e: one produce, one trace, three brokers.
+
+Drives a REAL in-process 3-node cluster (the loadgen Stack over loopback
+rpc) and asserts the cluster observability plane end to end:
+
+* wire propagation — an acks=-1 produce on a replication-3 topic yields a
+  SINGLE trace id whose assembled cluster view
+  (``GET /v1/trace/cluster/<tid>``) contains spans from >= 3 distinct
+  nodes: the leader's produce/dispatch, its rpc.send, and the followers'
+  JOINed append legs;
+* federation — the same cluster's /metrics scraped from every node and
+  merged judges the SLO spec cluster-wide, and under an injected rpc.send
+  delay the federated window FAILs with a breach exemplar that resolves to
+  the cluster-assembled trace.
+
+Tier-1 sized: seconds of wall time, deterministic verdicts (min_samples 1,
+thresholds far from the clean/injected separation band).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+
+from redpanda_tpu.finjector import honey_badger
+from redpanda_tpu.observability import probes, tracer
+from redpanda_tpu.observability.slo import SloSpec, slo
+
+from tools.loadgen import Stack
+
+SCENARIO = {
+    "nodes": 3,
+    "replication": 3,
+    "coproc": False,
+    # Stack._configs reads objectives only for the slow-ring threshold
+    "objectives": [
+        {"name": "rpc_p99", "metric": "rpc_request_latency_us",
+         "quantile": 99, "threshold_ms": 100, "min_samples": 1},
+        {"name": "produce_p99", "metric": "kafka_produce_latency_us",
+         "quantile": 99, "threshold_ms": 500, "min_samples": 1},
+    ],
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    saved_delay = honey_badger.delay_ms
+    yield
+    honey_badger.disable()
+    honey_badger.delay_ms = saved_delay
+    from redpanda_tpu.observability.slo import DEFAULT_SPEC
+
+    probes.reset_exemplars()
+    slo.configure(DEFAULT_SPEC, arm_exemplars=False)
+    tracer.configure(enabled=False)
+    tracer.reset()
+
+
+async def _get_json(port: int, path: str) -> dict:
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://127.0.0.1:{port}{path}") as r:
+            assert r.status == 200, (path, r.status, await r.text())
+            return await r.json()
+
+
+async def _put(port: int, path: str) -> dict:
+    async with aiohttp.ClientSession() as s:
+        async with s.put(f"http://127.0.0.1:{port}{path}") as r:
+            body = await r.json()
+            assert r.status == 200, (path, r.status, body)
+            return body
+
+
+async def _produce_until_multinode_trace(stack, client, topic) -> dict:
+    """Produce acks=-1 rounds until one trace's cluster assembly spans
+    >= 3 nodes (the replicate batcher samples ONE owner trace per flush
+    round, so the very first produce usually works; retry bounds flake)."""
+    admin_port = stack.admin_ports[0]
+    deadline = time.monotonic() + 30.0
+    last = None
+    seq = 0
+    while time.monotonic() < deadline:
+        await client.produce(topic, 0, [b"pandascope-%d" % seq], acks=-1)
+        seq += 1
+        # the produce root span is the newest kafka.produce in the ring
+        doc = await _get_json(admin_port, "/v1/trace/recent?limit=10")
+        tids = [
+            t["trace_id"]
+            for t in doc["traces"]
+            if any(s["name"] == "kafka.produce" for s in t["spans"])
+        ]
+        for tid in tids:
+            assembled = await _get_json(
+                admin_port, f"/v1/trace/cluster/{tid}"
+            )
+            last = assembled
+            if len(assembled.get("nodes", [])) >= 3:
+                return assembled
+        await asyncio.sleep(0.2)
+    raise AssertionError(f"no >=3-node cluster trace assembled; last={last}")
+
+
+def test_produce_yields_three_node_cluster_trace(tmp_path):
+    async def run():
+        from redpanda_tpu.kafka.client import KafkaClient
+
+        stack = Stack(dict(SCENARIO), str(tmp_path))
+        try:
+            await stack.start()
+            client = await KafkaClient(stack.bootstrap()).connect()
+            try:
+                await client.create_topic(
+                    "scope-e2e", partitions=1, replication=3
+                )
+                assembled = await _produce_until_multinode_trace(
+                    stack, client, "scope-e2e"
+                )
+            finally:
+                await client.close()
+            return assembled
+        finally:
+            await stack.stop()
+
+    assembled = asyncio.run(run())
+    # ONE trace id, spans from >= 3 distinct brokers
+    assert len(assembled["nodes"]) >= 3, assembled["nodes"]
+    names = {s["name"] for s in assembled["spans"]}
+    assert "kafka.produce" in names
+    assert "rpc.send" in names
+    assert "rpc.handle" in names  # the JOINed follower leg
+    # every span carries the one assembled trace id
+    assert {s["trace_id"] for s in assembled["spans"]} == {
+        assembled["trace_id"]
+    }
+    # the follower's JOINed span is a different node than the produce root
+    produce_nodes = {
+        s["node"] for s in assembled["spans"] if s["name"] == "kafka.produce"
+    }
+    handle_nodes = {
+        s["node"] for s in assembled["spans"] if s["name"] == "rpc.handle"
+    }
+    assert handle_nodes - produce_nodes, (produce_nodes, handle_nodes)
+    # remote legs anchor to their sender: rpc.handle carries parent_span
+    assert any(
+        s.get("parent_span") for s in assembled["spans"]
+        if s["name"] == "rpc.handle"
+    )
+
+
+def test_federated_slo_fails_under_rpc_delay_with_resolvable_trace(tmp_path):
+    async def run():
+        from redpanda_tpu.kafka.client import KafkaClient
+
+        stack = Stack(dict(SCENARIO), str(tmp_path))
+        try:
+            await stack.start()
+            admin_port = stack.admin_ports[0]
+            client = await KafkaClient(stack.bootstrap()).connect()
+            try:
+                await client.create_topic(
+                    "scope-chaos", partitions=1, replication=3
+                )
+                await client.produce(
+                    "scope-chaos", 0, [b"warm"], acks=-1
+                )
+                # arm the scenario spec so rpc breaches record exemplars
+                spec = SloSpec.from_dict(
+                    {"name": "scope_chaos",
+                     "objectives": SCENARIO["objectives"]}
+                )
+                slo.configure(spec)
+                # bracket the incident: local AND federated marks
+                await _get_json(admin_port, "/v1/slo")  # warm the engine
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"http://127.0.0.1:{admin_port}/v1/slo/mark"
+                        f"?name=chaos&federated=1"
+                    ) as r:
+                        fed_mark = await r.json()
+                        assert r.status == 200, fed_mark
+                baseline = slo.snapshot()
+                # inject a 400ms rpc.send delay via the real admin API —
+                # far past the 100ms rpc objective, far under election
+                # timeouts (Stack configures 2500ms)
+                await _put(
+                    admin_port,
+                    "/v1/failure-probes/rpc/send/delay?delay_ms=400",
+                )
+                for i in range(3):
+                    await client.produce(
+                        "scope-chaos", 0, [b"chaos-%d" % i], acks=-1
+                    )
+                honey_badger.disable()
+                # federated verdict over the merged multi-node scrape
+                fed_report = await _get_json(
+                    admin_port, "/v1/slo?federated=1&mark=chaos"
+                )
+                local_report = slo.evaluate(spec, baseline=baseline)
+            finally:
+                await client.close()
+            fed_by_name = {
+                o["name"]: o for o in fed_report["objectives"]
+            }
+            local_by_name = {
+                o["name"]: o for o in local_report["objectives"]
+            }
+            # the exemplar of the local rpc breach resolves to a
+            # cluster-assembled trace spanning more than one broker
+            exemplars = local_by_name["rpc_p99"].get("exemplars") or []
+            assembled = None
+            for ex in exemplars:
+                doc = await _get_json(
+                    admin_port, f"/v1/trace/cluster/{ex['trace_id']}"
+                )
+                if doc["spans"]:
+                    assembled = doc
+                    break
+            return fed_report, fed_by_name, local_by_name, assembled
+        finally:
+            await stack.stop()
+
+    fed_report, fed_by_name, local_by_name, assembled = asyncio.run(run())
+    # the federated window judged the injected delay: rpc p99 FAILs
+    assert fed_by_name["rpc_p99"]["status"] == "FAIL", fed_by_name
+    assert fed_report["pass"] is False
+    assert fed_report["window"] == "since_mark"
+    # the verdict provably came from a multi-node scrape
+    assert len(fed_report["federation"]["nodes"]) == 3
+    assert fed_report["federation"]["unreachable"] == []
+    assert fed_by_name["rpc_p99"].get("per_node"), "node drill-down missing"
+    assert any(
+        "node=" in k for k in fed_report["federation"]["node_series"]
+    )
+    # the local breach carried an exemplar that resolves to the
+    # cluster-assembled trace
+    assert local_by_name["rpc_p99"]["status"] == "FAIL"
+    assert assembled is not None, "no exemplar resolved to a cluster trace"
+    assert len(assembled["nodes"]) >= 2, assembled["nodes"]

@@ -250,10 +250,13 @@ def test_microbench_runs_and_reports(tmp_path):
     if is_available(Compression.zstd):
         expected |= {"zstd_compress_mb_s", "zstd_uncompress_mb_s"}
     assert expected <= set(out), out
-    # rates/costs must be positive; the tracer-overhead percentages are
-    # MEANT to sit at ~0 (a 0.0 reading is the bench's best outcome)
+    # rates/costs must be positive; the tracer-overhead percentages and
+    # the propagation bench's disabled-tracer wire delta are MEANT to sit
+    # at 0 (a 0.0 reading is the bench's best outcome)
     assert all(
         v > 0 for k, v in out.items()
         if not k.endswith("_skipped") and not k.endswith("_pct")
+        and not k.endswith("_extra_bytes")
     ), out
+    assert out["propagation_disabled_extra_bytes"] == 0
     assert all(v >= 0 for k, v in out.items() if k.endswith("_pct")), out

@@ -1,0 +1,324 @@
+"""pandascope federation plane: parse/merge exactness + degradation.
+
+The load-bearing property: merging per-node scrapes bucket-by-bucket is
+EXACT — ``merge(scrape(A), scrape(B))`` yields the same buckets, counts,
+sums and interpolated quantiles as recording every observation into one
+registry. Everything the federated SLO verdicts stand on reduces to it.
+Degradation contract: a stale/unreachable node means a PARTIAL merge with
+the missing nodes named and the ``federation_nodes_unreachable`` gauge
+moved — never a crash, never a silently-complete-looking total.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import random
+
+import pytest
+
+from redpanda_tpu.metrics import MetricsRegistry, registry as live_registry
+from redpanda_tpu.observability import federation as fed
+from redpanda_tpu.observability.slo import (
+    Objective,
+    SloSpec,
+    interpolate_quantile,
+    window_delta,
+)
+
+KEY = "kafka_produce_latency_us"
+
+
+def _three_way_split(observations, labels=()):
+    """One combined registry + three per-node registries with the same
+    observations split round-robin; returns (single, {node: registry})."""
+    single = MetricsRegistry()
+    nodes = {str(i): MetricsRegistry() for i in range(3)}
+    hs = single.histogram(KEY, "x", **dict(labels))
+    per = {
+        n: r.histogram(KEY, "x", **dict(labels)) for n, r in nodes.items()
+    }
+    for i, v in enumerate(observations):
+        hs.record(v)
+        per[str(i % 3)].record(v)
+    return single, nodes
+
+
+def test_merge_is_exact_vs_single_registry():
+    rng = random.Random(11)
+    obs = (
+        [rng.randint(1, 50) for _ in range(500)]
+        + [rng.randint(100_000, 5_000_000) for _ in range(500)]  # bimodal
+    )
+    single, nodes = _three_way_split(obs)
+    merged = fed.merge_scrapes({
+        n: fed.parse_prometheus(r.render_prometheus())
+        for n, r in nodes.items()
+    })
+    want = fed.parse_prometheus(single.render_prometheus())[KEY]
+    got = merged[KEY]
+    assert got["buckets"] == want["buckets"]
+    assert got["count"] == want["count"] == len(obs)
+    assert got["sum"] == want["sum"] == sum(obs)
+    for q in (50.0, 90.0, 99.0, 99.9):
+        qm = interpolate_quantile(
+            got["buckets"], got["count"], q, observed_max=got["max"],
+            hdr_layout=True,
+        )
+        qs = interpolate_quantile(
+            want["buckets"], want["count"], q, hdr_layout=True,
+        )
+        assert qm == pytest.approx(qs), q
+
+
+def test_merge_quantiles_match_true_hdr_quantiles():
+    """The merged scrape round-trips through prometheus TEXT — quantiles
+    must still match the live HdrHist within bucket resolution."""
+    rng = random.Random(5)
+    obs = [rng.randint(1, 2_000_000) for _ in range(4000)]
+    single, nodes = _three_way_split(obs)
+    merged = fed.merge_scrapes({
+        n: fed.parse_prometheus(r.render_prometheus())
+        for n, r in nodes.items()
+    })[KEY]
+    hs = single.histogram(KEY, "x")
+    for q in (90.0, 99.0):
+        qm = interpolate_quantile(
+            merged["buckets"], merged["count"], q,
+            observed_max=merged["max"], hdr_layout=True,
+        )
+        # percentile() reports the bucket upper bound; interpolation must
+        # land at or below it and above the previous bucket's floor
+        assert qm <= hs.hist.percentile(q)
+
+
+def test_node_label_preserved_for_drilldown():
+    obs = list(range(1, 301))
+    _single, nodes = _three_way_split(obs)
+    merged = fed.merge_scrapes({
+        n: fed.parse_prometheus(r.render_prometheus())
+        for n, r in nodes.items()
+    })[KEY]
+    assert set(merged["nodes"]) == {"0", "1", "2"}
+    assert sum(v["count"] for v in merged["nodes"].values()) == len(obs)
+    # per-node windows are themselves judgeable snapshots
+    for v in merged["nodes"].values():
+        assert v["buckets"] and v["count"] == 100
+
+
+def test_labeled_series_key_join():
+    labels = (("stage", "explode"),)
+    single, nodes = _three_way_split([5, 10, 20], labels=labels)
+    merged = fed.merge_scrapes({
+        n: fed.parse_prometheus(r.render_prometheus())
+        for n, r in nodes.items()
+    })
+    key = f'{KEY}{{stage="explode"}}'
+    assert key in merged
+    assert merged[key]["count"] == 3
+
+
+def test_counter_sums_and_gauge_keeps_per_node():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("x_total", "c").inc(3)
+    b.counter("x_total", "c").inc(4)
+    a.gauge("depth", lambda: 7.0, "g")
+    b.gauge("depth", lambda: 9.0, "g")
+    merged = fed.merge_scrapes({
+        "0": fed.parse_prometheus(a.render_prometheus()),
+        "1": fed.parse_prometheus(b.render_prometheus()),
+    })
+    assert merged["x_total"]["value"] == 7
+    assert merged["depth"]["nodes"] == {"0": 7.0, "1": 9.0}
+
+
+def test_window_delta_over_federated_snapshots():
+    """Marks work across a federated window: the delta between two merged
+    snapshots judges only what happened between them."""
+    regs = {str(i): MetricsRegistry() for i in range(2)}
+    hists = {n: r.histogram(KEY, "x") for n, r in regs.items()}
+
+    def snap():
+        return fed.merge_scrapes({
+            n: fed.parse_prometheus(r.render_prometheus())
+            for n, r in regs.items()
+        })[KEY]
+
+    for h in hists.values():
+        for v in (10, 20, 30):
+            h.record(v)
+    before = snap()
+    hists["0"].record(1_000_000)
+    after = snap()
+    w = window_delta(after, before)
+    assert w["count"] == 1
+    q = interpolate_quantile(
+        w["buckets"], w["count"], 50.0, observed_max=w["max"],
+        hdr_layout=True,
+    )
+    assert q > 500_000  # only the new observation is in the window
+
+
+def test_unreachable_node_degrades_to_partial_merge():
+    """A dead target is reported and counted on the gauge; the merge over
+    the surviving nodes still lands — never a crash, never silence."""
+    r = MetricsRegistry()
+    r.histogram(KEY, "x").record(42)
+
+    async def run():
+        import http.server
+        import threading
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = r.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("content-length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            targets = [
+                (0, f"http://127.0.0.1:{srv.server_port}"),
+                (1, "http://127.0.0.1:1"),  # nothing listens there
+                (2, None),                  # never advertised an admin
+            ]
+            snap = await fed.federated_snapshot(targets, timeout_s=2.0)
+        finally:
+            srv.shutdown()
+            t.join()
+        return snap
+
+    snap = asyncio.run(run())
+    meta = snap["__meta__"]
+    assert meta["nodes"] == ["0"]
+    assert sorted(meta["unreachable"]) == ["1", "2"]
+    assert snap[KEY]["count"] == 1  # the reachable node's data survived
+    # the gauge moved (registered on the LIVE registry at import)
+    gauge_val = dict(
+        (g.name, g.fn())
+        for g in live_registry._gauges.values()
+        if g.name == "federation_nodes_unreachable"
+    )
+    assert gauge_val["federation_nodes_unreachable"] == 2.0
+
+
+def test_scrape_presents_peer_credentials():
+    """Under admin auth the fan-out must carry the caller's bearer token —
+    otherwise every peer 401s and reads as 'unreachable', silently turning
+    the cluster view into a one-node partial."""
+    r = MetricsRegistry()
+    r.histogram(KEY, "x").record(7)
+
+    async def run():
+        import http.server
+        import threading
+
+        seen: list[str] = []
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                auth = self.headers.get("Authorization", "")
+                seen.append(auth)
+                if auth != "Bearer sesame":
+                    self.send_response(401)
+                    self.end_headers()
+                    return
+                body = r.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("content-length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            targets = [(0, f"http://127.0.0.1:{srv.server_port}")]
+            # without credentials: partial (the degradation is visible)
+            bare = await fed.federated_snapshot(targets, timeout_s=2.0)
+            # with credentials: the scrape lands
+            authed = await fed.federated_snapshot(
+                targets, timeout_s=2.0,
+                headers={"Authorization": "Bearer sesame"},
+            )
+        finally:
+            srv.shutdown()
+            t.join()
+        return bare, authed, seen
+
+    bare, authed, seen = asyncio.run(run())
+    assert bare["__meta__"]["unreachable"] == ["0"]
+    assert authed["__meta__"]["unreachable"] == []
+    assert authed[KEY]["count"] == 1
+    assert "Bearer sesame" in seen
+
+
+def test_federated_slo_judges_merged_window():
+    regs = {str(i): MetricsRegistry() for i in range(3)}
+    for r in regs.values():
+        h = r.histogram(KEY, "x")
+        for _ in range(50):
+            h.record(1_000)       # 1ms: comfortably under threshold
+
+    class FakeFed(fed.FederatedSlo):
+        async def snapshot(self):  # no sockets: merge the registries
+            snap = fed.merge_scrapes({
+                n: fed.parse_prometheus(r.render_prometheus())
+                for n, r in regs.items()
+            })
+            snap["__meta__"] = {
+                "ts": 0.0, "nodes": sorted(regs), "unreachable": [],
+            }
+            return snap
+
+    spec = SloSpec("fedtest", [Objective("p99", KEY, 100.0, 99.0, 10)])
+    engine = FakeFed(lambda: [])
+    report = asyncio.run(engine.evaluate(spec))
+    o = report["objectives"][0]
+    assert o["status"] == "PASS"
+    assert o["samples"] == 150
+    assert set(o["per_node"]) == {"0", "1", "2"}
+    assert all(v["samples"] == 50 for v in o["per_node"].values())
+    assert report["federation"]["nodes"] == ["0", "1", "2"]
+    assert any("node=" in k for k in report["federation"]["node_series"])
+    # now breach it on ONE node; the merged verdict flips and the
+    # drill-down names the culprit
+    for _ in range(200):
+        regs["1"].histogram(KEY, "x").record(50_000_000)  # 50s
+    report = asyncio.run(engine.evaluate(spec))
+    o = report["objectives"][0]
+    assert o["status"] == "FAIL"
+    assert o["per_node"]["1"]["status"] == "FAIL"
+    assert o["per_node"]["0"]["status"] == "PASS"
+
+
+def test_parse_prometheus_escaped_labels_and_inf():
+    text = (
+        "# TYPE redpanda_tpu_h us histogram\n"
+        "# TYPE redpanda_tpu_h histogram\n"
+        'redpanda_tpu_h_bucket{stage="a\\"b",le="10"} 3\n'
+        'redpanda_tpu_h_bucket{stage="a\\"b",le="+Inf"} 5\n'
+        'redpanda_tpu_h_sum{stage="a\\"b"} 99\n'
+        'redpanda_tpu_h_count{stage="a\\"b"} 5\n'
+    )
+    out = fed.parse_prometheus(text)
+    # the parsed key joins with the local registry's series_key form
+    # (same escaping both sides)
+    from redpanda_tpu.metrics import series_key
+
+    key = series_key("h", (("stage", 'a"b'),))
+    assert key in out, out
+    e = out[key]
+    assert e["count"] == 5 and e["sum"] == 99
+    # +Inf bound never enters the finite bucket list
+    assert all(math.isfinite(u) for u, _ in e["buckets"])

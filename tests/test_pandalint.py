@@ -181,6 +181,36 @@ def test_sleep_async_exempts_finjector(tmp_path):
         )
 
 
+def test_trace_ctx_rules_exact_lines():
+    got = _active(_lint(os.path.join(FIXTURES, "trace_ctx.py")))
+    assert got == [
+        ("TRC1201", 9),   # wire.frame without trace_ctx in span
+        ("TRC1201", 10),  # from-imported alias mkframe(...)
+        ("TRC1202", 11),  # hand-rolled wire.Header
+        ("TRC1201", 21),  # nested if-block still inside the span
+    ]
+
+
+def test_trace_ctx_scope_and_escapes():
+    """Explicit trace_ctx= (even a None-valued variable), framing outside
+    any span scope, and nested defs are all clean — the rule targets the
+    silent drop, not every frame call."""
+    findings = _lint(os.path.join(FIXTURES, "trace_ctx.py"))
+    trc_lines = {f.line for f in findings if f.rule.startswith("TRC")}
+    # send_propagated (ctx kwarg), frame_outside_span, helper_escapes
+    for clean_line in (28, 34, 41):
+        assert clean_line not in trc_lines, sorted(trc_lines)
+
+
+def test_trace_ctx_transport_stays_clean():
+    """The real transport (the ONE sanctioned propagating sender) passes
+    trace_ctx= inside its rpc.send span — the in-tree proof the rule's
+    escape hatch is the idiom, not a pragma."""
+    path = os.path.join(REPO, "redpanda_tpu", "rpc", "transport.py")
+    findings = _lint(path, relpath="redpanda_tpu/rpc/transport.py")
+    assert not any(f.rule.startswith("TRC") for f in findings)
+
+
 def test_bare_except_rules_exact_lines():
     got = _active(_lint(os.path.join(FIXTURES, "bare_except.py")))
     assert got == [

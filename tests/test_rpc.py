@@ -156,6 +156,72 @@ def test_concurrent_requests_preserve_correlation():
     run(_with_server(go))
 
 
+def test_trace_ctx_frame_roundtrip():
+    ctx = wire.TraceContext(0xABCDEF0123, 0x42, True)
+    framed = wire.frame(b"payload", meta=9, correlation_id=3, trace_ctx=ctx)
+    h = wire.Header.decode(framed[: wire.HEADER_SIZE])
+    assert h.version == wire.VERSION_TRACE_CTX
+    got = wire.TraceContext.decode(
+        framed[wire.HEADER_SIZE : wire.HEADER_SIZE + wire.TRACE_CTX_SIZE]
+    )
+    assert got == ctx
+    body = framed[wire.HEADER_SIZE + wire.TRACE_CTX_SIZE :]
+    assert wire.open_payload(h, body) == b"payload"
+    with pytest.raises(wire.WireError):
+        wire.TraceContext.decode(b"short")
+
+
+def test_no_trace_ctx_adds_zero_wire_bytes():
+    """The propagation header is feature-flagged on the tracer: without a
+    sampled trace the frame is the classic version-0 layout byte-for-byte
+    — a disabled tracer costs NOTHING on the wire."""
+    plain = wire.frame(b"x" * 100, meta=1, correlation_id=7)
+    assert len(plain) == wire.HEADER_SIZE + 100
+    assert wire.Header.decode(plain[: wire.HEADER_SIZE]).version == 0
+
+    async def go(server, t):
+        from redpanda_tpu.observability import tracer
+
+        assert not tracer.enabled  # default posture in the test process
+        client = rpc.Client(echo_service, t)
+        assert (await client.echo({"text": "hi"}))["text"] == "hi"
+
+    run(_with_server(go))
+
+
+def test_server_joins_sampled_trace_never_roots():
+    """A sampled request's context rides the wire and the server opens a
+    JOINed rpc.handle span under the SAME trace id, anchored to the
+    sender's rpc.send span; an unsampled request (no ambient trace) adds
+    no bytes and mints no orphan trace."""
+    from redpanda_tpu.observability import tracer
+
+    async def go(server, t):
+        client = rpc.Client(echo_service, t)
+        tracer.configure(enabled=True)
+        tracer.reset()
+        try:
+            with tracer.span("test.root", root=True) as root:
+                await client.echo({"text": "sampled"})
+            # outside any span: unsampled, must not create traces
+            await client.echo({"text": "unsampled"})
+            spans = [s for tr in tracer.recent(0) for s in tr["spans"]]
+            sends = [s for s in spans if s["name"] == "rpc.send"]
+            handles = [s for s in spans if s["name"] == "rpc.handle"]
+            assert len(sends) == 1 and len(handles) == 1
+            assert sends[0]["trace_id"] == root.trace_id
+            assert handles[0]["trace_id"] == root.trace_id  # JOINed
+            assert handles[0]["parent_span"] == sends[0]["span_id"]
+            # no orphan trace exists for the unsampled echo
+            tids = {s["trace_id"] for s in spans}
+            assert tids == {root.trace_id}
+        finally:
+            tracer.configure(enabled=False)
+            tracer.reset()
+
+    run(_with_server(go))
+
+
 def test_unknown_method_404():
     async def go(server, t):
         with pytest.raises(RpcError) as ei:

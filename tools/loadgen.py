@@ -27,9 +27,17 @@ breach, and each breach carries trace exemplars. The cluster-level
 partition-tolerance suite over real broker *processes* lives in
 tests/chaos/test_partition_tolerance.py; this tool is the load half.
 
+Backends: ``--backend inproc`` (default) boots Applications inside this
+process and judges the shared registry directly; ``--backend proc`` boots
+REAL broker processes (the chaos harness's ProcCluster) and judges the
+scenario from the FEDERATED /metrics scrape
+(observability/federation.py) — the merged multi-node HdrHists, node
+labels preserved — which removes the one-loop ceiling on offered load.
+
 Usage:
     python tools/loadgen.py --scenario mixed_64p --report SLO_r06.json
     python tools/loadgen.py --scenario mixed_64p --chaos --report SLO_r06_chaos.json
+    python tools/loadgen.py --scenario mixed_64p --backend proc --report SLO_r10.json
     python tools/loadgen.py --list
 
 Scale: client counts multiply with ``--clients-scale`` (the default
@@ -182,6 +190,8 @@ class Stack:
     scenario snapshot/judge them directly while chaos arming still goes
     through the real admin API."""
 
+    backend = "inproc"
+
     def __init__(self, scenario: dict, base_dir: str, imposter=None):
         self.scenario = scenario
         self.base_dir = base_dir
@@ -313,12 +323,95 @@ class Stack:
     def bootstrap(self) -> list[tuple[str, int]]:
         return [("127.0.0.1", p) for p in self.kafka_ports]
 
+    async def transforms_active(self, script: str) -> bool:
+        return all(
+            a.coproc is not None and script in a.coproc.active_scripts()
+            for a in self.apps
+        )
+
     async def stop(self) -> None:
         for a in self.apps:
             try:
                 await a.stop()
             except Exception:
                 pass
+
+
+class ProcStack:
+    """REAL broker processes (the chaos harness's ProcCluster): nothing is
+    shared with this process, so scenario SLOs are judged from the
+    FEDERATED /metrics scrape (observability/federation.py) instead of the
+    in-process registry — removing the one-loop ceiling on offered load:
+    the brokers burn their own cores, and the judged histograms live where
+    the latency happened. Chaos arming and transform-activation polling go
+    through each node's real admin API. Tiered-storage scenarios are
+    inproc-only (archival run_once has no admin surface yet), so
+    ``tiered_readers`` is forced to 0 in this mode."""
+
+    backend = "proc"
+
+    def __init__(self, scenario: dict, base_dir: str, imposter=None):
+        assert imposter is None, "tiered scenarios are inproc-only"
+        self.scenario = scenario
+        self.base_dir = base_dir
+        self.cluster = None
+        self.kafka_ports: list[int] = []
+        self.admin_ports: list[int] = []
+
+    async def start(self) -> "ProcStack":
+        from chaos.harness import ProcCluster
+
+        s = self.scenario
+        thresholds = [o["threshold_ms"] for o in s["objectives"]]
+        extra = {
+            "default_topic_replication": s["replication"],
+            # same chaos posture as the in-process stack: an injected
+            # rpc delay must not trigger election storms
+            "raft_election_timeout_ms": 2500,
+            "raft_heartbeat_interval_ms": 250,
+            "coproc_enable": bool(s.get("coproc")),
+            "trace_enabled": True,
+            "trace_slow_threshold_ms": max(1, int(min(thresholds))),
+        }
+        self.cluster = await ProcCluster(
+            self.base_dir, n=s["nodes"], extra_config=extra
+        ).start()
+        self.kafka_ports = [n.ports["kafka"] for n in self.cluster.nodes]
+        self.admin_ports = [n.ports["admin"] for n in self.cluster.nodes]
+        return self
+
+    def bootstrap(self) -> list[tuple[str, int]]:
+        return [("127.0.0.1", p) for p in self.kafka_ports]
+
+    def federation_targets(self) -> list[tuple[int, str]]:
+        return [
+            (i, f"http://127.0.0.1:{p}")
+            for i, p in enumerate(self.admin_ports)
+        ]
+
+    async def transforms_active(self, script: str) -> bool:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as sess:
+            for port in self.admin_ports:
+                try:
+                    async with sess.get(
+                        f"http://127.0.0.1:{port}/v1/coproc/status",
+                        timeout=aiohttp.ClientTimeout(total=5),
+                    ) as r:
+                        doc = await r.json()
+                except Exception:
+                    return False
+                if (
+                    not doc.get("enabled")
+                    or script not in (doc.get("scripts") or [])
+                ):
+                    return False
+        return True
+
+    async def stop(self) -> None:
+        if self.cluster is not None:
+            await self.cluster.stop()
 
 
 # ================================================================ workloads
@@ -535,7 +628,7 @@ async def _tiered_reader(i, client, hi_offset, stop, stats):
 
 
 # ================================================================ setup
-async def _deploy_transform(stack: Stack, client) -> str:
+async def _deploy_transform(stack, client) -> str:
     """Deploy the JSON-filter transform through the real wasm-event path
     (what `rpk wasm deploy` produces) and wait until every node's engine
     activated it."""
@@ -557,12 +650,7 @@ async def _deploy_transform(stack: Stack, client) -> str:
             if time.monotonic() > deadline:
                 raise
             await asyncio.sleep(0.5)
-    def _active() -> bool:
-        return all(
-            a.coproc is not None and SCRIPT_NAME in a.coproc.active_scripts()
-            for a in stack.apps
-        )
-    while not _active():
+    while not await stack.transforms_active(SCRIPT_NAME):
         if time.monotonic() > deadline:
             raise TimeoutError("transform never activated on every node")
         await asyncio.sleep(0.1)
@@ -614,24 +702,48 @@ async def _setup_tiered(stack: Stack, client) -> int:
     return hwm
 
 
-async def _arm_chaos(stack: Stack, chaos: dict) -> dict:
+async def _arm_chaos(stack, chaos: dict) -> dict:
     """Arm the scenario's failure probe through the real admin API (and
-    size the injected delay), exactly like an operator with rpk."""
+    size the injected delay), exactly like an operator with rpk. The probe
+    is armed on EVERY node: in-process brokers share one honey badger so
+    repeats are idempotent, while real broker processes each own theirs —
+    one PUT per process is the only way the fault exists cluster-wide."""
     import aiohttp
 
-    from redpanda_tpu.finjector import honey_badger
-
-    honey_badger.delay_ms = int(chaos.get("delay_ms", 50))
-    url = (
-        f"http://127.0.0.1:{stack.admin_ports[0]}/v1/failure-probes/"
-        f"{chaos['module']}/{chaos['probe']}/{chaos['effect']}"
-    )
+    delay_ms = int(chaos.get("delay_ms", 50))
+    qs = f"?delay_ms={delay_ms}" if chaos["effect"] == "delay" else ""
+    body = None
     async with aiohttp.ClientSession() as s:
-        async with s.put(url) as resp:
-            body = await resp.json()
-            if resp.status != 200:
-                raise RuntimeError(f"chaos arm failed: {resp.status} {body}")
+        for port in stack.admin_ports:
+            url = (
+                f"http://127.0.0.1:{port}/v1/failure-probes/"
+                f"{chaos['module']}/{chaos['probe']}/{chaos['effect']}{qs}"
+            )
+            async with s.put(url) as resp:
+                body = await resp.json()
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"chaos arm failed on :{port}: {resp.status} {body}"
+                    )
     return {**chaos, "armed": body.get("armed")}
+
+
+async def _disarm_chaos(stack, chaos: dict) -> None:
+    """Disarm on every node (the proc backend has one badger per broker
+    process; honey_badger.disable() in this process reaches none of them)."""
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        for port in stack.admin_ports:
+            url = (
+                f"http://127.0.0.1:{port}/v1/failure-probes/"
+                f"{chaos['module']}/{chaos['probe']}"
+            )
+            try:
+                async with s.delete(url):
+                    pass
+            except Exception:
+                pass  # a node lost mid-chaos: nothing to disarm there
 
 
 async def _resolve_exemplars(stack: Stack, report: dict) -> None:
@@ -697,10 +809,13 @@ async def run_scenario_async(
     clients_scale: float = 1.0,
     overrides: dict | None = None,
     base_dir: str | None = None,
+    backend: str = "inproc",
 ) -> dict:
     from redpanda_tpu.kafka.client import KafkaClient
     from redpanda_tpu.observability.slo import slo
 
+    if backend not in ("inproc", "proc"):
+        raise ValueError(f"unknown backend {backend!r}")
     s = copy.deepcopy(SCENARIOS[name])
     s.update(overrides or {})
     if duration_s is not None:
@@ -708,6 +823,8 @@ async def run_scenario_async(
     for key in ("producers", "group_members", "eos_pairs",
                 "transform_readers", "tiered_readers"):
         s[key] = max(0 if s[key] == 0 else 1, int(s[key] * clients_scale))
+    if backend == "proc":
+        s["tiered_readers"] = 0  # see ProcStack docstring
 
     tmp = None
     if base_dir is None:
@@ -734,7 +851,8 @@ async def run_scenario_async(
 
         imposter = await S3Imposter().start()
 
-    stack = Stack(s, base_dir, imposter=imposter)
+    stack_cls = ProcStack if backend == "proc" else Stack
+    stack = stack_cls(s, base_dir, imposter=imposter)
     stats: dict[str, int] = {
         k: 0 for k in (
             "produce_ops", "produced_records", "produce_errors",
@@ -800,8 +918,19 @@ async def run_scenario_async(
 
         # ---- the measured window
         spec = _spec_for(name, s)
-        slo.configure(spec)          # arms per-metric exemplar thresholds
-        baseline = slo.snapshot()
+        fed = None
+        if backend == "proc":
+            # nothing broker-side lives in this process: judge the window
+            # from the FEDERATED scrape of every broker's /metrics (the
+            # merged HdrHists carry node labels for drill-down)
+            from redpanda_tpu.observability.federation import FederatedSlo
+
+            targets = stack.federation_targets()
+            fed = FederatedSlo(lambda: targets)
+            baseline = await fed.snapshot()
+        else:
+            slo.configure(spec)      # arms per-metric exemplar thresholds
+            baseline = slo.snapshot()
         stop = asyncio.Event()
         tasks = []
         for i in range(s["producers"]):
@@ -849,7 +978,10 @@ async def run_scenario_async(
         elapsed = time.monotonic() - t0
 
         if chaos_info is not None:
-            # disarm before the closed-loop verification reads
+            # disarm before the closed-loop verification reads — through
+            # the admin API on every node (real broker processes own their
+            # badgers; the local disable only reaches the in-process one)
+            await _disarm_chaos(stack, s["chaos"])
             honey_badger.disable()
 
         eos_check = (
@@ -857,9 +989,13 @@ async def run_scenario_async(
             if s["eos_pairs"] else None
         )
 
-        report = slo.evaluate(spec, baseline=baseline)
+        if fed is not None:
+            report = await fed.evaluate(spec, baseline=baseline)
+        else:
+            report = slo.evaluate(spec, baseline=baseline)
         await _resolve_exemplars(stack, report)
         report.update({
+            "backend": stack.backend,
             "chaos": chaos_info,
             "duration_s": round(elapsed, 3),
             "setup_s": round(t0 - t_setup0, 3),
@@ -937,6 +1073,13 @@ def main(argv=None) -> int:
     p.add_argument("--chaos", action="store_true",
                    help="arm the scenario's honey-badger probe for the "
                         "measured window")
+    p.add_argument("--backend", choices=("inproc", "proc"),
+                   default="inproc",
+                   help="inproc = 1..N Applications in this process "
+                        "(judged off the shared registry); proc = REAL "
+                        "broker processes judged from the federated "
+                        "/metrics scrape — no one-loop ceiling on offered "
+                        "load (tiered readers are inproc-only)")
     p.add_argument("--duration", type=float, default=None,
                    help="override the scenario's measured window (s)")
     p.add_argument("--clients-scale", type=float, default=1.0,
@@ -954,7 +1097,7 @@ def main(argv=None) -> int:
         p.error(f"unknown scenario {args.scenario!r}; --list shows them")
     report = run_scenario(
         args.scenario, chaos=args.chaos, duration_s=args.duration,
-        clients_scale=args.clients_scale,
+        clients_scale=args.clients_scale, backend=args.backend,
     )
     out = args.report or f"SLO_{args.scenario}.json"
     with open(out, "w") as f:

@@ -748,6 +748,116 @@ def bench_governor_overhead(secs: float) -> dict:
     }
 
 
+def bench_trace_propagation_overhead(secs: float) -> dict:
+    """Cost of pandascope trace propagation on an rpc round trip.
+
+    What a SAMPLED request pays beyond the pre-propagation wire: encoding
+    the 17-byte TraceContext on the sender, decoding it on the receiver,
+    and the receiver's JOINed rpc.handle span. Same derived min-of-blocks
+    discipline as tracer_overhead: each piece is strictly additive
+    straight-line code, so (per-call cost sum) / (per-RTT cost of a real
+    loopback rpc) IS its share — wall-clock A/B on a shared box cannot
+    resolve sub-1%.
+
+    The denominator round trip carries a REPLICATE-REPRESENTATIVE payload
+    (128 KiB, a quarter of the default 512 KiB recovery chunk): the only
+    rpcs that are ever sampled are the coalesced-produce append_entries
+    sends that join the submitter's trace — data-carrying by construction
+    — while empty heartbeats and chatter never carry context and pay
+    zero. Pricing the ctx against an empty echo would gate a cost against
+    a request shape that never bears it; the one-process loopback echo
+    already UNDERSTATES a real inter-broker round trip besides (no
+    process switch, no NIC — the SLO harness measures real cross-process
+    rpc means in the milliseconds). The acceptance bar (<1%) is asserted
+    by --assert-propagation-overhead, which also FAILS if a disabled
+    tracer adds even one byte to the wire
+    (``propagation_disabled_extra_bytes`` must be 0 — the header is
+    feature-flagged on trace_enabled)."""
+    from redpanda_tpu.observability.trace import Tracer
+    from redpanda_tpu.rpc import wire
+
+    # real loopback RTT (tracer state untouched: whatever the process has)
+    rtt_s = _rpc_echo_rtt_s(min(secs, 2.0), payload_bytes=128 * 1024)
+
+    ctx = wire.TraceContext(0x1234_5678_9ABC, 0x42, True)
+    blob = ctx.encode()
+    encode_ns = float("inf")
+    decode_ns = float("inf")
+    join_ns = float("inf")
+    scratch = Tracer(enabled=True, capacity=64)
+    for _ in range(10):
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ctx.encode()
+        encode_ns = min(encode_ns, (time.perf_counter() - t0) / n * 1e9)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            wire.TraceContext.decode(blob)
+        decode_ns = min(decode_ns, (time.perf_counter() - t0) / n * 1e9)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with scratch.span("bench.join", trace_id=7):
+                pass
+        join_ns = min(join_ns, (time.perf_counter() - t0) / n * 1e9)
+    per_rpc_ns = encode_ns + decode_ns + join_ns
+    rtt_ns = rtt_s * 1e9
+    pct = per_rpc_ns / rtt_ns * 100.0 if rtt_ns else 0.0
+    # zero-wire-bytes invariant: no ctx -> byte-identical version-0 frame
+    payload = b"x" * 128
+    extra = len(wire.frame(payload, 1, 1)) - (wire.HEADER_SIZE + len(payload))
+    return {
+        "propagation_ctx_encode_ns": round(encode_ns, 1),
+        "propagation_ctx_decode_ns": round(decode_ns, 1),
+        "propagation_join_span_ns": round(join_ns, 1),
+        "propagation_rpc_rtt_us": round(rtt_s * 1e6, 2),
+        "propagation_overhead_pct": round(pct, 3),
+        "propagation_disabled_extra_bytes": extra,
+        "propagation_ctx_wire_bytes": wire.TRACE_CTX_SIZE,
+    }
+
+
+def _rpc_echo_rtt_s(secs: float, payload_bytes: int = 0) -> float:
+    """Per-round-trip seconds of a real loopback rpc echo; the request
+    carries ``payload_bytes`` of text (0 = the minimal chatter shape)."""
+    from redpanda_tpu import rpc
+    from redpanda_tpu.rpc.transport import Transport
+
+    async def run() -> float:
+        from redpanda_tpu.rpc import serde
+
+        msg = serde.S(("text", serde.STRING))
+        svc = rpc.ServiceDef(
+            "bench", "echo_prop", [rpc.MethodDef("echo", msg, msg)]
+        )
+
+        class Impl:
+            async def echo(self, req):
+                return {"text": req["text"]}
+
+        server = rpc.Server()
+        proto = rpc.SimpleProtocol()
+        proto.register_service(rpc.ServiceHandler(svc, Impl()))
+        server.set_protocol(proto)
+        await server.start()
+        t = Transport("127.0.0.1", server.port)
+        await t.connect()
+        client = rpc.Client(svc, t)
+        body = "r" * max(1, payload_bytes)
+        await client.echo({"text": body})
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < secs:
+            await client.echo({"text": body})
+            n += 1
+        dt = time.perf_counter() - t0
+        await t.close()
+        await server.stop()
+        return dt / max(1, n)
+
+    return asyncio.run(run())
+
+
 def bench_rpc_echo(secs: float) -> dict:
     """Loopback RPC round trips (rpc_bench shape) over the real stack."""
     from redpanda_tpu import rpc
@@ -797,6 +907,7 @@ BENCHES = {
     "allocation": bench_allocation,
     "rpc_echo": bench_rpc_echo,
     "tracer_overhead": bench_tracer_overhead,
+    "trace_propagation_overhead": bench_trace_propagation_overhead,
     "breaker_overhead": bench_breaker_overhead,
     "slo_eval_overhead": bench_slo_eval_overhead,
     "governor_overhead": bench_governor_overhead,
@@ -822,6 +933,15 @@ def main(argv=None) -> int:
         metavar="PCT",
         help="fail (exit 1) if the disabled-tracer overhead exceeds PCT "
         "percent; implies the tracer_overhead bench",
+    )
+    p.add_argument(
+        "--assert-propagation-overhead",
+        type=float,
+        metavar="PCT",
+        help="fail (exit 1) if the trace-context encode/decode + join-span "
+        "share of an rpc round trip exceeds PCT percent, OR if a disabled "
+        "tracer adds ANY bytes to the wire; implies the "
+        "trace_propagation_overhead bench",
     )
     p.add_argument(
         "--assert-pool-speedup",
@@ -873,6 +993,11 @@ def main(argv=None) -> int:
         p.error(f"unknown bench(es) {unknown}; choose from {sorted(BENCHES)}")
     if args.assert_tracer_overhead is not None and "tracer_overhead" not in names:
         names.append("tracer_overhead")
+    if (
+        args.assert_propagation_overhead is not None
+        and "trace_propagation_overhead" not in names
+    ):
+        names.append("trace_propagation_overhead")
     if args.assert_pool_speedup is not None and "host_pool_scaling" not in names:
         names.append("host_pool_scaling")
     if args.assert_breaker_overhead is not None and "breaker_overhead" not in names:
@@ -906,6 +1031,24 @@ def main(argv=None) -> int:
             print(
                 f"tracer overhead {pct}% exceeds budget "
                 f"{args.assert_tracer_overhead}%",
+                file=sys.stderr,
+            )
+            return 1
+    if args.assert_propagation_overhead is not None:
+        pct = out.get("propagation_overhead_pct", 0.0)
+        extra = out.get("propagation_disabled_extra_bytes", 0)
+        if pct > args.assert_propagation_overhead:
+            print(
+                f"trace propagation overhead {pct}% exceeds budget "
+                f"{args.assert_propagation_overhead}%",
+                file=sys.stderr,
+            )
+            return 1
+        if extra != 0:
+            print(
+                f"disabled tracer added {extra} byte(s) to the wire "
+                f"(must be ZERO — header is feature-flagged on "
+                f"trace_enabled)",
                 file=sys.stderr,
             )
             return 1

@@ -19,6 +19,7 @@ from tools.pandalint.checkers.excepts import BareExceptChecker
 from tools.pandalint.checkers.hdrrecord import HdrRecordChecker
 from tools.pandalint.checkers.races import RaceChecker
 from tools.pandalint.checkers.deadlocks import DeadlockChecker
+from tools.pandalint.checkers.tracectx import TraceCtxChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     ReactorChecker,
@@ -35,6 +36,7 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     HdrRecordChecker,
     RaceChecker,
     DeadlockChecker,
+    TraceCtxChecker,
 )
 
 

@@ -1,0 +1,145 @@
+"""Trace-context propagation: no ctx-less wire framing in a traced region.
+
+Post-pandascope, the rpc wire carries a compact trace-context block
+(rpc/wire.py TraceContext) so a produce's trace survives the hop onto the
+brokers it replicates through. ``Transport.send`` threads the ambient
+context automatically — but code that frames wire messages BY HAND inside
+a live ``tracer.span(...)`` block silently truncates the distributed trace
+at that hop: the bytes go out version-0, the peer's handler span never
+JOINs, and the cluster-assembled view ends at the sender. Post-propagation
+that is a bug, not a style choice.
+
+Heuristic scope (no type inference): lexically inside a ``with`` block
+whose context expression is a ``*.span(...)`` call on a tracer-named
+receiver (``tracer.span``, ``self._tracer.span``):
+
+- TRC1201 — a call resolving to ``rpc.wire.frame(...)`` (module alias or
+  ``from``-import) without a ``trace_ctx=`` keyword. Passing the keyword —
+  even an explicitly-``None`` variable — is the signal the author decided
+  what rides the wire; omitting it is the silent drop.
+- TRC1202 — hand-rolled ``rpc.wire.Header(...)`` construction. A raw
+  header can never carry context (``frame(..., trace_ctx=)`` is the only
+  propagating entry point), so building one in a traced region bypasses
+  propagation entirely; go through ``frame`` or move the framing out of
+  the span.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.checkers.base import (
+    Checker,
+    FileContext,
+    RawFinding,
+    dotted,
+)
+
+_WIRE_MODULE = "redpanda_tpu.rpc.wire"
+
+
+def _wire_aliases(tree: ast.Module) -> tuple[set[str], set[str], set[str]]:
+    """(names bound to wire.frame, names bound to wire.Header, module
+    aliases bound to the rpc.wire module). The conventional bare ``wire``
+    receiver counts as a module alias even without a resolvable import —
+    fixtures and vendored copies must not dodge the rule on import shape."""
+    frame_names: set[str] = set()
+    header_names: set[str] = set()
+    wire_mods: set[str] = {"wire"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == _WIRE_MODULE:
+                for alias in node.names:
+                    if alias.name == "frame":
+                        frame_names.add(alias.asname or alias.name)
+                    elif alias.name == "Header":
+                        header_names.add(alias.asname or alias.name)
+            elif node.module == "redpanda_tpu.rpc":
+                for alias in node.names:
+                    if alias.name == "wire":
+                        wire_mods.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == _WIRE_MODULE and alias.asname:
+                    wire_mods.add(alias.asname)
+    return frame_names, header_names, wire_mods
+
+
+def _is_tracer_span_with(node: ast.AST) -> bool:
+    if not isinstance(node, (ast.With, ast.AsyncWith)):
+        return False
+    for item in node.items:
+        ctx = item.context_expr
+        if isinstance(ctx, ast.Call):
+            name = dotted(ctx.func)
+            if name.endswith(".span") and "trace" in name.lower():
+                return True
+    return False
+
+
+class TraceCtxChecker(Checker):
+    name = "trace-ctx"
+    rules = {
+        "TRC1201": "wire.frame(...) inside a tracer.span(...) block without trace_ctx= — the send silently drops the trace at this hop",
+        "TRC1202": "hand-rolled wire.Header(...) inside a tracer.span(...) block — raw headers can never carry trace context",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        frame_names, header_names, wire_mods = _wire_aliases(ctx.tree)
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._walk(
+                    fn, fn.name, False, frame_names, header_names, wire_mods
+                )
+
+    def _walk(
+        self, node, fn_name, in_span, frame_names, header_names, wire_mods
+    ) -> Iterator[RawFinding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs execute in their own (unspanned) scope
+            child_in_span = in_span or _is_tracer_span_with(child)
+            if child_in_span and isinstance(child, ast.Call):
+                func = child.func
+                is_frame = (
+                    isinstance(func, ast.Name) and func.id in frame_names
+                ) or (
+                    isinstance(func, ast.Attribute)
+                    and func.attr == "frame"
+                    and dotted(func.value) in wire_mods
+                )
+                is_header = (
+                    isinstance(func, ast.Name) and func.id in header_names
+                ) or (
+                    isinstance(func, ast.Attribute)
+                    and func.attr == "Header"
+                    and dotted(func.value) in wire_mods
+                )
+                if is_frame and not any(
+                    kw.arg == "trace_ctx" for kw in child.keywords
+                ):
+                    yield RawFinding(
+                        "TRC1201",
+                        child.lineno,
+                        child.col_offset,
+                        f"{fn_name}() frames a wire message inside a live "
+                        f"tracer.span block without trace_ctx= — the "
+                        f"ambient trace dies at this hop; pass "
+                        f"trace_ctx=... (None is an explicit decision) or "
+                        f"send through Transport.send",
+                    )
+                elif is_header:
+                    yield RawFinding(
+                        "TRC1202",
+                        child.lineno,
+                        child.col_offset,
+                        f"{fn_name}() hand-rolls a wire.Header inside a "
+                        f"live tracer.span block — raw headers cannot "
+                        f"carry trace context; use wire.frame(..., "
+                        f"trace_ctx=) or move the framing out of the span",
+                    )
+            yield from self._walk(
+                child, fn_name, child_in_span, frame_names, header_names,
+                wire_mods,
+            )

@@ -24,7 +24,11 @@ import json
 import sys
 
 _BAR_WIDTH = 24
-_EXTRA_KEYS_SKIP = {"trace_id", "name", "start_us", "dur_us", "thread"}
+# node renders as its own column (cluster-assembled traces); span_id is
+# plumbing for cross-node dedup/anchoring, not operator signal
+_EXTRA_KEYS_SKIP = {
+    "trace_id", "name", "start_us", "dur_us", "thread", "node", "span_id",
+}
 
 
 def _fmt_us(us: float) -> str:
@@ -76,7 +80,10 @@ def stage_breakdown(traces: list[dict]) -> str:
 
 def render_trace(trace: dict) -> str:
     """One trace as an indentation flamegraph: a span nests under the
-    nearest earlier span whose [start, end) interval contains it."""
+    nearest earlier span whose [start, end) interval contains it. Cluster-
+    assembled traces (GET /v1/trace/cluster/<id>) carry a ``node`` per
+    span — rendered as a leading ``n<id>`` column so the hop from leader
+    dispatch to follower append reads straight down the containment tree."""
     spans = sorted(
         trace.get("spans", []), key=lambda s: (s["start_us"], -s["dur_us"])
     )
@@ -84,7 +91,15 @@ def render_trace(trace: dict) -> str:
         return f"trace {trace.get('trace_id', '?')}: (empty)"
     t0 = min(s["start_us"] for s in spans)
     wall = max(1, trace.get("wall_us") or 1)
-    lines = [f"trace {trace.get('trace_id', '?')}  wall={_fmt_us(wall)}"]
+    nodes = trace.get("nodes") or sorted(
+        {s["node"] for s in spans if s.get("node") is not None}
+    )
+    head = f"trace {trace.get('trace_id', '?')}  wall={_fmt_us(wall)}"
+    if nodes:
+        head += f"  nodes={','.join(str(n) for n in nodes)}"
+    lines = [head]
+    with_nodes = bool(nodes)
+    node_w = max((len(f"n{n}") for n in nodes), default=0) + 1
     stack: list[tuple[int, int]] = []  # (end_us, depth)
     name_w = max(len(s["name"]) for s in spans) + 2
     for s in spans:
@@ -96,8 +111,12 @@ def render_trace(trace: dict) -> str:
         bar_n = max(1, round(_BAR_WIDTH * s["dur_us"] / wall))
         pad = "  " * depth
         extras = _extras(s)
+        node_col = ""
+        if with_nodes:
+            tag = f"n{s['node']}" if s.get("node") is not None else "?"
+            node_col = f"{tag:<{node_w}}"
         lines.append(
-            f"  {pad}{s['name']:<{max(1, name_w - len(pad))}}"
+            f"  {node_col}{pad}{s['name']:<{max(1, name_w - len(pad))}}"
             f"{_fmt_us(s['dur_us']):>10}  +{_fmt_us(start - t0):<9}"
             f"{'#' * bar_n:<{_BAR_WIDTH}} {s['thread']}"
             + (f"  [{extras}]" if extras else "")
