@@ -15,8 +15,13 @@
 #include <cstdlib>
 
 #if defined(__x86_64__)
-#include <nmmintrin.h>
-#define HAVE_SSE42 1
+// x86intrin.h + per-function target attributes instead of a global -msse4.2:
+// the .so must never carry SSE4.2 instructions outside runtime-dispatched
+// functions, or a prebuilt binary SIGILLs on pre-Nehalem hosts. SSE2 is
+// part of the x86_64 ABI baseline and is safe to use unguarded.
+#include <emmintrin.h>
+#include <x86intrin.h>
+#define HAVE_X86_64 1
 #endif
 
 extern "C" {
@@ -56,9 +61,9 @@ static uint32_t crc32c_sw(uint32_t crc, const uint8_t* p, size_t n) {
   return crc;
 }
 
-// crc is internal state (pre-inverted). Returns new internal state.
-uint32_t rp_crc32c_update(uint32_t crc, const uint8_t* data, size_t len) {
-#if HAVE_SSE42
+#if HAVE_X86_64
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(uint32_t crc, const uint8_t* data, size_t len) {
   const uint8_t* p = data;
   size_t n = len;
   uint64_t c = crc;
@@ -70,6 +75,24 @@ uint32_t rp_crc32c_update(uint32_t crc, const uint8_t* data, size_t len) {
   }
   while (n--) c = _mm_crc32_u8((uint32_t)c, *p++);
   return (uint32_t)c;
+}
+#endif
+
+// crc is internal state (pre-inverted). Returns new internal state.
+// Runtime feature dispatch: the SSE4.2 CRC32 instructions live only inside
+// crc32c_hw (target attribute), picked once per process when the CPU
+// actually has them — the same .so runs on any x86_64 (and any other arch
+// via the table path). The pointer write is idempotent, so the unlocked
+// first-call race is benign.
+uint32_t rp_crc32c_update(uint32_t crc, const uint8_t* data, size_t len) {
+#if HAVE_X86_64
+  static uint32_t (*impl)(uint32_t, const uint8_t*, size_t) = nullptr;
+  uint32_t (*fn)(uint32_t, const uint8_t*, size_t) = impl;
+  if (!fn) {
+    fn = __builtin_cpu_supports("sse4.2") ? crc32c_hw : crc32c_sw;
+    impl = fn;
+  }
+  return fn(crc, data, len);
 #else
   return crc32c_sw(crc, data, len);
 #endif
@@ -722,60 +745,68 @@ int64_t rp_explode_find(const uint8_t* joined, const int64_t* payload_off,
   return r;
 }
 
-// Fused projection: gather every Int/Float/Str projection field straight
-// from the span tables into the PACKED output rows in one pass per record
-// (replaces k gather_* crossings + the numpy row assembly). Byte-layout
-// parity with ColumnarPlan.assemble_rows: int/float = 4 bytes LE;
-// str = LE16 clipped length + w bytes zero-padded. ok[r] mirrors
+// One record's projection row off its span-table row — THE shared body of
+// rp_project_rows and the fused rp_extract_cols2, so the packed layout
+// and ok-mask rules cannot diverge between the staged and fused ladders.
+// Byte-layout parity with ColumnarPlan.assemble_rows: int/float = 4 bytes
+// LE; str = LE16 clipped length + w bytes zero-padded. *ok mirrors
 // extract_projection's per-kind validity (int: PRESENT|NUMBER|INT_EXACT
 // and |v| <= 999999999; float: PRESENT|NUMBER; str: present and fits w).
-// descs: per field {kind(0 int,1 float,2 str), span col, w, out off}.
+// descs: per field {kind(0 int, 1 float, 2 str), span col, w, out off}.
+static inline void project_one_row(const uint8_t* rec, const int8_t* trow,
+                                   const int64_t* vrow, const int64_t* erow,
+                                   const int32_t* descs, int32_t n_fields,
+                                   int32_t r_out, uint8_t* row, uint8_t* ok) {
+  std::memset(row, 0, (size_t)r_out);
+  uint8_t okr = 1;
+  for (int32_t f = 0; f < n_fields; f++) {
+    const int32_t* d = descs + f * 4;
+    int32_t kind = d[0], col = d[1], w = d[2], off = d[3];
+    if (kind == 2) {  // str
+      if (trow[col] != 1) {
+        okr = 0;  // missing / non-string: zeroed slot, record dropped
+        continue;
+      }
+      int64_t vlen = erow[col] - vrow[col];
+      if (vlen < 0) vlen = 0;  // unterminated: empty-but-present
+      if (vlen > w) okr = 0;
+      int32_t slen = (int32_t)(vlen < w ? vlen : w);
+      row[off] = (uint8_t)(slen & 0xFF);
+      row[off + 1] = (uint8_t)((slen >> 8) & 0xFF);
+      std::memcpy(row + off + 2, rec + vrow[col], (size_t)slen);
+    } else {
+      float f32;
+      int32_t i32;
+      uint8_t fl;
+      num_from_span(rec, trow[col], vrow[col], erow[col], &f32, &i32, &fl);
+      if (kind == 0) {  // int
+        const uint8_t need = RP_F_PRESENT | RP_F_NUMBER | RP_F_INT_EXACT;
+        if ((fl & need) != need || i32 > 999999999 || i32 < -999999999)
+          okr = 0;
+        std::memcpy(row + off, &i32, 4);
+      } else {  // float
+        const uint8_t need = RP_F_PRESENT | RP_F_NUMBER;
+        if ((fl & need) != need) okr = 0;
+        std::memcpy(row + off, &f32, 4);
+      }
+    }
+  }
+  *ok = okr;
+}
+
+// Fused projection: gather every Int/Float/Str projection field straight
+// from the span tables into the PACKED output rows in one pass per record
+// (replaces k gather_* crossings + the numpy row assembly). One shared
+// per-record body with the fused extractor: project_one_row.
 int64_t rp_project_rows(const uint8_t* joined, const int64_t* offsets,
                         int64_t n, const int8_t* types, const int64_t* vs,
                         const int64_t* ve, int32_t k, const int32_t* descs,
                         int32_t n_fields, int32_t r_out, uint8_t* rows,
                         uint8_t* ok) {
   for (int64_t r = 0; r < n; r++) {
-    uint8_t* row = rows + r * (int64_t)r_out;
-    std::memset(row, 0, (size_t)r_out);
-    uint8_t okr = 1;
-    const uint8_t* rec = joined + offsets[r];
-    const int8_t* trow = types + r * k;
-    const int64_t* vrow = vs + r * k;
-    const int64_t* erow = ve + r * k;
-    for (int32_t f = 0; f < n_fields; f++) {
-      const int32_t* d = descs + f * 4;
-      int32_t kind = d[0], col = d[1], w = d[2], off = d[3];
-      if (kind == 2) {  // str
-        if (trow[col] != 1) {
-          okr = 0;  // missing / non-string: zeroed slot, record dropped
-          continue;
-        }
-        int64_t vlen = erow[col] - vrow[col];
-        if (vlen < 0) vlen = 0;  // unterminated: empty-but-present
-        if (vlen > w) okr = 0;
-        int32_t slen = (int32_t)(vlen < w ? vlen : w);
-        row[off] = (uint8_t)(slen & 0xFF);
-        row[off + 1] = (uint8_t)((slen >> 8) & 0xFF);
-        std::memcpy(row + off + 2, rec + vrow[col], (size_t)slen);
-      } else {
-        float f32;
-        int32_t i32;
-        uint8_t fl;
-        num_from_span(rec, trow[col], vrow[col], erow[col], &f32, &i32, &fl);
-        if (kind == 0) {  // int
-          const uint8_t need = RP_F_PRESENT | RP_F_NUMBER | RP_F_INT_EXACT;
-          if ((fl & need) != need || i32 > 999999999 || i32 < -999999999)
-            okr = 0;
-          std::memcpy(row + off, &i32, 4);
-        } else {  // float
-          const uint8_t need = RP_F_PRESENT | RP_F_NUMBER;
-          if ((fl & need) != need) okr = 0;
-          std::memcpy(row + off, &f32, 4);
-        }
-      }
-    }
-    ok[r] = okr;
+    project_one_row(joined + offsets[r], types + r * k, vs + r * k,
+                    ve + r * k, descs, n_fields, r_out,
+                    rows + r * (int64_t)r_out, ok + r);
   }
   return n;
 }
@@ -832,6 +863,567 @@ int64_t rp_extract_num(const uint8_t* joined, const int64_t* offsets,
                   out_flags + i);
   }
   return hits;
+}
+
+// ------------------------------------------------------------- structural
+// Two-stage structural-index parse (Langdale & Lemire, "Parsing Gigabytes
+// of JSON per Second"), adapted to the engine's record shape. Stage 1 is a
+// vectorized character-class scan over each record's JSON value producing
+// two bitmaps (bit i = value byte i): unescaped quotes, and structural
+// operators ({}[]:,) OUTSIDE strings — escape runs and string interiors
+// are computed branch-free with carried word ops, and the scan is seeded
+// fresh per record so inter-record framing bytes can never contaminate
+// the masks. Stage 2 (find2_in_record) is byte-for-byte the scalar
+// find_in_record control flow, except string skips jump straight to the
+// closing-quote bit and container skips walk the operator bitmap instead
+// of re-scanning bytes. rp_explode_find stays exported as the parity
+// oracle and fallback (tests/test_structural_parse.py pins the matrix).
+
+static inline uint64_t bb_eq(uint64_t x, uint64_t pat) {
+  // 0x80 in each byte of x equal to the broadcast byte `pat`
+  uint64_t t = x ^ pat;
+  return (t - 0x0101010101010101ULL) & ~t & 0x8080808080808080ULL;
+}
+
+static inline uint64_t bb_pack(uint64_t msbs) {
+  // gather the 8 byte-MSBs into the low 8 bits (movemask emulation)
+  return (msbs * 0x0002040810204081ULL) >> 56;
+}
+
+#define RP_BCAST(c) ((uint64_t)0x0101010101010101ULL * (uint8_t)(c))
+
+// Stage-1 eager classification covers ONLY quote + backslash — exactly
+// what the escape and in-string masks need, so the eager scan costs two
+// byte-compares per 16 bytes (memchr-class throughput). The six operator
+// characters are classified LAZILY per word, only when a container skip
+// actually walks them (classify_op_word below) — string-heavy records
+// (the bench shape: one ~1KB string value per record) never pay for them.
+
+#if HAVE_X86_64
+static void classify2_sse2(const uint8_t* p, uint64_t* quote,
+                           uint64_t* bslash) {
+  uint64_t q = 0, b = 0;
+  const __m128i vq = _mm_set1_epi8('"');
+  const __m128i vb = _mm_set1_epi8('\\');
+  for (int i = 0; i < 4; i++) {
+    __m128i v = _mm_loadu_si128((const __m128i*)(p + 16 * i));
+    q |= (uint64_t)(uint32_t)_mm_movemask_epi8(_mm_cmpeq_epi8(v, vq))
+         << (16 * i);
+    b |= (uint64_t)(uint32_t)_mm_movemask_epi8(_mm_cmpeq_epi8(v, vb))
+         << (16 * i);
+  }
+  *quote = q;
+  *bslash = b;
+}
+
+#else
+static void classify2_swar(const uint8_t* p, uint64_t* quote,
+                           uint64_t* bslash) {
+  uint64_t q = 0, b = 0;
+  for (int i = 0; i < 8; i++) {
+    uint64_t x;
+    std::memcpy(&x, p + 8 * i, 8);
+    q |= bb_pack(bb_eq(x, RP_BCAST('"'))) << (8 * i);
+    b |= bb_pack(bb_eq(x, RP_BCAST('\\'))) << (8 * i);
+  }
+  *quote = q;
+  *bslash = b;
+}
+#endif
+
+// Operator bitmap for ONE 64-byte word of the value, classified on demand
+// ({}[]:, — container skips are the only consumer). Tail words pad with
+// zeros so the classifier never reads past the value span.
+static uint64_t classify_op_word(const uint8_t* s, int64_t w, int64_t end) {
+  const uint8_t* p = s + (w << 6);
+  uint8_t buf[64];
+  if ((w << 6) + 64 > end) {
+    std::memset(buf, 0, 64);
+    std::memcpy(buf, p, (size_t)(end - (w << 6)));
+    p = buf;
+  }
+#if HAVE_X86_64
+  uint64_t o = 0;
+  const __m128i c1 = _mm_set1_epi8('{'), c2 = _mm_set1_epi8('}');
+  const __m128i c3 = _mm_set1_epi8('['), c4 = _mm_set1_epi8(']');
+  const __m128i c5 = _mm_set1_epi8(':'), c6 = _mm_set1_epi8(',');
+  for (int i = 0; i < 4; i++) {
+    __m128i v = _mm_loadu_si128((const __m128i*)(p + 16 * i));
+    __m128i m = _mm_or_si128(
+        _mm_or_si128(_mm_cmpeq_epi8(v, c1), _mm_cmpeq_epi8(v, c2)),
+        _mm_or_si128(
+            _mm_or_si128(_mm_cmpeq_epi8(v, c3), _mm_cmpeq_epi8(v, c4)),
+            _mm_or_si128(_mm_cmpeq_epi8(v, c5), _mm_cmpeq_epi8(v, c6))));
+    o |= (uint64_t)(uint32_t)_mm_movemask_epi8(m) << (16 * i);
+  }
+  return o;
+#else
+  uint64_t o = 0;
+  for (int i = 0; i < 8; i++) {
+    uint64_t x;
+    std::memcpy(&x, p + 8 * i, 8);
+    uint64_t m = bb_eq(x, RP_BCAST('{')) | bb_eq(x, RP_BCAST('}')) |
+                 bb_eq(x, RP_BCAST('[')) | bb_eq(x, RP_BCAST(']')) |
+                 bb_eq(x, RP_BCAST(':')) | bb_eq(x, RP_BCAST(','));
+    o |= bb_pack(m) << (8 * i);
+  }
+  return o;
+#endif
+}
+
+static inline uint64_t prefix_xor64(uint64_t x) {
+  x ^= x << 1;
+  x ^= x << 2;
+  x ^= x << 4;
+  x ^= x << 8;
+  x ^= x << 16;
+  x ^= x << 32;
+  return x;
+}
+
+// Characters escaped by an odd-length backslash run (simdjson's
+// find_escaped_branchless); *prev carries runs across word boundaries.
+// Equivalent to the scalar backward odd-count at every quote because a
+// run can never cross an opening quote (the quote byte breaks it).
+static inline uint64_t find_escaped(uint64_t backslash, uint64_t* prev) {
+  backslash &= ~*prev;
+  uint64_t follows_escape = (backslash << 1) | *prev;
+  const uint64_t even_bits = 0x5555555555555555ULL;
+  uint64_t odd_starts = backslash & ~even_bits & ~follows_escape;
+  uint64_t seq = odd_starts + backslash;
+  *prev = seq < backslash;  // carry out: an odd run reaches the word end
+  uint64_t invert = seq << 1;
+  return (even_bits ^ invert) & follows_escape;
+}
+
+// Stage 1 over one record value: fill qbits (unescaped quotes) and sbits
+// (the string-interior mask: 1 from each opening quote through the byte
+// before its closing quote). Carries reset here, per record — framing
+// bytes between records can never contaminate the masks. The body is a
+// macro so each dispatch variant inlines its classifier (an indirect call
+// per 64-byte block costs more than the classification itself), and words
+// with no quote and no backslash — the string-body common case — take a
+// two-store fast path: escape state decays (the pending escape consumed a
+// non-quote byte) and the string mask holds.
+#define RP_BUILD_STRUCTURAL_BODY(CLASSIFY2)                                  \
+  uint64_t prev_escaped = 0;                                                 \
+  uint64_t in_string = 0; /* 0 or ~0: string-interior carry */               \
+  int64_t nwords = (len + 63) >> 6;                                          \
+  for (int64_t w = 0; w < nwords; w++) {                                     \
+    const uint8_t* p = s + (w << 6);                                         \
+    uint64_t q, b;                                                           \
+    if ((w << 6) + 64 <= len) {                                              \
+      CLASSIFY2(p, &q, &b);                                                  \
+    } else {                                                                 \
+      /* tail block: copy-pad to 64 zero bytes — never read past the     */  \
+      /* value span (the next record's framing bytes, or the blob end)   */  \
+      uint8_t buf[64];                                                       \
+      std::memset(buf, 0, 64);                                               \
+      std::memcpy(buf, p, (size_t)(len - (w << 6)));                         \
+      CLASSIFY2(buf, &q, &b);                                                \
+    }                                                                        \
+    if ((q | b) == 0) {                                                      \
+      prev_escaped = 0;                                                      \
+      qbits[w] = 0;                                                          \
+      sbits[w] = in_string;                                                  \
+      continue;                                                              \
+    }                                                                        \
+    uint64_t esc = find_escaped(b, &prev_escaped);                           \
+    q &= ~esc;                                                               \
+    /* inclusive prefix XOR of quote bits: 1 from each opening quote    */   \
+    /* through the byte before its closing quote — exactly where an     */   \
+    /* operator byte is string content, not structure                   */   \
+    uint64_t S = prefix_xor64(q) ^ in_string;                                \
+    in_string = (uint64_t)(-(int64_t)(S >> 63));                             \
+    qbits[w] = q;                                                            \
+    sbits[w] = S;                                                            \
+  }
+
+#if HAVE_X86_64
+static void build_structural_sse2(const uint8_t* s, int64_t len,
+                                  uint64_t* qbits, uint64_t* sbits) {
+  RP_BUILD_STRUCTURAL_BODY(classify2_sse2)
+}
+__attribute__((target("avx2")))
+static void build_structural_avx2(const uint8_t* s, int64_t len,
+                                  uint64_t* qbits, uint64_t* sbits) {
+  // hand-specialized: vptest answers "any quote/backslash in these 64
+  // bytes" straight from the compare vectors, so the dominant string-body
+  // words never pay the movemask+shift assembly of the generic path
+  const __m256i vq = _mm256_set1_epi8('"');
+  const __m256i vb = _mm256_set1_epi8('\\');
+  uint64_t prev_escaped = 0;
+  uint64_t in_string = 0;
+  int64_t nwords = (len + 63) >> 6;
+  for (int64_t w = 0; w < nwords; w++) {
+    const uint8_t* p = s + (w << 6);
+    uint8_t buf[64];
+    if ((w << 6) + 64 > len) {
+      std::memset(buf, 0, 64);
+      std::memcpy(buf, p, (size_t)(len - (w << 6)));
+      p = buf;
+    }
+    __m256i v0 = _mm256_loadu_si256((const __m256i*)p);
+    __m256i v1 = _mm256_loadu_si256((const __m256i*)(p + 32));
+    __m256i q0 = _mm256_cmpeq_epi8(v0, vq), q1 = _mm256_cmpeq_epi8(v1, vq);
+    __m256i b0 = _mm256_cmpeq_epi8(v0, vb), b1 = _mm256_cmpeq_epi8(v1, vb);
+    __m256i any = _mm256_or_si256(_mm256_or_si256(q0, q1),
+                                  _mm256_or_si256(b0, b1));
+    if (_mm256_testz_si256(any, any)) {
+      prev_escaped = 0;
+      qbits[w] = 0;
+      sbits[w] = in_string;
+      continue;
+    }
+    uint64_t q = (uint64_t)(uint32_t)_mm256_movemask_epi8(q0) |
+                 ((uint64_t)(uint32_t)_mm256_movemask_epi8(q1) << 32);
+    uint64_t b = (uint64_t)(uint32_t)_mm256_movemask_epi8(b0) |
+                 ((uint64_t)(uint32_t)_mm256_movemask_epi8(b1) << 32);
+    uint64_t esc = find_escaped(b, &prev_escaped);
+    q &= ~esc;
+    uint64_t S = prefix_xor64(q) ^ in_string;
+    in_string = (uint64_t)(-(int64_t)(S >> 63));
+    qbits[w] = q;
+    sbits[w] = S;
+  }
+}
+typedef void (*build_structural_fn)(const uint8_t*, int64_t, uint64_t*,
+                                    uint64_t*);
+static build_structural_fn build_structural_resolve() {
+  // same runtime-dispatch posture as the CRC path: AVX2 instructions live
+  // only behind the cpu check, the .so itself stays baseline-x86_64
+  static build_structural_fn impl = nullptr;
+  build_structural_fn fn = impl;
+  if (!fn) {
+    fn = __builtin_cpu_supports("avx2") ? build_structural_avx2
+                                        : build_structural_sse2;
+    impl = fn;
+  }
+  return fn;
+}
+static void build_structural(const uint8_t* s, int64_t len, uint64_t* qbits,
+                             uint64_t* sbits) {
+  build_structural_resolve()(s, len, qbits, sbits);
+}
+#else
+static void build_structural(const uint8_t* s, int64_t len, uint64_t* qbits,
+                             uint64_t* sbits) {
+  RP_BUILD_STRUCTURAL_BODY(classify2_swar)
+}
+#endif
+
+static inline int64_t next_set_bit(const uint64_t* words, int64_t len,
+                                   int64_t from) {
+  if (from >= len) return -1;
+  int64_t w = from >> 6;
+  uint64_t cur = words[w] & (~0ULL << (from & 63));
+  for (;;) {
+    if (cur) return (w << 6) + __builtin_ctzll(cur);
+    if (((++w) << 6) >= len) return -1;
+    cur = words[w];
+  }
+}
+
+// skip_string twin over the quote bitmap: i at the opening quote. The next
+// quote BIT is the closing quote by construction (escaped quotes are
+// masked out of qbits; operators between them are irrelevant here).
+static inline int64_t skip_string_idx(int64_t i, int64_t end,
+                                      const uint64_t* qbits) {
+  int64_t close = next_set_bit(qbits, end, i + 1);
+  return close < 0 ? end : close + 1;
+}
+
+// skip_value twin: containers walk lazily classified operator words
+// (masked by the stored string-interior bits), strings jump via the quote
+// bitmap, primitives byte-scan exactly like the scalar walker (their
+// tokens are a few bytes and the scalar stop set must be honored
+// byte-for-byte).
+static int64_t skip_value_idx(const uint8_t* s, int64_t i, int64_t end,
+                              const uint64_t* qbits, const uint64_t* sbits) {
+  i = skip_ws(s, i, end);
+  if (i >= end) return end;
+  uint8_t c = s[i];
+  if (c == '"') return skip_string_idx(i, end, qbits);
+  if (c == '{' || c == '[') {
+    int64_t depth = 0;
+    int64_t nwords = (end + 63) >> 6;
+    uint64_t first_mask = ~0ULL << (i & 63);
+    for (int64_t w = i >> 6; w < nwords; w++) {
+      uint64_t ow = classify_op_word(s, w, end) & ~sbits[w] & first_mask;
+      first_mask = ~0ULL;
+      while (ow) {
+        int64_t p = (w << 6) + __builtin_ctzll(ow);
+        ow &= ow - 1;
+        uint8_t pc = s[p];
+        if (pc == '{' || pc == '[') {
+          depth++;
+        } else if (pc == '}' || pc == ']') {
+          depth--;
+          if (depth == 0) return p + 1;
+        }
+        // ':' and ',' are structural but depth-neutral
+      }
+    }
+    return end;
+  }
+  while (i < end && c != ',' && c != '}' && c != ']' && c != ' ' &&
+         c != '\t' && c != '\n' && c != '\r') {
+    i++;
+    if (i < end) c = s[i];
+  }
+  return i;
+}
+
+// classify_value twin; token typing shares the scalar rules verbatim.
+static int32_t classify_value_idx(const uint8_t* s, int64_t i, int64_t end,
+                                  const uint64_t* qbits,
+                                  const uint64_t* sbits, int64_t* vs,
+                                  int64_t* ve) {
+  if (i >= end) return 0;
+  uint8_t c = s[i];
+  if (c == '"') {
+    int64_t j = skip_string_idx(i, end, qbits);
+    *vs = i + 1;
+    *ve = j - 1;
+    return 1;
+  }
+  if (c == '{') {
+    *vs = i;
+    *ve = skip_value_idx(s, i, end, qbits, sbits);
+    return 6;
+  }
+  if (c == '[') {
+    *vs = i;
+    *ve = skip_value_idx(s, i, end, qbits, sbits);
+    return 7;
+  }
+  int64_t j = skip_value_idx(s, i, end, qbits, sbits);
+  *vs = i;
+  *ve = j;
+  int64_t tl = j - i;
+  if (tl == 4 && std::memcmp(s + i, "true", 4) == 0) return 3;
+  if (tl == 5 && std::memcmp(s + i, "false", 5) == 0) return 4;
+  if (tl == 4 && std::memcmp(s + i, "null", 4) == 0) return 5;
+  return 2;
+}
+
+// Stage 2: find_in_record with the three skip primitives swapped for their
+// structural-index twins. The control flow is line-for-line the scalar
+// walker's, so the two walks cannot diverge on ANY input — well-formed or
+// malformed — except through the skip primitives, whose equivalence the
+// parity suite pins (escaped quotes, backslash runs, unterminated
+// strings, truncated records).
+static void find2_in_record(const uint8_t* s, int64_t end,
+                            const uint64_t* qbits, const uint64_t* sbits,
+                            const char* paths_blob, const int32_t* path_off,
+                            const int32_t* path_lens, int32_t k,
+                            int8_t* trow, int64_t* vrow, int64_t* erow) {
+  std::memset(trow, 0, (size_t)k);
+  if (end <= 0) return;
+  int64_t i = skip_ws(s, 0, end);
+  if (i >= end || s[i] != '{') return;
+  i++;
+  int32_t found = 0;
+  for (;;) {
+    i = skip_ws(s, i, end);
+    if (i >= end || s[i] == '}') break;
+    if (s[i] != '"') break;  // malformed
+    int64_t kstart = i + 1;
+    i = skip_string_idx(i, end, qbits);
+    int64_t kend = i - 1;
+    i = skip_ws(s, i, end);
+    if (i >= end || s[i] != ':') break;
+    i++;
+    i = skip_ws(s, i, end);
+    int64_t klen = kend - kstart;
+    bool matched = false;
+    for (int32_t p = 0; p < k; p++) {
+      if (trow[p] != 0) continue;  // first occurrence wins
+      if (klen == path_lens[p] &&
+          std::memcmp(s + kstart, paths_blob + path_off[p],
+                      (size_t)path_lens[p]) == 0) {
+        int64_t vs, ve;
+        int32_t t = classify_value_idx(s, i, end, qbits, sbits, &vs, &ve);
+        if (t == 0) break;
+        trow[p] = (int8_t)t;
+        vrow[p] = vs;
+        erow[p] = ve;
+        matched = true;
+        found++;
+        i = (t == 1) ? ve + 1 : ve;
+        break;
+      }
+    }
+    if (!matched) i = skip_value_idx(s, i, end, qbits, sbits);
+    i = skip_ws(s, i, end);
+    if (i < end && s[i] == ',') i++;
+    if (found == k) break;  // everything located
+  }
+}
+
+// Structural-index fused parse: the launch's payload bytes cross the
+// native boundary ONCE, as a table of per-batch source pointers — no
+// Python-side b"".join. When `joined_out` is given (passthrough plans,
+// whose zero-copy harvest gathers output bytes from the blob) each
+// payload is memcpy'd in first and parsed cache-hot from the copy; when
+// NULL (projection plans — nothing downstream ever reads the raw bytes
+// again) records parse straight from the source buffers and the blob is
+// never built. val_off is absolute into the (possibly virtual)
+// concatenation either way, so the index tables are identical to
+// rp_explode_find's. Returns records parsed (== sum(counts) on success),
+// or -1 on scratch allocation failure.
+int64_t rp_explode_find2(const uint8_t* const* payloads,
+                         const int32_t* payload_len, const int32_t* counts,
+                         int32_t n_batches, uint8_t* joined_out,
+                         const char* paths_blob, const int32_t* path_off,
+                         const int32_t* path_lens, int32_t k,
+                         int64_t* val_off, int32_t* val_len, int8_t* types,
+                         int64_t* vs_arr, int64_t* ve_arr) {
+  // one scratch bitmap pair sized to the largest payload (a record value
+  // can never outgrow its batch payload), reused cache-hot per record
+  int64_t max_words = 1;
+  for (int32_t b = 0; b < n_batches; b++) {
+    int64_t w = ((int64_t)payload_len[b] + 63) >> 6;
+    if (w > max_words) max_words = w;
+  }
+  uint64_t* qbits = (uint64_t*)std::malloc((size_t)max_words * 8);
+  uint64_t* sbits = (uint64_t*)std::malloc((size_t)max_words * 8);
+  if (!qbits || !sbits) {
+    std::free(qbits);
+    std::free(sbits);
+    return -1;
+  }
+  int64_t r = 0;
+  int64_t base = 0;
+  for (int32_t b = 0; b < n_batches; b++) {
+    const uint8_t* src = payloads[b];
+    if (joined_out) {
+      std::memcpy(joined_out + base, src, (size_t)payload_len[b]);
+      src = joined_out + base;  // parse the copy while it is cache-hot
+    }
+    const uint8_t* p = src;
+    const uint8_t* end = p + payload_len[b];
+    for (int32_t i = 0; i < counts[b]; i++, r++) {
+      const uint8_t* value;
+      int64_t vlen;
+      if (!parse_one_record(&p, end, &value, &vlen)) {
+        std::free(qbits);
+        std::free(sbits);
+        return r;
+      }
+      val_off[r] = base + (value - src);
+      if (vlen < 0) {
+        val_len[r] = -1;
+        std::memset(types + r * k, 0, (size_t)k);
+      } else {
+        val_len[r] = (int32_t)vlen;
+        build_structural(value, vlen, qbits, sbits);
+        find2_in_record(value, vlen, qbits, sbits, paths_blob, path_off,
+                        path_lens, k, types + r * k, vs_arr + r * k,
+                        ve_arr + r * k);
+      }
+    }
+    base += payload_len[b];
+  }
+  std::free(qbits);
+  std::free(sbits);
+  return r;
+}
+
+// Fused extraction: every predicate input column AND (optionally) the
+// packed projection rows gathered from the span tables in ONE
+// record-major pass — replaces the per-column gather crossings, the
+// separate rp_project_rows crossing and the numpy pad concatenations.
+// Record bytes resolve against the per-batch source buffers (the same
+// pointer table rp_explode_find2 consumed), so no joined blob is needed.
+// pred_descs is [n_pred, 4] int32 {kind: 0 num, 1 str, 2 exists; span
+// col; w; unused}; pred_ptrs holds the outputs in desc order with
+// per-kind arity num=3 (f32, i32, flags), str=2 (bytes [n_pad, w], vlen
+// i32), exists=1 (u8); rows [n, n_pad) get the staged extractors' exact
+// pad semantics (zeros; str vlen -1). proj_descs/proj_rows/proj_ok (may
+// be empty/NULL) follow rp_project_rows' desc layout and byte semantics.
+void rp_extract_cols2(const uint8_t* const* payloads,
+                      const int32_t* payload_len, const int32_t* counts,
+                      int32_t n_batches, const int64_t* val_off,
+                      const int32_t* val_len, const int8_t* types,
+                      const int64_t* vs, const int64_t* ve, int32_t k,
+                      const int32_t* pred_descs, int32_t n_pred,
+                      void** pred_ptrs, int64_t n_pad,
+                      const int32_t* proj_descs, int32_t n_proj,
+                      int32_t r_out, uint8_t* proj_rows, uint8_t* proj_ok) {
+  int64_t r = 0;
+  int64_t base = 0;
+  for (int32_t b = 0; b < n_batches; b++) {
+    const uint8_t* buf = payloads[b];
+    for (int32_t i = 0; i < counts[b]; i++, r++) {
+      // null values (val_len -1) keep rec at the batch buffer: their
+      // types row is all 0, so every extractor below emits "absent"
+      // without dereferencing the span
+      const uint8_t* rec = buf + (val_off[r] - base);
+      const int8_t* trow = types + r * k;
+      const int64_t* vrow = vs + r * k;
+      const int64_t* erow = ve + r * k;
+      int32_t pi = 0;
+      for (int32_t d = 0; d < n_pred; d++) {
+        const int32_t* de = pred_descs + d * 4;
+        int32_t kind = de[0], col = de[1], w = de[2];
+        if (kind == 0) {  // num: (f32, i32, flags) — rp_gather_num parity
+          num_from_span(rec, trow[col], vrow[col], erow[col],
+                        (float*)pred_ptrs[pi] + r,
+                        (int32_t*)pred_ptrs[pi + 1] + r,
+                        (uint8_t*)pred_ptrs[pi + 2] + r);
+          pi += 3;
+        } else if (kind == 1) {  // str — rp_gather_str parity
+          uint8_t* dst = (uint8_t*)pred_ptrs[pi] + r * (int64_t)w;
+          int32_t* out_vlen = (int32_t*)pred_ptrs[pi + 1];
+          std::memset(dst, 0, (size_t)w);
+          if (trow[col] != 1) {
+            out_vlen[r] = -1;
+          } else {
+            int64_t vlen = erow[col] - vrow[col];
+            if (vlen < 0) vlen = 0;  // unterminated: empty-but-present
+            if (vlen > (1 << 30)) vlen = 1 << 30;
+            out_vlen[r] = (int32_t)vlen;
+            int64_t cp = vlen < w ? vlen : w;
+            std::memcpy(dst, rec + vrow[col], (size_t)cp);
+          }
+          pi += 2;
+        } else {  // exists
+          ((uint8_t*)pred_ptrs[pi])[r] = trow[col] != 0;
+          pi += 1;
+        }
+      }
+      if (n_proj > 0) {
+        project_one_row(rec, trow, vrow, erow, proj_descs, n_proj, r_out,
+                        proj_rows + r * (int64_t)r_out, proj_ok + r);
+      }
+    }
+    base += payload_len[b];
+  }
+  if (n_pad > r) {
+    int64_t n = r;
+    int64_t pad = n_pad - n;
+    int32_t pi = 0;
+    for (int32_t d = 0; d < n_pred; d++) {
+      const int32_t* de = pred_descs + d * 4;
+      int32_t kind = de[0], w = de[2];
+      if (kind == 0) {
+        std::memset((float*)pred_ptrs[pi] + n, 0, (size_t)pad * 4);
+        std::memset((int32_t*)pred_ptrs[pi + 1] + n, 0, (size_t)pad * 4);
+        std::memset((uint8_t*)pred_ptrs[pi + 2] + n, 0, (size_t)pad);
+        pi += 3;
+      } else if (kind == 1) {
+        std::memset((uint8_t*)pred_ptrs[pi] + n * (int64_t)w, 0,
+                    (size_t)(pad * w));
+        int32_t* vl = (int32_t*)pred_ptrs[pi + 1];
+        for (int64_t j = n; j < n_pad; j++) vl[j] = -1;
+        pi += 2;
+      } else {
+        std::memset((uint8_t*)pred_ptrs[pi] + n, 0, (size_t)pad);
+        pi += 1;
+      }
+    }
+  }
 }
 
 // Presence-only column (exists()): 1 when the path resolves to any value.

@@ -434,8 +434,8 @@ async def cmd_debug(args) -> int:
             print(f"  {k:<28}{v}")
         for k in (
             "columnar_backend", "host_pool_probe", "host_pool_probe_prev",
-            "host_pool_recal", "columnar_probe", "arena", "breakers",
-            "lockwatch",
+            "host_pool_recal", "columnar_probe", "parse_path", "parse_probe",
+            "colcache", "arena", "breakers", "lockwatch",
         ):
             if stats.get(k) is not None:
                 print(f"  {k:<28}{stats[k]}")

@@ -158,6 +158,16 @@ PROPERTIES: list[Property] = [
         "Zero-copy harvest: frame byte-identity transform output straight from the joined blob's (offset, len) columns instead of packing a padded row matrix",
         True, bool,
     ),
+    Property(
+        "coproc_structural_parse",
+        "Allow the structural-index fused parse ladder (rp_explode_find2 + one fused extraction crossing); the engine still MEASURES fused-vs-staged on the first representative launch and pins the winner. False pins the scalar staged ladder outright",
+        True, bool,
+    ),
+    Property(
+        "coproc_device_column_cache_mb",
+        "LRU byte budget for the device-resident column cache (repeat scripts over unchanged batch windows skip the host parse/extract ladder and the H2D replay); 0 disables it",
+        32, int, _non_negative,
+    ),
     # --- coproc fault domains (coproc/faults.py)
     Property(
         "coproc_device_deadline_ms",

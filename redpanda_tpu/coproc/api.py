@@ -51,6 +51,10 @@ class CoprocApi:
                 "coproc_host_pool_recal_launches", None
             ),
             gather_frame=_knob("coproc_gather_frame", True),
+            structural_parse=_knob("coproc_structural_parse", None),
+            device_column_cache_mb=_knob(
+                "coproc_device_column_cache_mb", 32
+            ),
             device_deadline_ms=_knob("coproc_device_deadline_ms", None),
             launch_retries=_knob("coproc_launch_retries", None),
             retry_backoff_ms=_knob("coproc_retry_backoff_ms", None),

@@ -146,6 +146,85 @@ def explode_and_find(batches: list[RecordBatch], paths: list[str]):
     return ex, types, vs, ve
 
 
+class StructuralParse:
+    """One launch's structural-index parse: everything the fused
+    extraction crossing (and the engine's bookkeeping) needs, with the
+    decompressed per-batch payload buffers retained so record bytes stay
+    reachable WITHOUT a joined blob. ``joined`` is populated (as a uint8
+    ndarray view over the in-crossing copy) only when the caller asked
+    for it — passthrough plans gather harvest output from it; projection
+    plans never touch raw bytes again and skip the copy entirely."""
+
+    __slots__ = (
+        "payloads", "counts", "ranges", "joined", "val_off", "val_len",
+        "types", "vs", "ve", "n",
+    )
+
+    def __init__(self, payloads, counts, ranges, joined, val_off, val_len,
+                 types, vs, ve):
+        self.payloads = payloads
+        self.counts = counts
+        self.ranges = ranges
+        self.joined = joined
+        self.val_off = val_off
+        self.val_len = val_len
+        self.types = types
+        self.vs = vs
+        self.ve = ve
+        self.n = len(val_len)
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return np.maximum(self.val_len, 0)
+
+    def exploded(self) -> ExplodedBatches:
+        """The classic exploded table (requires ``joined``)."""
+        return ExplodedBatches(
+            self.joined, self.val_off, self.sizes, self.ranges
+        )
+
+
+def explode_find_structural(
+    batches: list[RecordBatch], paths: list[str], need_joined: bool
+) -> StructuralParse | None:
+    """Structural-index fused parse (rp_explode_find2): decompressed
+    payloads cross the native boundary ONCE as a pointer table — the
+    Python-side b"".join copy of explode_and_find's path only happens
+    in-crossing, and only when ``need_joined`` says the harvest will
+    gather from the blob. Returns None when the native symbols are
+    unavailable (caller runs the staged ladder)."""
+    lib = _native()
+    if lib is None or not getattr(lib, "has_structural", False) or not paths:
+        return None
+    payloads: list[bytes] = []
+    counts = np.empty(len(batches), np.int32)
+    ranges: list[tuple[int, int]] = []
+    n = 0
+    for i, b in enumerate(batches):
+        payload = b.payload
+        if b.header.compression != Compression.none:
+            payload = uncompress(payload, b.header.compression)
+        count = b.header.record_count
+        payloads.append(payload)
+        counts[i] = count
+        ranges.append((n, n + count))
+        n += count
+    if n == 0:
+        k = len(paths)
+        return StructuralParse(
+            payloads, counts, ranges,
+            np.zeros(0, np.uint8) if need_joined else None,
+            np.zeros(0, np.int64), np.zeros(0, np.int32),
+            np.zeros((0, k), np.int8), np.zeros((0, k), np.int64),
+            np.zeros((0, k), np.int64),
+        )
+    joined, off, ln, types, vs, ve = lib.explode_find_structural(
+        payloads, counts, paths, need_joined
+    )
+    return StructuralParse(payloads, counts, ranges, joined, off, ln,
+                           types, vs, ve)
+
+
 def merge_exploded(parts: list[ExplodedBatches]) -> ExplodedBatches:
     """Concatenate per-shard explode results into one launch-wide table.
 

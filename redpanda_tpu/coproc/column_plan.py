@@ -64,6 +64,9 @@ class DevCol:
 # num -> (f32, i32, flags), exists -> (present,)
 _COL_ARITY = {"str": 2, "num": 3, "exists": 1}
 
+# DevCol kind -> rp_extract_cols2 desc kind code
+_PRED_KIND = {"num": 0, "str": 1, "exists": 2}
+
 
 class FindCache:
     """Span tables from ONE native JSON walk per record for every
@@ -311,30 +314,85 @@ class ColumnarPlan:
                 data.append(("str", b, np.clip(v, 0, f.max_len), f.max_len))
         return data, ok
 
+    def _proj_desc_rows(self, col_of: dict) -> list | None:
+        """[{kind, span col, w, out off}] rows for the fused projector, or
+        None when any field needs the general path (Substr/Concat/nested).
+        Field order and widths MUST mirror assemble_rows' layout walk —
+        shared by the staged (rp_project_rows) and structural
+        (rp_extract_cols2) fused projectors."""
+        descs = []
+        off = 0
+        for f in self.proj:
+            if isinstance(f, Int) and f.key in col_of:
+                descs.append((0, col_of[f.key], 0, off))
+                off += 4
+            elif isinstance(f, Float) and f.key in col_of:
+                descs.append((1, col_of[f.key], 0, off))
+                off += 4
+            elif type(f) is Str and f.key in col_of:
+                descs.append((2, col_of[f.key], f.max_len, off))
+                off += 2 + f.max_len
+            else:  # Substr/Concat/nested: general path
+                return None
+        return descs
+
     def _project_descs(self, cache):
         """[n_fields, 4] int32 {kind, span col, w, out off} when the fused
-        projector applies to this plan, else None. Field order and widths
-        MUST mirror assemble_rows' layout walk."""
+        projector applies to this plan, else None."""
         if cache is None:
             return None
         lib = _native()
         if lib is None or not getattr(lib, "has_project_rows", False):
             return None
-        descs = []
-        off = 0
-        for f in self.proj:
-            if isinstance(f, Int) and f.key in cache.col:
-                descs.append((0, cache.col[f.key], 0, off))
-                off += 4
-            elif isinstance(f, Float) and f.key in cache.col:
-                descs.append((1, cache.col[f.key], 0, off))
-                off += 4
-            elif type(f) is Str and f.key in cache.col:
-                descs.append((2, cache.col[f.key], f.max_len, off))
-                off += 2 + f.max_len
-            else:  # Substr/Concat/nested: general path
-                return None
+        descs = self._proj_desc_rows(cache.col)
+        if descs is None:
+            return None
         return np.asarray(descs, dtype=np.int32), lib
+
+    # ------------------------------------------------------ structural fused
+    def structural_eligible(self) -> bool:
+        """Whether the structural-index fused ladder can serve this plan:
+        the native structural symbols exist, every DevCol path is a
+        top-level single segment, and the projection (when any) is
+        expressible as fused Int/Float/Str descs. Anything else keeps the
+        staged ladder — the parity contract is 'same outputs, different
+        machinery', never 'almost'."""
+        lib = _native()
+        if lib is None or not getattr(lib, "has_structural", False):
+            return False
+        col_of = {p: i for i, p in enumerate(self.flat_paths())}
+        if not col_of or any(c.path not in col_of for c in self.dev_cols):
+            return False
+        if self.passthrough:
+            return True
+        return self._proj_desc_rows(col_of) is not None
+
+    def extract_fused(self, sp, n_pad: int):
+        """ONE record-major native crossing off the structural parse's
+        span tables: every predicate column and (for projection plans) the
+        packed output rows — replaces extract_device_inputs' per-column
+        gathers + pads AND extract_projection's separate crossing.
+        Returns (cols, proj_data | None, proj_ok | None): cols in
+        _bind_slots order, proj_data in assemble_rows' fused shape."""
+        lib = _native()
+        col_of = {p: i for i, p in enumerate(self.flat_paths())}
+        pred = np.asarray(
+            [(_PRED_KIND[c.kind], col_of[c.path], c.w, 0)
+             for c in self.dev_cols],
+            dtype=np.int32,
+        ).reshape(-1, 4)
+        proj_descs = None
+        if not self.passthrough:
+            proj_descs = np.asarray(
+                self._proj_desc_rows(col_of), dtype=np.int32
+            )
+        cols, rows, ok = lib.extract_cols2(
+            sp.payloads, sp.counts, sp.val_off, sp.val_len,
+            sp.types, sp.vs, sp.ve, pred, n_pad, proj_descs, self.r_out,
+        )
+        if self.passthrough:
+            return cols, None, None
+        return cols, [("rows", rows)], ok
 
     def assemble_rows(self, data, n: int) -> tuple[np.ndarray, np.ndarray]:
         """Projection columns -> ([n, r_out] u8 rows, [n] i32 lens)."""

@@ -58,7 +58,7 @@ from redpanda_tpu.ops.pipeline import IN_META, make_packed_pipeline, unpack_resu
 
 logger = logging.getLogger("rptpu.coproc.engine")
 from redpanda_tpu.ops.transforms import TransformSpec
-from redpanda_tpu.coproc import batch_codec, faults, governor, host_pool, lockwatch
+from redpanda_tpu.coproc import batch_codec, colcache, faults, governor, host_pool, lockwatch
 from redpanda_tpu.coproc.column_plan import ColumnarPlan, HostPlan, PayloadPlan, plan_spec
 
 
@@ -890,6 +890,9 @@ class TpuEngine:
         host_pool_probe: bool = True,
         host_pool_recal_launches: int | None = None,
         gather_frame: bool = True,
+        structural_parse: bool | None = None,
+        structural_probe: bool = True,
+        device_column_cache_mb: int | None = None,
         device_deadline_ms: int | None = None,
         launch_retries: int | None = None,
         retry_backoff_ms: int | None = None,
@@ -1013,6 +1016,57 @@ class TpuEngine:
         # launches through the arena (reset_arenas() for tests).
         self._gather_frame = bool(gather_frame)
         self._arena = batch_codec.Arena()
+        # Structural-index parse path (native rp_explode_find2 +
+        # rp_extract_cols2): fused-vs-staged is a MEASURED per-engine
+        # decision with the host-pool posture — the first representative
+        # columnar launch times BOTH full ladders on its own batches and
+        # the winner pins (PROBE_MARGIN; the scalar staged ladder is the
+        # known path, so structural must show a real win). config
+        # coproc_structural_parse=False pins staged outright;
+        # structural_probe=False pins structural unmeasured (bench
+        # ablations / parity tests need the fused lane deterministically).
+        self._structural_enabled = (
+            True if structural_parse is None else bool(structural_parse)
+        )
+        self._parse_probe_enabled = bool(structural_probe)
+        self._parse_probe: dict | None = None
+        if not self._structural_enabled:
+            self._parse_decision: str | None = "staged"
+            # operator pin, not a measurement: posture only
+            self.governor.note_posture(governor.PARSE_PATH, "staged")
+        elif not self._parse_probe_enabled:
+            self._parse_decision = "structural"
+            self.governor.note_posture(governor.PARSE_PATH, "structural")
+        else:
+            self._parse_decision = None
+        self._parse_decision_lock = lockwatch.wrap(
+            threading.Lock(), "TpuEngine._parse_decision_lock"
+        )
+        # serializes calibration EXECUTION only (see _parse_path): never
+        # held while publishing or reading the decision fields
+        self._parse_probe_run_lock = lockwatch.wrap(
+            threading.Lock(), "TpuEngine._parse_probe_run_lock"
+        )
+        self.governor.update_config_snapshot(
+            structural_parse=self._structural_enabled
+        )
+        # Device-resident column cache (coproc/colcache.py): repeat
+        # scripts over unchanged batch windows skip the whole host ladder
+        # and the H2D replay. 0/None disables it — the BROKER default is
+        # 32 MB via config coproc_device_column_cache_mb (CoprocApi), but
+        # a bare-constructed engine keeps the uncached semantics so fault/
+        # parity harnesses that replay one request still exercise the
+        # machinery they are pointed at.
+        _cache_mb = (
+            0 if device_column_cache_mb is None
+            else max(0, int(device_column_cache_mb))
+        )
+        self._colcache = (
+            colcache.DeviceColumnCache(_cache_mb << 20) if _cache_mb else None
+        )
+        self.governor.update_config_snapshot(
+            device_column_cache_mb=_cache_mb
+        )
         # per-shard stage splits of the most recent sharded launch (bench
         # artifact + debugging aid; overwritten per launch under the lock)
         self.last_launch_shards: list[dict] | None = None
@@ -1233,6 +1287,7 @@ class TpuEngine:
                 del self._handles[sid]
                 self._pipelines.pop(sid, None)
                 self._plans.pop(sid, None)
+                self.invalidate_columns(sid)
                 out.append(DisableResponseCode.success)
             else:
                 out.append(DisableResponseCode.script_id_does_not_exist)
@@ -1243,7 +1298,26 @@ class TpuEngine:
         self._handles.clear()
         self._pipelines.clear()
         self._plans.clear()
+        self.invalidate_columns()
         return n
+
+    # ------------------------------------------------------------ colcache
+    def invalidate_columns(self, script_id: int | None = None) -> int:
+        """Drop cached device/host columns (every script when script_id is
+        None); returns entries dropped. The cache key is content-addressed
+        (a changed batch window misses by construction), so this hook is a
+        MEMORY contract, not a correctness one: the pacemaker calls it
+        when a script's input offsets advance (streaming never re-reads,
+        the bytes are dead weight) and script unload drops its entries."""
+        if self._colcache is None:
+            return 0
+        return self._colcache.invalidate(script_id)
+
+    def reset_column_cache(self) -> None:
+        """Test/bench hook: drop all cached columns AND zero the cache
+        counters so hit-rate accounting is deterministic per run."""
+        if self._colcache is not None:
+            self._colcache.reset()
 
     # ------------------------------------------------------------ metrics
     def stats(self) -> dict:
@@ -1266,6 +1340,12 @@ class TpuEngine:
             # debug mode only: the observed lock-order edge count rides
             # stats() into /v1/coproc/status, rpk debug coproc and BENCH
             out["lockwatch"] = lockwatch.snapshot()
+        with self._parse_decision_lock:
+            out["parse_path"] = self._parse_decision
+            if self._parse_probe is not None:
+                out["parse_probe"] = dict(self._parse_probe)
+        if self._colcache is not None:
+            out["colcache"] = self._colcache.stats()
         if self._host_pool_probe is not None:
             out["host_pool_probe"] = dict(self._host_pool_probe)
         if self._host_pool_probe_prev is not None:
@@ -1550,15 +1630,73 @@ class TpuEngine:
         launch.mode = plan.mode
         launch._plan = plan
         all_batches = [b for _, _, item in entries for b in item.batches]
-        if self._dispatch_sharded(launch, plan, all_batches):
+        # Device-resident column cache: a repeat launch over an unchanged
+        # batch window skips the WHOLE host ladder (decompress, parse,
+        # find, extract) and — when the predicate ran on-device — the H2D
+        # replay (the cached cols are device-resident). The key is
+        # content-addressed (colcache.fingerprint), so an append produces
+        # a clean miss by construction; a key missing twice marks a
+        # repeating workload and this launch dispatches inline to
+        # POPULATE the cache (one slightly slower launch buys every later
+        # identical one a full skip).
+        store_key = None
+        skip_shard = False
+        if (
+            plan.mode == "columnar"
+            and self._colcache is not None
+            and self._mesh is None
+            and all_batches
+        ):
+            key = (script_id, colcache.fingerprint(all_batches))
+            entry, repeat_miss = self._colcache.lookup(key)
+            if entry is not None:
+                self._stat_add("n_colcache_hit", 1.0)
+                probes.coproc_colcache_hits.inc()
+                self._dispatch_columnar_cached(launch, plan, entry)
+                return
+            self._stat_add("n_colcache_miss", 1.0)
+            probes.coproc_colcache_misses.inc()
+            store_key = key
+            skip_shard = repeat_miss
+        if not skip_shard and self._dispatch_sharded(launch, plan, all_batches):
             return
+        # decide the parse ladder BEFORE the stage timer starts: the first
+        # representative launch runs the fused-vs-staged calibration here,
+        # and its four ladder passes must not masquerade as that launch's
+        # t_explode_find* stage time
+        parse = (
+            self._parse_path(plan, all_batches)
+            if plan.mode == "columnar"
+            else "staged"
+        )
         t0 = time.perf_counter()
         cache = None
         if plan.mode == "columnar":
-            # FUSED fast path: framing parse + k-path JSON walk in one
-            # native crossing while each record is cache-hot — the two
-            # hottest host stages become one traversal (rp_explode_find)
             paths = plan.flat_paths()
+            sp = None
+            if parse == "structural":
+                # STRUCTURAL fused lane: payload bytes cross the native
+                # boundary once as a pointer table (no Python-side join;
+                # the blob is built in-crossing only for passthrough
+                # plans, whose zero-copy harvest gathers from it), parsed
+                # by the two-stage structural-index kernel
+                sp = batch_codec.explode_find_structural(
+                    all_batches, paths, need_joined=plan.byte_identity
+                )
+            if sp is not None:
+                self._stat_add("t_explode_find2", time.perf_counter() - t0)
+                launch.ranges = sp.ranges
+                n = sp.n
+                launch.n = n
+                self._stat_add("n_records", n)
+                self._stat_add("n_launches", 1)
+                with self._stats_lock:
+                    probes.coproc_launch_rows_hist.record(n)
+                self._dispatch_columnar_fused(launch, plan, sp, store_key)
+                return
+            # STAGED lane: framing parse + k-path JSON walk in one scalar
+            # native crossing (rp_explode_find) — the parity oracle, and
+            # the measured pick on boxes where structural doesn't win
             fused = batch_codec.explode_and_find(all_batches, paths)
             if fused is not None:
                 exploded, types, vs, ve = fused
@@ -1580,9 +1718,123 @@ class TpuEngine:
         if plan.mode == "payload":
             self._dispatch_payload(launch, exploded, n)
         elif plan.mode == "columnar":
-            self._dispatch_columnar(launch, plan, exploded, n, cache)
+            self._dispatch_columnar(launch, plan, exploded, n, cache, store_key)
         else:  # host: materialized lazily at harvest
             launch._exploded = exploded
+
+    # ------------------------------------------------------ parse-path probe
+    def _parse_path(self, plan, all_batches) -> str:
+        """Which parse ladder this launch runs: the measured per-engine
+        fused-vs-staged decision, gated by plan eligibility (nested paths
+        or general projections keep the staged ladder regardless). Until
+        a representative launch has probed, small launches take the known
+        staged path without pinning anything.
+
+        Same two-lock discipline as the columnar-backend probe: the RUN
+        lock serializes calibration EXECUTION (concurrent first launches
+        must not measure against each other's load), while the short
+        decision lock guards only the field — stats() readers never wait
+        behind the four ladder passes a calibration runs."""
+        if not self._structural_enabled or not plan.structural_eligible():
+            return "staged"
+        with self._parse_decision_lock:
+            decision = self._parse_decision
+        if decision is not None:
+            return decision
+        n = sum(b.header.record_count for b in all_batches)
+        if n < _PROBE_MIN_ROWS:
+            return "staged"
+        with self._parse_probe_run_lock:
+            with self._parse_decision_lock:
+                decision = self._parse_decision
+            if decision is None:
+                self._calibrate_parse_path(plan, all_batches)
+                with self._parse_decision_lock:
+                    decision = self._parse_decision
+        return decision
+
+    def _measure_parse_ratio(self, plan, all_batches) -> tuple[float, float]:
+        """(t_staged, t_structural) for this launch's REAL parse+extract
+        ladders, each best-of-2 — the same measure-the-true-workload
+        posture as _measure_pool_ratio."""
+        paths = plan.flat_paths()
+        n = sum(b.header.record_count for b in all_batches)
+        n_pad = _bucket_rows(n)
+
+        def staged():
+            fused = batch_codec.explode_and_find(all_batches, paths)
+            if fused is None:
+                raise RuntimeError("staged native ladder unavailable")
+            ex, types, vs, ve = fused
+            cache = plan.make_cache_from_tables(ex, paths, types, vs, ve)
+            if plan.dev_cols:
+                plan.extract_device_inputs(
+                    ex.joined, ex.offsets, ex.sizes, n_pad, cache
+                )
+            if not plan.passthrough:
+                plan.extract_projection(ex.joined, ex.offsets, ex.sizes, cache)
+
+        def structural():
+            sp = batch_codec.explode_find_structural(
+                all_batches, paths, need_joined=plan.byte_identity
+            )
+            if sp is None:
+                raise RuntimeError("structural native ladder unavailable")
+            plan.extract_fused(sp, n_pad)
+
+        t_staged = t_structural = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            staged()
+            t_staged = min(t_staged, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            structural()
+            t_structural = min(t_structural, time.perf_counter() - t0)
+        return t_staged, t_structural
+
+    def _calibrate_parse_path(self, plan, all_batches) -> None:
+        """One-shot engine-sticky fused-vs-staged pin off the first
+        representative columnar launch. Caller holds the probe RUN lock;
+        the decision fields publish under the short decision lock."""
+        try:
+            t_staged, t_structural = self._measure_parse_ratio(
+                plan, all_batches
+            )
+        except Exception as exc:
+            # a box whose probe blows up runs the known staged ladder
+            # forever — classified so the demotion is visible on /metrics
+            faults.note_failure("parse_calibration", exc)
+            logger.exception("parse-path calibration failed; keeping staged")
+            with self._parse_decision_lock:
+                self._parse_decision = "staged"
+            self.governor.record(
+                governor.PARSE_PATH,
+                "staged",
+                f"calibration FAILED ({faults.kind_of(exc)}); keeping the "
+                "scalar staged ladder",
+                {"error": faults.kind_of(exc)},
+            )
+            return
+        ratio = t_staged / t_structural if t_structural > 0 else 0.0
+        decision = "structural" if ratio >= host_pool.PROBE_MARGIN else "staged"
+        probe = {
+            "t_staged_ms": round(t_staged * 1e3, 3),
+            "t_structural_ms": round(t_structural * 1e3, 3),
+            "speedup": round(ratio, 3),
+            "chosen": decision,
+        }
+        with self._parse_decision_lock:
+            self._parse_decision = decision
+            self._parse_probe = probe
+        logger.info("parse-path calibration: %s", probe)
+        self.governor.record(
+            governor.PARSE_PATH,
+            decision,
+            f"measured parse+extract ladders: staged {t_staged * 1e3:.3f} ms"
+            f" vs structural {t_structural * 1e3:.3f} ms (structural must "
+            f"win {host_pool.PROBE_MARGIN}x; engine-sticky)",
+            dict(probe),
+        )
 
     # ------------------------------------------------------ pool calibration
     def _measure_pool_ratio(self, plan, all_batches, counts) -> tuple[float, float]:
@@ -1755,12 +2007,17 @@ class TpuEngine:
                 # workers must find the function already cached
                 plan.compile_device(None)
             paths = plan.flat_paths()
+            # parse ladder decided ONCE per launch (may probe, inline, on
+            # the first representative launch) — shard workers must not
+            # race the calibration or mix ladders within a launch
+            structural = self._parse_path(plan, all_batches) == "structural"
             t0 = time.perf_counter()
             try:
                 shards = pool.run([
                     (
                         lambda i=i, s=s, e=e: self._run_columnar_shard(
-                            i, launch, plan, all_batches[s:e], paths, use_host
+                            i, launch, plan, all_batches[s:e], paths,
+                            use_host, structural
                         )
                     )
                     for i, (s, e) in enumerate(parts)
@@ -1835,13 +2092,16 @@ class TpuEngine:
 
     def _run_columnar_shard(
         self, idx: int, launch: _Launch, plan: ColumnarPlan, batches, paths,
-        use_host,
+        use_host, structural: bool = False,
     ) -> _HostShard:
         """One shard's dispatch-side host stages, on a pool worker: explode
         + find, predicate column extraction, predicate dispatch (the shard's
         own device launch or numpy eval — issued as soon as THIS shard's
         columns land, overlapping later shards' extraction), projection
-        extraction. Touches only its own shard (SHD6xx)."""
+        extraction. ``structural`` runs the shard through the fused
+        structural ladder instead (one parse crossing + one extraction
+        crossing — same outputs, the engine-level decision is per launch).
+        Touches only its own shard (SHD6xx)."""
         shard = _HostShard()
         t_shard0 = time.perf_counter()
         # shard-worker fault domain: a fault here (injected or real) fails
@@ -1860,32 +2120,62 @@ class TpuEngine:
 
         t0 = time.perf_counter()
         cache = None
-        fused = batch_codec.explode_and_find(batches, paths) if paths else None
-        if fused is not None:
-            ex, types, vs, ve = fused
-            cache = plan.make_cache_from_tables(ex, paths, types, vs, ve)
-            stage("t_explode_find", t0)
-        else:
-            ex = batch_codec.explode_batches(batches)
-            stage("t_explode", t0)
-        shard.exploded = ex
-        shard.ranges = ex.ranges
-        n = len(ex.sizes)
-        shard.n = n
-        if n == 0:
-            shard.proj_ok = np.zeros(0, dtype=bool)
-            return shard
-        if cache is None:
-            t0 = time.perf_counter()
-            cache = plan.build_find_cache(ex.joined, ex.offsets, ex.sizes)
-            stage("t_find", t0)
-        if plan.dev_cols:
+        cols = None
+        n_pad = 0
+        fused_proj = None  # (proj_data, proj_ok) from the fused lane
+        sp = (
+            batch_codec.explode_find_structural(
+                batches, paths, need_joined=plan.byte_identity
+            )
+            if structural and paths
+            else None
+        )
+        if sp is not None:
+            stage("t_explode_find2", t0)
+            shard.ranges = sp.ranges
+            n = sp.n
+            shard.n = n
+            if n == 0:
+                shard.proj_ok = np.zeros(0, dtype=bool)
+                return shard
+            # passthrough framing gathers from the joined blob the fused
+            # crossing built; projection shards never need raw bytes again
+            shard.exploded = sp.exploded() if plan.byte_identity else None
             t0 = time.perf_counter()
             n_pad = _bucket_rows(n)
-            cols = plan.extract_device_inputs(
-                ex.joined, ex.offsets, ex.sizes, n_pad, cache
+            cols, proj_data, proj_ok = plan.extract_fused(sp, n_pad)
+            stage("t_fused_extract", t0)
+            fused_proj = (proj_data, proj_ok)
+        else:
+            fused = (
+                batch_codec.explode_and_find(batches, paths) if paths else None
             )
-            stage("t_extract_pred", t0)
+            if fused is not None:
+                ex, types, vs, ve = fused
+                cache = plan.make_cache_from_tables(ex, paths, types, vs, ve)
+                stage("t_explode_find", t0)
+            else:
+                ex = batch_codec.explode_batches(batches)
+                stage("t_explode", t0)
+            shard.exploded = ex
+            shard.ranges = ex.ranges
+            n = len(ex.sizes)
+            shard.n = n
+            if n == 0:
+                shard.proj_ok = np.zeros(0, dtype=bool)
+                return shard
+            if cache is None:
+                t0 = time.perf_counter()
+                cache = plan.build_find_cache(ex.joined, ex.offsets, ex.sizes)
+                stage("t_find", t0)
+            if plan.dev_cols:
+                t0 = time.perf_counter()
+                n_pad = _bucket_rows(n)
+                cols = plan.extract_device_inputs(
+                    ex.joined, ex.offsets, ex.sizes, n_pad, cache
+                )
+                stage("t_extract_pred", t0)
+        if cols is not None:
             slot = _MaskSlot(n)
             slot.trace_id = launch.trace_id
             t0 = time.perf_counter()
@@ -1922,17 +2212,20 @@ class TpuEngine:
                     slot._enq_t = time.perf_counter()
                     self._harvest_q.put(slot)
             shard.mask = slot
-        t0 = time.perf_counter()
         if plan.passthrough:
             shard.proj_ok = np.ones(n, dtype=bool)
+        elif fused_proj is not None:
+            # projection rows came out of the fused extraction crossing
+            shard.proj_data, shard.proj_ok = fused_proj
         else:
+            t0 = time.perf_counter()
             data, ok = plan.extract_projection(
                 ex.joined, ex.offsets, ex.sizes, cache
             )
             shard.proj_data = data
             shard.proj_ok = ok
             shard.exploded = None  # framing reads proj_data, not raw records
-        stage("t_extract_proj", t0)
+            stage("t_extract_proj", t0)
         tracer.record(
             "coproc.shard",
             (time.perf_counter() - t_shard0) * 1e6,
@@ -1985,8 +2278,106 @@ class TpuEngine:
         self._stat_add("bytes_d2h", n_pad * (r_out + 8))
         launch._packed_dev = packed
 
+    def _dispatch_predicate(
+        self, launch: _Launch, plan: ColumnarPlan, cols, n: int, n_pad: int,
+        entry=None, dev_cols=None,
+    ) -> None:
+        """The columnar predicate leg over extracted columns — backend
+        pick (measured probe), breaker gate, device dispatch or numpy
+        eval, harvester enqueue. ONE copy shared by the staged, fused and
+        cache-hit dispatch paths. ``entry``: a column-cache entry under
+        construction — the device leg records its device-put arrays into
+        it so later hits launch with zero H2D. ``dev_cols``: already
+        device-resident arrays from a cache hit (no H2D accounting).
+        ``cols`` are always the HOST arrays (probe + exact fallback)."""
+        if not plan.dev_cols:
+            return
+        use_host = self._force_mode == "columnar_host"
+        backend = TpuEngine.sticky_columnar_backend()
+        if self._force_mode is None and self._mesh is None:
+            if backend is None:
+                if n_pad >= _PROBE_MIN_ROWS:
+                    # double-checked under the probe RUN lock:
+                    # concurrent first launches must not each pay the
+                    # device probe (or tear the backend/probe-record
+                    # pair) — the loser waits here and adopts the
+                    # winner's pick. Readers never take this lock.
+                    with TpuEngine._columnar_probe_run_lock:
+                        if TpuEngine.sticky_columnar_backend() is None:
+                            self._probe_columnar_backend(plan, cols)
+                    backend = TpuEngine.sticky_columnar_backend()
+                    use_host = backend == "host"
+                else:
+                    # too small to be representative of steady state:
+                    # don't pin the process-wide choice on a trickle
+                    # batch — numpy is the cheap safe pick at this size
+                    use_host = True
+            else:
+                use_host = backend == "host"
+        if backend is not None:
+            # this engine runs the sticky process-wide pick (probed by
+            # us just above, or inherited): posture only — the probe
+            # that made the decision already journaled it
+            self.governor.note_posture(
+                governor.COLUMNAR_BACKEND, backend
+            )
+        breaker_demoted = False
+        if not use_host and not self._breaker.allow_device():
+            # open breaker: the whole launch stays on the exact numpy
+            # predicate over the same columns — identical bits, no
+            # device touch until the half-open probe re-admits it
+            use_host = breaker_demoted = True
+        t0 = time.perf_counter()
+        if use_host:
+            # measured-host predicate: SAME extracted columns, numpy —
+            # what the probe (or the bench ablation) picked on this link
+            launch._mask_np = plan.eval_host_mask(cols)
+            self._stat_add("t_dispatch", time.perf_counter() - t0)
+            if breaker_demoted:
+                self._count_fallback(n)
+        else:
+            def leg():
+                faults.inject(faults.DEVICE_DISPATCH)
+                fn = plan.compile_device(self._mesh)
+                args = dev_cols
+                if args is None:
+                    if entry is not None:
+                        # explicit device_put so the cache entry owns
+                        # committed device arrays: later hits pass them
+                        # straight back to the jitted predicate and no
+                        # byte re-crosses the link
+                        import jax
+
+                        args = [jax.device_put(c) for c in cols]
+                        entry.cols_dev = args
+                    else:
+                        args = cols
+                mask = fn(*args)
+                mask.copy_to_host_async()
+                return mask
+
+            mask = self._try_device_leg(faults.DEVICE_DISPATCH, leg)
+            if mask is None:
+                launch._mask_np = plan.eval_host_mask(cols)
+                self._stat_add("t_dispatch", time.perf_counter() - t0)
+                self._count_fallback(n)
+            else:
+                self._breaker.record_success()  # dispatch-domain verdict
+                self._stat_add("t_dispatch", time.perf_counter() - t0)
+                if dev_cols is None:
+                    self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
+                self._stat_add("bytes_d2h", n_pad // 8)
+                launch._mask_dev = mask
+                launch._cols = cols
+                launch._mask_event = threading.Event()
+                launch._mask_state = "queued"
+                self._ensure_harvester()
+                launch._enq_t = time.perf_counter()
+                self._harvest_q.put(launch)
+
     def _dispatch_columnar(
-        self, launch: _Launch, plan: ColumnarPlan, exploded, n: int, cache=None
+        self, launch: _Launch, plan: ColumnarPlan, exploded, n: int,
+        cache=None, store_key=None,
     ) -> None:
         launch.r_out = plan.r_out
         if n == 0:
@@ -2001,81 +2392,22 @@ class TpuEngine:
                 exploded.joined, exploded.offsets, exploded.sizes
             )
             self._stat_add("t_find", time.perf_counter() - t0)
+        entry = None
+        cols = None
+        n_pad = _bucket_rows(n)
         if plan.dev_cols:
             t0 = time.perf_counter()
-            n_pad = _bucket_rows(n)
             cols = plan.extract_device_inputs(
                 exploded.joined, exploded.offsets, exploded.sizes, n_pad, cache
             )
             self._stat_add("t_extract_pred", time.perf_counter() - t0)
-            use_host = self._force_mode == "columnar_host"
-            backend = TpuEngine.sticky_columnar_backend()
-            if self._force_mode is None and self._mesh is None:
-                if backend is None:
-                    if n_pad >= _PROBE_MIN_ROWS:
-                        # double-checked under the probe RUN lock:
-                        # concurrent first launches must not each pay the
-                        # device probe (or tear the backend/probe-record
-                        # pair) — the loser waits here and adopts the
-                        # winner's pick. Readers never take this lock.
-                        with TpuEngine._columnar_probe_run_lock:
-                            if TpuEngine.sticky_columnar_backend() is None:
-                                self._probe_columnar_backend(plan, cols)
-                        backend = TpuEngine.sticky_columnar_backend()
-                        use_host = backend == "host"
-                    else:
-                        # too small to be representative of steady state:
-                        # don't pin the process-wide choice on a trickle
-                        # batch — numpy is the cheap safe pick at this size
-                        use_host = True
-                else:
-                    use_host = backend == "host"
-            if backend is not None:
-                # this engine runs the sticky process-wide pick (probed by
-                # us just above, or inherited): posture only — the probe
-                # that made the decision already journaled it
-                self.governor.note_posture(
-                    governor.COLUMNAR_BACKEND, backend
+            if store_key is not None and self._colcache is not None:
+                entry = colcache.Entry(
+                    n=n, n_pad=n_pad, ranges=launch.ranges, cols=cols,
+                    exploded=exploded if plan.passthrough else None,
+                    parse_mode="staged",
                 )
-            breaker_demoted = False
-            if not use_host and not self._breaker.allow_device():
-                # open breaker: the whole launch stays on the exact numpy
-                # predicate over the same columns — identical bits, no
-                # device touch until the half-open probe re-admits it
-                use_host = breaker_demoted = True
-            t0 = time.perf_counter()
-            if use_host:
-                # measured-host predicate: SAME extracted columns, numpy —
-                # what the probe (or the bench ablation) picked on this link
-                launch._mask_np = plan.eval_host_mask(cols)
-                self._stat_add("t_dispatch", time.perf_counter() - t0)
-                if breaker_demoted:
-                    self._count_fallback(n)
-            else:
-                def leg():
-                    faults.inject(faults.DEVICE_DISPATCH)
-                    fn = plan.compile_device(self._mesh)
-                    mask = fn(*cols)
-                    mask.copy_to_host_async()
-                    return mask
-
-                mask = self._try_device_leg(faults.DEVICE_DISPATCH, leg)
-                if mask is None:
-                    launch._mask_np = plan.eval_host_mask(cols)
-                    self._stat_add("t_dispatch", time.perf_counter() - t0)
-                    self._count_fallback(n)
-                else:
-                    self._breaker.record_success()  # dispatch-domain verdict
-                    self._stat_add("t_dispatch", time.perf_counter() - t0)
-                    self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
-                    self._stat_add("bytes_d2h", n_pad // 8)
-                    launch._mask_dev = mask
-                    launch._cols = cols
-                    launch._mask_event = threading.Event()
-                    launch._mask_state = "queued"
-                    self._ensure_harvester()
-                    launch._enq_t = time.perf_counter()
-                    self._harvest_q.put(launch)
+            self._dispatch_predicate(launch, plan, cols, n, n_pad, entry=entry)
         # Projection extraction overlaps the device launch.
         t0 = time.perf_counter()
         if plan.passthrough:
@@ -2087,7 +2419,78 @@ class TpuEngine:
             )
             launch._proj_data = data
             launch._proj_ok = ok
+            if entry is not None:
+                entry.proj_data = data
+                entry.proj_ok = ok
+                entry.nbytes = entry._measure()
         self._stat_add("t_extract_proj", time.perf_counter() - t0)
+        if entry is not None:
+            self._colcache.put(store_key, entry)
+
+    def _dispatch_columnar_fused(
+        self, launch: _Launch, plan: ColumnarPlan, sp, store_key=None
+    ) -> None:
+        """Structural fused lane: ONE record-major extraction crossing off
+        the span tables the structural parse produced — predicate columns
+        and packed projection rows together; the separate
+        t_extract_pred/t_extract_proj passes don't exist on this path."""
+        n = sp.n
+        launch.r_out = plan.r_out
+        if n == 0:
+            launch._proj_ok = np.zeros(0, bool)
+            return
+        t0 = time.perf_counter()
+        n_pad = _bucket_rows(n)
+        cols, proj_data, proj_ok = plan.extract_fused(sp, n_pad)
+        self._stat_add("t_fused_extract", time.perf_counter() - t0)
+        ex = sp.exploded() if plan.passthrough else None
+        if plan.passthrough:
+            launch._proj_ok = np.ones(n, bool)
+            launch._exploded = ex
+        else:
+            launch._proj_data = proj_data
+            launch._proj_ok = proj_ok
+        entry = None
+        if store_key is not None and self._colcache is not None:
+            entry = colcache.Entry(
+                n=n, n_pad=n_pad, ranges=launch.ranges, cols=cols,
+                proj_data=proj_data, proj_ok=launch._proj_ok, exploded=ex,
+                parse_mode="structural",
+            )
+        self._dispatch_predicate(launch, plan, cols, n, n_pad, entry=entry)
+        if entry is not None:
+            self._colcache.put(store_key, entry)
+
+    def _dispatch_columnar_cached(
+        self, launch: _Launch, plan: ColumnarPlan, entry
+    ) -> None:
+        """Column-cache hit: every host dispatch stage (decompress, parse,
+        find, extract) is skipped, and a device-backed predicate launches
+        over the cached DEVICE-RESIDENT columns — zero H2D. Output is
+        bit-identical to a cold run because the predicate and projection
+        consume the exact arrays the cold launch produced (entries are
+        read-only after put)."""
+        n = entry.n
+        launch.ranges = list(entry.ranges)
+        launch.n = n
+        launch.r_out = plan.r_out
+        self._stat_add("n_records", n)
+        self._stat_add("n_launches", 1)
+        with self._stats_lock:
+            probes.coproc_launch_rows_hist.record(n)
+        if n == 0:
+            launch._proj_ok = np.zeros(0, bool)
+            return
+        if plan.passthrough:
+            launch._proj_ok = np.ones(n, bool)
+            launch._exploded = entry.exploded
+        else:
+            launch._proj_data = entry.proj_data
+            launch._proj_ok = entry.proj_ok
+        self._dispatch_predicate(
+            launch, plan, entry.cols, n, entry.n_pad,
+            dev_cols=entry.cols_dev,
+        )
 
     def _probe_columnar_backend(self, plan, cols) -> None:
         """One-time process-wide probe: run the SAME predicate over the SAME

@@ -62,6 +62,16 @@ BREAKER = "breaker"
 HARVEST_PATH = "harvest_path"
 SHARDED_SEAL = "sharded_seal"
 DEADLINE = "deadline"
+# structural-index parse: the engine's measured fused-vs-staged probe
+# (staged = scalar rp_explode_find ladder + per-column gathers; structural
+# = rp_explode_find2 + one fused extraction crossing) journals its pick
+# here — slower boxes self-demote honestly, same posture as host_pool
+PARSE_PATH = "parse_path"
+# device-resident column cache (coproc/colcache.py): budget/eviction
+# pressure notes land here when the cache has to shed entries
+COLUMN_CACHE = "column_cache"
+# bench.py regression-diagnosis verdicts (A/A-bracketed config reruns)
+DIAGNOSIS = "diagnosis"
 # coproc_lockwatch: each newly observed runtime lock-order edge journals
 # here (coproc/lockwatch.py) — the dynamic validation trail of the
 # pandaraces static acquisition graph
@@ -69,7 +79,7 @@ LOCKWATCH = "lockwatch"
 
 DOMAINS = (
     HOST_POOL, COLUMNAR_BACKEND, DEVICE_LZ4, BREAKER, HARVEST_PATH,
-    SHARDED_SEAL, DEADLINE, LOCKWATCH,
+    SHARDED_SEAL, DEADLINE, PARSE_PATH, COLUMN_CACHE, DIAGNOSIS, LOCKWATCH,
 )
 
 # fault domains that get their own breaker + adaptive deadline. Each
@@ -97,6 +107,7 @@ _STATE_ENCODING: dict[str, dict[str, float]] = {
     DEVICE_LZ4: {"host": 0.0, "device": 1.0},
     HARVEST_PATH: {"padded": 0.0, "gather": 1.0},
     SHARDED_SEAL: {"inline": 0.0, "sharded": 1.0},
+    PARSE_PATH: {"staged": 0.0, "structural": 1.0},
 }
 
 _BREAKER_SEVERITY = {
@@ -658,6 +669,7 @@ class Governor:
             DEVICE_LZ4: modes.get(DEVICE_LZ4),
             HARVEST_PATH: modes.get(HARVEST_PATH),
             SHARDED_SEAL: modes.get(SHARDED_SEAL),
+            PARSE_PATH: modes.get(PARSE_PATH),
             "breakers": self.breakers_snapshot(),
             "deadlines_ms": {
                 d: round(self.deadline_s(d) * 1e3, 3) for d in BREAKER_DOMAINS

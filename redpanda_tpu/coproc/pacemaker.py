@@ -178,6 +178,13 @@ class ScriptContext:
                     if await self._write_materialized(item.source, item.batches):
                         self.offsets[item.source] = read_high[item.source]
                         moved = True
+            if moved:
+                # append-invalidation hook for the device column cache:
+                # this script's input window just advanced, so its cached
+                # columns can never be re-read (the cache key is
+                # content-addressed — this reclaims memory, it is not
+                # what keeps hits correct)
+                pm.engine.invalidate_columns(self.script_id)
             return moved
 
     def _input_ntps(self) -> list[NTP]:

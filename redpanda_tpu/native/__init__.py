@@ -114,6 +114,34 @@ class _NativeLib:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p,
             ]
+        # Structural-index fused parse + extraction (the pointer-table
+        # crossings: payload bytes reach native code without a Python-side
+        # b"".join; the joined blob is built in-crossing only when the
+        # caller needs it for the zero-copy harvest). The two symbols ship
+        # together; the scalar rp_explode_find stays bound as the parity
+        # oracle and fallback.
+        self.has_structural = hasattr(dll, "rp_explode_find2") and hasattr(
+            dll, "rp_extract_cols2"
+        )
+        if self.has_structural:
+            dll.rp_explode_find2.restype = ctypes.c_int64
+            dll.rp_explode_find2.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            dll.rp_extract_cols2.restype = None
+            dll.rp_extract_cols2.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_int32, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+            ]
         self.has_project_rows = hasattr(dll, "rp_project_rows")
         if self.has_project_rows:
             dll.rp_project_rows.restype = ctypes.c_int64
@@ -484,6 +512,125 @@ class _NativeLib:
         if parsed != total:
             raise ValueError(f"record framing parse failed at record {parsed}/{total}")
         return val_off, val_len, types, vs, ve
+
+    def explode_find_structural(
+        self,
+        payloads: list[bytes],
+        counts: np.ndarray,
+        paths: list[str],
+        build_joined: bool,
+    ):
+        """Structural-index fused parse (rp_explode_find2): the payload
+        bytes cross the boundary ONCE as a per-batch pointer table — no
+        Python-side b"".join. ``build_joined=True`` additionally emits the
+        concatenated blob (built in-crossing, parsed cache-hot from the
+        copy) for plans whose zero-copy harvest gathers from it; False
+        skips the blob entirely (projection plans never read the raw bytes
+        again). Returns (joined | None, val_off, val_len, types, vs, ve);
+        val_off is absolute into the (possibly virtual) concatenation,
+        identical to explode_find's tables."""
+        counts = np.ascontiguousarray(counts, dtype=np.int32)
+        p_len = np.fromiter((len(p) for p in payloads), np.int32, len(payloads))
+        total = int(counts.sum())
+        blob, path_off, path_len, k = _pack_paths(paths)
+        # bytes -> borrowed char*; the ctypes array retains the objects and
+        # the caller holds the payloads list across the call either way
+        ptrs = (ctypes.c_char_p * len(payloads))(*payloads)
+        joined = (
+            np.empty(max(int(p_len.sum()), 1), dtype=np.uint8)
+            if build_joined
+            else None
+        )
+        val_off = np.empty(total, dtype=np.int64)
+        val_len = np.empty(total, dtype=np.int32)
+        types = np.empty((total, k), dtype=np.int8)
+        vs = np.empty((total, k), dtype=np.int64)
+        ve = np.empty((total, k), dtype=np.int64)
+        parsed = self._dll.rp_explode_find2(
+            ptrs, p_len.ctypes.data, counts.ctypes.data, len(payloads),
+            joined.ctypes.data if joined is not None else None,
+            blob, path_off.ctypes.data, path_len.ctypes.data, k,
+            val_off.ctypes.data, val_len.ctypes.data,
+            types.ctypes.data, vs.ctypes.data, ve.ctypes.data,
+        )
+        if parsed != total:
+            # includes rp_explode_find2's -1 scratch-allocation sentinel
+            raise ValueError(f"record framing parse failed at record {parsed}/{total}")
+        if joined is not None and int(p_len.sum()) == 0:
+            joined = joined[:0]
+        return joined, val_off, val_len, types, vs, ve
+
+    def extract_cols2(
+        self,
+        payloads: list[bytes],
+        counts: np.ndarray,
+        val_off: np.ndarray,
+        val_len: np.ndarray,
+        types: np.ndarray,
+        vs: np.ndarray,
+        ve: np.ndarray,
+        pred_descs: np.ndarray,
+        n_pad: int,
+        proj_descs: np.ndarray | None = None,
+        r_out: int = 0,
+    ):
+        """FUSED extraction (rp_extract_cols2): every predicate column and
+        (optionally) the packed projection rows gathered from the span
+        tables in ONE record-major crossing, straight from the per-batch
+        source buffers — replaces the per-column gather crossings, the
+        separate project_rows crossing AND the numpy pad concatenations.
+        pred_descs is [n, 4] int32 {kind: 0 num, 1 str, 2 exists; span
+        col; w; 0}; proj_descs follows project_rows' desc layout. Returns
+        (pred_arrays, proj_rows | None, proj_ok | None); pred_arrays is
+        the flat list in desc order (num -> f32, i32, flags; str -> bytes
+        [n_pad, w], vlen; exists -> u8) — the _bind_slots input shape."""
+        counts = np.ascontiguousarray(counts, dtype=np.int32)
+        p_len = np.fromiter((len(p) for p in payloads), np.int32, len(payloads))
+        val_off = np.ascontiguousarray(val_off, dtype=np.int64)
+        val_len = np.ascontiguousarray(val_len, dtype=np.int32)
+        types = np.ascontiguousarray(types, dtype=np.int8)
+        vs = np.ascontiguousarray(vs, dtype=np.int64)
+        ve = np.ascontiguousarray(ve, dtype=np.int64)
+        pred_descs = np.ascontiguousarray(pred_descs, dtype=np.int32)
+        n, _k = types.shape
+        ptrs = (ctypes.c_char_p * len(payloads))(*payloads)
+        arrays: list[np.ndarray] = []
+        for kind, _col, w, _ in pred_descs:
+            if kind == 0:
+                arrays += [
+                    np.empty(n_pad, np.float32),
+                    np.empty(n_pad, np.int32),
+                    np.empty(n_pad, np.uint8),
+                ]
+            elif kind == 1:
+                arrays += [
+                    np.empty((n_pad, int(w)), np.uint8),
+                    np.empty(n_pad, np.int32),
+                ]
+            else:
+                arrays.append(np.empty(n_pad, np.uint8))
+        pred_ptrs = (ctypes.c_void_p * max(len(arrays), 1))(
+            *[a.ctypes.data for a in arrays]
+        )
+        if proj_descs is not None and len(proj_descs):
+            proj_descs = np.ascontiguousarray(proj_descs, dtype=np.int32)
+            rows = np.empty((n, r_out), dtype=np.uint8)
+            ok = np.empty(n, dtype=np.bool_)
+            n_proj, rows_ptr, ok_ptr = (
+                len(proj_descs), rows.ctypes.data, ok.ctypes.data
+            )
+            proj_ptr = proj_descs.ctypes.data
+        else:
+            rows = ok = None
+            n_proj, rows_ptr, ok_ptr, proj_ptr = 0, None, None, None
+        self._dll.rp_extract_cols2(
+            ptrs, p_len.ctypes.data, counts.ctypes.data, len(payloads),
+            val_off.ctypes.data, val_len.ctypes.data,
+            types.ctypes.data, vs.ctypes.data, ve.ctypes.data, types.shape[1],
+            pred_descs.ctypes.data, len(pred_descs), pred_ptrs, n_pad,
+            proj_ptr, n_proj, r_out, rows_ptr, ok_ptr,
+        )
+        return arrays, rows, ok
 
     def project_rows(
         self,

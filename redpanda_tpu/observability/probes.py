@@ -78,6 +78,19 @@ coproc_harvest_padded = registry.counter(
     "Harvest framing crossings by path",
     mode="padded",
 )
+# Device-resident column cache (coproc/colcache.py): a hit means a launch
+# skipped the whole host parse/extract ladder (and, on the device backend,
+# the H2D replay of its predicate columns).
+coproc_colcache_hits = registry.counter(
+    "coproc_colcache_total",
+    "Column-cache lookups by outcome",
+    outcome="hit",
+)
+coproc_colcache_misses = registry.counter(
+    "coproc_colcache_total",
+    "Column-cache lookups by outcome",
+    outcome="miss",
+)
 
 # -------------------------------------------------------- coproc fault domains
 # Classified failure counter, one series per (fault domain, exception kind):

@@ -80,12 +80,27 @@ def bench_batch_codec(secs: float) -> dict:
 
 
 def bench_explode_find(secs: float) -> dict:
-    """Per-component rates for the engine's native launch stages:
-    explode_find = the FUSED framing-parse + JSON-walk pass (one
-    traversal); find_multi = the JSON walk ALONE over pre-exploded
-    records (not directly comparable — it omits the framing parse);
-    project_rows = the fused projection gather. Regressions in any hot
-    loop show up here per component, not just in the headline."""
+    """Staged-vs-structural parse+extract ladders (min-of-blocks) over
+    three record shapes, plus the old per-component rates.
+
+    staged = the scalar rp_explode_find ladder exactly as the engine runs
+    it (Python payload join, scalar fused parse, per-column span gathers +
+    pads, project_rows crossing); structural = the fused ladder
+    (rp_explode_find2 pointer-table parse — no join for projection plans —
+    + ONE rp_extract_cols2 extraction crossing). The parse-only split is
+    also reported so the kernel and the fusion are attributable
+    separately.
+
+    Shapes: ``flat`` is the bench.py 64p headline shape (~1KB records, one
+    long string value — the scalar walker's memchr best case, where the
+    two ladders are closest); ``nested`` buries an unselected nested
+    container the scalar walker must skip byte-at-a-time; ``stringified``
+    carries a stringified-JSON msg (escaped quotes everywhere — the
+    memchr-restart pathology, and THE log-analytics shape the structural
+    escape mask exists for). --assert-explode-speedup gates
+    ``explode_find_speedup`` = staged/structural on the stringified shape;
+    the engine's own parse-path probe decides per box which ladder
+    production launches take (BENCH json records its verdict)."""
     from redpanda_tpu.coproc import batch_codec
     from redpanda_tpu.coproc.column_plan import plan_spec
     from redpanda_tpu.models.record import Record, RecordBatch
@@ -93,43 +108,108 @@ def bench_explode_find(secs: float) -> dict:
     from redpanda_tpu.ops.transforms import Int, Str, map_project, where
 
     rng = np.random.default_rng(0)
-    batches = []
-    for p_ in range(64):
-        recs = [
-            Record(
-                offset_delta=i,
-                value=json.dumps({
-                    "level": ["error", "info"][i % 2], "code": i,
-                    "msg": "x" * int(rng.integers(40, 90)),
-                }).encode(),
-            )
-            for i in range(32)
-        ]
-        batches.append(RecordBatch.build(recs, base_offset=0))
-    paths = ["level", "code", "msg"]
-    n_recs = 64 * 32
-    out = {}
-    fused = batch_codec.explode_and_find(batches, paths)
-    if fused is not None:
-        r = _rate(lambda: batch_codec.explode_and_find(batches, paths), secs, n_recs)
-        out["explode_find_recs_per_s"] = round(r, 1)
-    lib = batch_codec._native()
-    ex = batch_codec.explode_batches(batches)
-    if lib is not None and getattr(lib, "has_find_multi", False):
-        split = _rate(
-            lambda: lib.find_multi(ex.joined, ex.offsets, ex.sizes, paths),
-            secs, n_recs,
-        )
-        out["find_multi_recs_per_s"] = round(split, 1)
+
+    def flat(p, i):
+        return json.dumps({
+            "level": ["error", "info", "warn"][(p + i) % 3], "code": i,
+            "msg": "x" * (900 + int(rng.integers(0, 100))),
+        }).encode()
+
+    def nested(p, i):
+        inner = {
+            "user": {"id": i, "tags": ["a", "b", "c"],
+                     "attrs": {f"k{j}": j for j in range(20)}},
+            "ctx": [{"s": "x", "n": j} for j in range(10)],
+        }
+        return json.dumps({
+            "level": ["error", "info"][i % 2], "payload": inner,
+            "code": i, "msg": "x" * 120,
+        }).encode()
+
+    def stringified(p, i):
+        inner = json.dumps({
+            "trace": "abc", "fields": {f"f{j}": "v" * 8 for j in range(24)},
+        })
+        return json.dumps({
+            "level": ["error", "info"][i % 2], "code": i, "msg": inner,
+        }).encode()
+
     spec = where(field("level") == "error") | map_project(Int("code"), Str("msg", 64))
     plan = plan_spec(spec)
-    cache = plan.build_find_cache(ex.joined, ex.offsets, ex.sizes)
-    if cache is not None and plan._project_descs(cache) is not None:
-        r = _rate(
-            lambda: plan.extract_projection(ex.joined, ex.offsets, ex.sizes, cache),
-            secs, n_recs,
-        )
-        out["project_rows_recs_per_s"] = round(r, 1)
+    paths = plan.flat_paths()
+    lib = batch_codec._native()
+    out = {}
+
+    def min_of_blocks(fn) -> float:
+        fn()  # warmup
+        best = float("inf")
+        t_end = time.perf_counter() + secs
+        while time.perf_counter() < t_end:
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def bucket(n: int) -> int:
+        b = 128
+        while b < n:
+            b *= 2
+        return b
+
+    for shape, value_fn in (("flat", flat), ("nested", nested),
+                            ("stringified", stringified)):
+        batches = [
+            RecordBatch.build(
+                [Record(offset_delta=i, value=value_fn(p, i)) for i in range(32)],
+                base_offset=0,
+            )
+            for p in range(64)
+        ]
+        n = 64 * 32
+        n_pad = bucket(n)
+
+        def staged():
+            got = batch_codec.explode_and_find(batches, paths)
+            if got is None:
+                raise RuntimeError("staged native ladder unavailable")
+            ex, types, vs, ve = got
+            cache = plan.make_cache_from_tables(ex, paths, types, vs, ve)
+            plan.extract_device_inputs(ex.joined, ex.offsets, ex.sizes, n_pad, cache)
+            plan.extract_projection(ex.joined, ex.offsets, ex.sizes, cache)
+
+        def structural():
+            sp = batch_codec.explode_find_structural(batches, paths, False)
+            if sp is None:
+                raise RuntimeError("structural native ladder unavailable")
+            plan.extract_fused(sp, n_pad)
+
+        try:
+            s = min_of_blocks(staged)
+        except RuntimeError:
+            out["explode_find_skipped"] = "native lib unavailable"
+            return out
+        out[f"explode_find_{shape}_staged_ms"] = round(s * 1e3, 3)
+        out[f"explode_find_{shape}_staged_recs_per_s"] = round(n / s, 1)
+        if lib is not None and getattr(lib, "has_structural", False):
+            f = min_of_blocks(structural)
+            out[f"explode_find_{shape}_structural_ms"] = round(f * 1e3, 3)
+            out[f"explode_find_{shape}_structural_recs_per_s"] = round(n / f, 1)
+            out[f"explode_find_{shape}_speedup"] = round(s / f, 3)
+            # parse-only split: the kernels alone, identical inputs
+            payloads, counts, p_off, p_len, _r, joined, _n = (
+                batch_codec._gather_payloads(batches)
+            )
+            ps = min_of_blocks(
+                lambda: lib.explode_find(joined, p_off, p_len, counts, paths)
+            )
+            pf = min_of_blocks(
+                lambda: lib.explode_find_structural(payloads, counts, paths, False)
+            )
+            out[f"explode_find_{shape}_parse_scalar_ms"] = round(ps * 1e3, 3)
+            out[f"explode_find_{shape}_parse_structural_ms"] = round(pf * 1e3, 3)
+    if "explode_find_stringified_speedup" in out:
+        # the gated number: the structural-index target shape
+        out["explode_find_speedup"] = out["explode_find_stringified_speedup"]
     return out
 
 
@@ -982,6 +1062,15 @@ def main(argv=None) -> int:
         "speedup over the padded path falls below RATIO (e.g. 1.33 = a "
         "25%% cut); implies the harvest_path bench",
     )
+    p.add_argument(
+        "--assert-explode-speedup",
+        type=float,
+        metavar="RATIO",
+        help="fail (exit 1) if the structural fused ladder's speedup over "
+        "the staged rp_explode_find ladder on the stringified-JSON shape "
+        "(the structural-index target shape) falls below RATIO (e.g. 2.0);"
+        " implies the explode_find bench",
+    )
     args = p.parse_args(argv)
     names = list(args.benches)
     if args.only:
@@ -1004,6 +1093,8 @@ def main(argv=None) -> int:
         names.append("breaker_overhead")
     if args.assert_harvest_speedup is not None and "harvest_path" not in names:
         names.append("harvest_path")
+    if args.assert_explode_speedup is not None and "explode_find" not in names:
+        names.append("explode_find")
     if args.assert_slo_overhead is not None and "slo_eval_overhead" not in names:
         names.append("slo_eval_overhead")
     if args.assert_governor_overhead is not None and "governor_overhead" not in names:
@@ -1094,6 +1185,15 @@ def main(argv=None) -> int:
             print(
                 f"harvest gather speedup {ratio}x below floor "
                 f"{args.assert_harvest_speedup}x",
+                file=sys.stderr,
+            )
+            return 1
+    if args.assert_explode_speedup is not None:
+        ratio = out.get("explode_find_speedup", 0.0)
+        if ratio < args.assert_explode_speedup:
+            print(
+                f"structural explode+find+extract speedup {ratio}x below "
+                f"floor {args.assert_explode_speedup}x",
                 file=sys.stderr,
             )
             return 1
