@@ -163,7 +163,7 @@ def _harvest_mode(stats: dict) -> str:
 
 def _run_engine_mode(
     req, force_mode: str | None, host_workers: int = HOST_WORKERS,
-    colcache_mb: int = 0,
+    colcache_mb: int = 0, **engine_kw,
 ) -> tuple[float, dict, list | None, dict]:
     """One measured engine run. force_mode None = the PRODUCT path (the
     engine's own measured device-vs-host probe picks where the predicate
@@ -181,6 +181,7 @@ def _run_engine_mode(
     engine = TpuEngine(
         row_stride=ROW_STRIDE, force_mode=force_mode,
         host_workers=host_workers, device_column_cache_mb=colcache_mb,
+        **engine_kw,
     )
     codes = engine.enable_coprocessors([(1, _spec().to_json(), ("bench",))])
     assert codes[0] == 0
@@ -222,6 +223,8 @@ def _run_engine_mode(
         "governor_posture": (stats.get("governor") or {}).get("posture"),
         "fallback_rows": stats.get("n_fallback_rows", 0.0),
         "device_retries": stats.get("n_retries", 0.0),
+        # multi-chip meshrunner block (absent on single-device engines)
+        "mesh": stats.get("mesh"),
     }
     shards = engine.last_launch_shards
     # a live harvester pins the engine (jit executables, staged arrays)
@@ -495,6 +498,116 @@ def run_harvest_passthrough(req) -> dict:
     return out
 
 
+def run_mesh_64p() -> dict:
+    """Config-5 promotion, MEASURED (the MULTICHIP_r06 artifact): the
+    64-partition JSON-filter workload through the mesh-sharded engine
+    (coproc/meshrunner.py — per-device sub-launches, one SPMD predicate
+    program over the partition axis) against the 1-device ablation, with
+    the A/A skew band applied to the delta. Bit-parity between the two
+    engines is ASSERTED on a live request here (the same contract the
+    test_meshrunner matrix pins), and the governor's mesh-domain journal
+    rides in the artifact so the mesh-vs-single decision is
+    reconstructible.
+
+    Caller must provide >= 2 devices on the cpu backend (``bench.py
+    mesh`` spawns this in a child with the host-platform device flag;
+    on real multi-chip hardware the mesh spans the actual chips)."""
+    from redpanda_tpu.coproc import TpuEngine
+    from redpanda_tpu.coproc import governor as gov_mod
+    from redpanda_tpu.coproc.meshrunner import available_devices
+
+    n_dev = len(available_devices("cpu"))
+    if n_dev < 2:
+        return {"skipped": True, "reason": f"need >= 2 devices, have {n_dev}"}
+    n_dev = min(8, n_dev)
+    req = _build_workload()
+    aa = _measure_aa_skew(req)
+    gov_mod.reset_journal()
+    # mesh lane pinned (mesh_probe=False): the 1-device run IS the
+    # ablation, so the config must measure the lane, not the probe's
+    # verdict about it — the probe's own measured verdict is reported
+    # separately by the headline bench's product path
+    TpuEngine.reset_columnar_probe()
+    mesh_rate, mesh_stages, _, mesh_probe = _run_engine_mode(
+        req, None, colcache_mb=32,
+        mesh_devices=n_dev, mesh_backend="cpu", mesh_probe=False,
+    )
+    TpuEngine.reset_columnar_probe()
+    one_rate, one_stages, _, _ = _run_engine_mode(req, None, colcache_mb=32)
+    # live bit-parity assertion between the two paths
+    TpuEngine.reset_columnar_probe()
+    em = TpuEngine(
+        row_stride=ROW_STRIDE, host_workers=HOST_WORKERS,
+        mesh_devices=n_dev, mesh_backend="cpu", mesh_probe=False,
+    )
+    e1 = TpuEngine(row_stride=ROW_STRIDE, host_workers=0)
+    for e in (em, e1):
+        assert e.enable_coprocessors([(1, _spec().to_json(), ("bench",))]) == [0]
+    pm = [
+        (it.script_id, [b.payload for b in it.batches])
+        for it in em.process_batch(req).items
+    ]
+    p1 = [
+        (it.script_id, [b.payload for b in it.batches])
+        for it in e1.process_batch(req).items
+    ]
+    em.shutdown()
+    e1.shutdown()
+    assert pm == p1, "mesh output diverged from the single-device path"
+    delta_pct = (mesh_rate - one_rate) / one_rate * 100.0 if one_rate else 0.0
+    verdict = (
+        "within-band"
+        if abs(delta_pct) <= aa["aa_skew_pct"]
+        else ("mesh-win" if delta_pct > 0 else "mesh-loss")
+    )
+    return {
+        "measured": True,
+        "dryrun": False,
+        "config": "mesh_64p",
+        "n_devices": n_dev,
+        "mesh_rb_s": round(mesh_rate, 1),
+        "ablation_1dev_rb_s": round(one_rate, 1),
+        "delta_pct": round(delta_pct, 1),
+        "aa_skew_pct": aa["aa_skew_pct"],
+        "aa_rates_rb_s": aa["aa_rates_rb_s"],
+        "verdict": verdict,
+        "parity": "bit-identical (asserted live; matrix in tests/test_meshrunner.py)",
+        "mesh": mesh_probe.get("mesh"),
+        "stages_mesh": mesh_stages,
+        "stages_1dev": one_stages,
+        "governor_journal_mesh": gov_mod.journal.entries(
+            domain=gov_mod.MESH
+        ),
+    }
+
+
+def main_mesh() -> None:
+    """``python bench.py mesh``: the measured multichip round (run under
+    the host-platform device flag; the MULTICHIP_r06 producer)."""
+    _pin_cpu()
+    from redpanda_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(8)
+    out = run_mesh_64p()
+    # the microbench gate on the same mesh: sharded CRC+vote step at
+    # 1/2/4/8 devices with the no-regression floor (see
+    # tools/microbench.py bench_mesh_scaling threshold guidance)
+    try:
+        from tools.microbench import bench_mesh_scaling
+
+        scaling = bench_mesh_scaling(1.0)
+        floor = float(os.environ.get("BENCH_MESH_SPEEDUP_FLOOR", "0.9"))
+        scaling["assert_mesh_speedup"] = {
+            "threshold": floor,
+            "speedup": scaling.get("mesh_speedup_best", 0.0),
+            "pass": scaling.get("mesh_speedup_best", 0.0) >= floor,
+        }
+        out["mesh_scaling"] = scaling
+    except Exception as exc:
+        out["mesh_scaling_error"] = repr(exc)
+    print(json.dumps(out))
+
+
 def run_link_profile() -> dict:
     """Quick device-link physics: sync RTT and H2D bandwidth (the numbers
     that justify columnar pushdown; full probe in tools/link_probe.py)."""
@@ -553,6 +666,26 @@ def main():
 
     extras = {}
     try:
+        # mesh_64p runs in a CHILD with the host-platform device flag:
+        # this process's jax backend is already initialized (possibly on
+        # the 1-chip tunnel), and the virtual multi-device mesh can only
+        # be requested before backend init
+        try:
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            flag = "--xla_force_host_platform_device_count=8"
+            if flag not in env.get("XLA_FLAGS", ""):
+                env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flag).strip()
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "mesh"],
+                capture_output=True, timeout=1800, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            extras["mesh_64p"] = json.loads(
+                child.stdout.decode().strip().splitlines()[-1]
+            )
+        except Exception as exc:
+            extras["mesh_64p"] = {"skipped": True, "error": repr(exc)}
         extras["harvest_passthrough_64p"] = run_harvest_passthrough(req)
         extras["config1_crc_validate"] = run_config1_crc_validate()
         extras["config2_lz4_produce"] = run_config2_lz4_produce()
@@ -679,4 +812,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "mesh":
+        main_mesh()
+    else:
+        main()

@@ -6,6 +6,8 @@ Parity with redpanda/admin_server.cc:
 - GET  /v1/brokers                     (broker membership view)
 - GET  /v1/partitions                  (local partition inventory)
 - POST /v1/raft/{group}/transfer_leadership             (:301)
+- GET  /v1/raft/heartbeat_acks         (config-5 batched ack tally + the
+  device plane's measured probe stats)
 - POST /v1/partitions/kafka/{t}/{p}/transfer_leadership (:486)
 - GET/POST/DELETE /v1/security/users   (:401-483 SCRAM CRUD)
 - GET  /v1/failure-probes, PUT /v1/failure-probes/{m}/{p}/{type}[?count=N]
@@ -128,6 +130,7 @@ class AdminServer:
             web.put("/v1/brokers/{node_id}/recommission", self._recommission),
             web.get("/v1/partitions", self._get_partitions),
             web.post("/v1/raft/{group}/transfer_leadership", self._raft_transfer),
+            web.get("/v1/raft/heartbeat_acks", self._raft_heartbeat_acks),
             web.post(
                 "/v1/partitions/kafka/{topic}/{partition}/transfer_leadership",
                 self._partition_transfer,
@@ -283,6 +286,26 @@ class AdminServer:
             return web.json_response({"error": f"unknown group {group}"}, status=404)
         ok = await c.do_transfer_leadership(target)
         return web.json_response({"success": bool(ok)})
+
+    async def _raft_heartbeat_acks(self, req: web.Request) -> web.Response:
+        """Per-group ack counts from the last heartbeat tick's batched
+        tally (BASELINE config 5 vote half, ``raft_device_vote_tally``)
+        plus the device plane's measured host-vs-device probe stats —
+        the operator's view of whether the batched reduction runs and
+        where."""
+        from redpanda_tpu.raft import device_plane
+
+        acks = {}
+        if self.gm is not None:
+            acks = {
+                str(g): n
+                for g, n in self.gm.heartbeats.last_tick_acks.items()
+            }
+        return web.json_response({
+            "enabled": device_plane.vote_tally_enabled(),
+            "last_tick_acks": acks,
+            "plane": device_plane.default_plane().stats(),
+        })
 
     async def _partition_transfer(self, req: web.Request) -> web.Response:
         if self.gm is None:
@@ -559,6 +582,11 @@ class AdminServer:
             "enabled": True,
             "scripts": api.active_scripts(),
             "breaker": stats.pop("breaker", None),
+            # multi-chip meshrunner block surfaced explicitly (devices,
+            # mesh-vs-single decision + probe, per-device rows, demotions)
+            # so `rpk debug coproc` renders it without digging in stats;
+            # popped like breaker so the stats dump doesn't repeat it
+            "mesh": stats.pop("mesh", None),
             "stats": stats,
         })
 

@@ -243,6 +243,19 @@ class Application:
             ),
             recovery_concurrency=c.raft_recovery_concurrency,
         )
+        # raft device plane (BASELINE config 5): batched follower CRC
+        # validation + per-tick cross-group ack tally, both behind their
+        # own measured host-vs-device probe (raft/device_plane.py)
+        from redpanda_tpu.raft import device_plane as raft_device_plane
+
+        raft_device_plane.configure(
+            crc_validate=getattr(c, "raft_device_crc_validate", False),
+            vote_tally=getattr(c, "raft_device_vote_tally", False),
+            # the plane shares the coproc engine's multi-chip topology:
+            # >= 2 devices gives the sharded crc+vote step the psum lane
+            mesh_devices=getattr(c, "coproc_mesh_devices", 0),
+            mesh_backend=getattr(c, "coproc_mesh_backend", "") or None,
+        )
         self.controller = Controller(self_vnode, self.group_manager, self.connections)
         # One topic table per node: the controller STM's replicated view IS
         # the broker's view (topic_table.h — metadata_cache aggregates the

@@ -424,6 +424,15 @@ async def cmd_debug(args) -> int:
             f"/{b.get('threshold', '?')} cooldown={b.get('cooldown_ms', '?')}ms"
         )
         print(f"scripts: {', '.join(body.get('scripts') or []) or '(none)'}")
+        mesh = body.get("mesh")
+        if mesh:
+            print(
+                f"mesh:    {mesh.get('devices', '?')} devices, "
+                f"decision={mesh.get('decision')}, "
+                f"launches={mesh.get('launches', 0)}, "
+                f"demotions={mesh.get('demotions', 0)}, "
+                f"rows_per_device={mesh.get('rows_per_device')}"
+            )
         stats = body.get("stats") or {}
         shown = {
             k: v for k, v in sorted(stats.items())

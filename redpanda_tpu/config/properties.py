@@ -168,6 +168,35 @@ PROPERTIES: list[Property] = [
         "LRU byte budget for the device-resident column cache (repeat scripts over unchanged batch windows skip the host parse/extract ladder and the H2D replay); 0 disables it",
         32, int, _non_negative,
     ),
+    # --- coproc multi-chip mesh (coproc/meshrunner.py)
+    Property(
+        "coproc_mesh_devices",
+        "Shard the coproc partition axis over an N-device mesh (pjit/shard_map; per-device sub-launches, one SPMD predicate program). 0/1 keeps the single-device engine; clamped to the devices actually present",
+        0, int, _non_negative,
+    ),
+    Property(
+        "coproc_mesh_backend",
+        "jax backend whose devices the mesh spans ('' = default backend; 'cpu' = the virtual host-platform mesh, for forced-multi-device runs)",
+        "",
+    ),
+    Property(
+        "coproc_mesh_probe",
+        "Measure mesh-vs-single-device on the first representative launch and pin the winner (PROBE_MARGIN posture, journaled in the governor 'mesh' domain); false pins 'mesh' unmeasured",
+        True, bool,
+    ),
+    # --- raft device plane (raft/device_plane.py, BASELINE config 5);
+    # the plane spans the coproc mesh topology (coproc_mesh_devices /
+    # coproc_mesh_backend >= 2 devices = the sharded crc+vote psum step)
+    Property(
+        "raft_device_crc_validate",
+        "Follower-side batched CRC validation of every append_entries blob in one kernel call (the device plane's measured probe picks host or device; both bit-exact). Off = appends are not CRC-checked on the follower (the historical posture)",
+        False, bool,
+    ),
+    Property(
+        "raft_device_vote_tally",
+        "Per-tick cross-group heartbeat ack tally as one batched reduction (mesh psum on multi-chip, np.sum on host) feeding HeartbeatManager.last_tick_acks; off = no tally",
+        False, bool,
+    ),
     # --- coproc fault domains (coproc/faults.py)
     Property(
         "coproc_device_deadline_ms",

@@ -55,6 +55,9 @@ class CoprocApi:
             device_column_cache_mb=_knob(
                 "coproc_device_column_cache_mb", 32
             ),
+            mesh_devices=_knob("coproc_mesh_devices", 0) or None,
+            mesh_backend=_knob("coproc_mesh_backend", "") or None,
+            mesh_probe=_knob("coproc_mesh_probe", True),
             device_deadline_ms=_knob("coproc_device_deadline_ms", None),
             launch_retries=_knob("coproc_launch_retries", None),
             retry_backoff_ms=_knob("coproc_retry_backoff_ms", None),

@@ -225,6 +225,62 @@ def explode_find_structural(
                            types, vs, ve)
 
 
+@dataclass
+class PtrExploded:
+    """Pointer-table explode for the payload staging lane (ROADMAP item 1
+    follow-on b): the decompressed per-batch payload buffers are retained
+    and record (offset, len) stay RELATIVE to their own buffer, so
+    staging packs straight from each buffer — the joined blob (and its
+    b"".join copy, plus _pack_staged's second cache-cold read of it)
+    never exists."""
+
+    payloads: list[bytes]
+    rel_off: list[np.ndarray]  # int64 per batch, relative to its payload
+    rel_len: list[np.ndarray]  # int32 per batch (raw; -1 for null values)
+    sizes: np.ndarray  # int32 [N] launch-wide, clamped >= 0
+    ranges: list[tuple[int, int]]  # per input batch: [start, end) in N
+
+
+def explode_ptrs(batches: list[RecordBatch]) -> PtrExploded | None:
+    """Explode a batch list WITHOUT building the joined blob. Returns
+    None when the native packer is unavailable — the classic joined-blob
+    lane is the fallback and the parity oracle."""
+    lib = _native()
+    if lib is None:
+        # rp_pack_rows is a mandatory symbol — a .so without it fails
+        # _NativeLib binding entirely, so lib None IS the "packer
+        # unavailable" case
+        return None
+    payloads: list[bytes] = []
+    rel_off: list[np.ndarray] = []
+    rel_len: list[np.ndarray] = []
+    sizes_parts: list[np.ndarray] = []
+    ranges: list[tuple[int, int]] = []
+    n = 0
+    for b in batches:
+        payload = b.payload
+        if b.header.compression != Compression.none:
+            payload = uncompress(payload, b.header.compression)
+        count = b.header.record_count
+        if count:
+            off, ln = lib.parse_record_values(payload, count)
+        else:
+            off = np.zeros(0, np.int64)
+            ln = np.zeros(0, np.int32)
+        payloads.append(payload)
+        rel_off.append(off)
+        rel_len.append(ln)
+        sizes_parts.append(np.maximum(ln, 0))
+        ranges.append((n, n + count))
+        n += count
+    sizes = (
+        np.concatenate(sizes_parts).astype(np.int32)
+        if sizes_parts
+        else np.zeros(0, np.int32)
+    )
+    return PtrExploded(payloads, rel_off, rel_len, sizes, ranges)
+
+
 def merge_exploded(parts: list[ExplodedBatches]) -> ExplodedBatches:
     """Concatenate per-shard explode results into one launch-wide table.
 

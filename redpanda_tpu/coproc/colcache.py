@@ -30,13 +30,6 @@ from collections import OrderedDict
 
 from redpanda_tpu.hashing.xx import xxhash64
 
-# how many recently-missed keys to remember: a key missing TWICE signals a
-# repeating workload, and the engine then routes that launch inline (not
-# sharded) so the cache can be populated — one slightly-slower launch buys
-# every later identical launch a full-ladder skip
-_RECENT_MISS_KEYS = 64
-
-
 def fingerprint(batches) -> int:
     """Content fingerprint of a batch list. The per-batch tuple (payload
     CRC, base offset, record count, payload length, attrs) pins both the
@@ -114,47 +107,31 @@ class DeviceColumnCache:
         )
         self._budget = max(0, int(budget_bytes))
         self._entries: "OrderedDict[tuple, Entry]" = OrderedDict()
-        self._recent_misses: "OrderedDict[tuple, None]" = OrderedDict()
-        # keys whose entries the budget refused: their launches must NOT
-        # keep self-routing inline to "populate" a cache that can never
-        # hold them — they shard normally like any uncached launch
-        self._uncacheable: "OrderedDict[tuple, None]" = OrderedDict()
         self._bytes = 0
         self._hits = 0
         self._misses = 0
         self._evictions = 0
         self._invalidations = 0
 
-    def lookup(self, key: tuple):
-        """(entry | None, repeat_miss). A hit refreshes LRU order; a miss
-        is remembered so the engine can recognize a repeating workload
-        (repeat_miss=True) and populate the cache on this launch."""
+    def lookup(self, key: tuple) -> Entry | None:
+        """The cached entry (refreshing LRU order) or None. Misses carry
+        no side state: since the sharded path populates per shard, every
+        miss — inline or sharded — populates on the SAME launch, so
+        nothing needs to recognize a repeating workload anymore."""
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
                 self._entries.move_to_end(key)
                 self._hits += 1
-                return entry, False
+                return entry
             self._misses += 1
-            repeat = (
-                key in self._recent_misses
-                and key not in self._uncacheable
-            )
-            self._recent_misses[key] = None
-            self._recent_misses.move_to_end(key)
-            while len(self._recent_misses) > _RECENT_MISS_KEYS:
-                self._recent_misses.popitem(last=False)
-            return None, repeat
+            return None
 
     def put(self, key: tuple, entry: Entry) -> bool:
         """Insert + evict LRU down to the budget. An entry bigger than
         the whole budget is refused outright (storing it would evict
         everything for a guaranteed-evicted tenant)."""
         if entry.nbytes > self._budget:
-            with self._lock:
-                self._uncacheable[key] = None
-                while len(self._uncacheable) > _RECENT_MISS_KEYS:
-                    self._uncacheable.popitem(last=False)
             return False
         with self._lock:
             old = self._entries.pop(key, None)
@@ -162,8 +139,6 @@ class DeviceColumnCache:
                 self._bytes -= old.nbytes
             self._entries[key] = entry
             self._bytes += entry.nbytes
-            self._recent_misses.pop(key, None)
-            self._uncacheable.pop(key, None)
             while self._bytes > self._budget and len(self._entries) > 1:
                 _, evicted = self._entries.popitem(last=False)
                 self._bytes -= evicted.nbytes
@@ -185,17 +160,11 @@ class DeviceColumnCache:
             if script_id is None:
                 dropped = len(self._entries)
                 self._entries.clear()
-                self._recent_misses.clear()
-                self._uncacheable.clear()
                 self._bytes = 0
             else:
                 keys = [k for k in self._entries if k[0] == script_id]
                 for k in keys:
                     self._bytes -= self._entries.pop(k).nbytes
-                for k in [
-                    k for k in self._recent_misses if k[0] == script_id
-                ]:
-                    self._recent_misses.pop(k, None)
                 dropped = len(keys)
             self._invalidations += dropped
         return dropped
@@ -204,8 +173,6 @@ class DeviceColumnCache:
         """Test hook: drop entries AND zero the counters."""
         with self._lock:
             self._entries.clear()
-            self._recent_misses.clear()
-            self._uncacheable.clear()
             self._bytes = 0
             self._hits = self._misses = 0
             self._evictions = self._invalidations = 0

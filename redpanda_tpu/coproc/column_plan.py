@@ -231,6 +231,61 @@ class ColumnarPlan:
         self._fn_cache[key] = fn
         return fn
 
+    def compile_device_stacked(self, mesh):
+        """shard_map'd twin of compile_device for the meshrunner: every
+        input is a per-device STACK [D, n_pad, ...] sharded over the
+        mesh's 'p' axis, output is packed keep bits [D, n_pad//8] with
+        the same sharding. Each device evaluates its own [n_pad] block of
+        the SAME predicate tree, so bit (d, i) is identical to what
+        compile_device over device d's rows alone would produce — the
+        mesh-vs-single parity contract."""
+        key = ("stacked", id(mesh))
+        fn = self._fn_cache.get(key)
+        if fn is not None:
+            return fn
+        with self._fn_lock:
+            fn = self._fn_cache.get(key)
+            if fn is not None:
+                return fn
+            return self._compile_stacked_locked(key, mesh)
+
+    def _compile_stacked_locked(self, key, mesh):
+        import jax
+        import jax.numpy as jnp
+
+        try:  # jax >= 0.5 exports shard_map at top level
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec
+
+        from redpanda_tpu.parallel.mesh import PARTITION_AXIS
+
+        expr = self.spec.where
+        consts = _prepare_cmp_consts(expr)
+        plan = self
+
+        def _local(*arrays):
+            # per-device block: [1, n_pad, ...] -> strip the device dim,
+            # evaluate the shared predicate tree, re-add it for out_specs
+            flat = [a[0] for a in arrays]
+            keep = _build_expr(jnp, expr, plan._bind_slots(flat), consts)
+            return _packbits(jnp, keep)[None, :]
+
+        in_specs = []
+        for c in self.dev_cols:
+            in_specs += [PartitionSpec(PARTITION_AXIS)] * _COL_ARITY[c.kind]
+        fn = jax.jit(
+            shard_map(
+                _local,
+                mesh=mesh,
+                in_specs=tuple(in_specs),
+                out_specs=PartitionSpec(PARTITION_AXIS),
+            )
+        )
+        self._fn_cache[key] = fn
+        return fn
+
     def eval_host_mask(self, cols) -> np.ndarray:
         """ABLATION twin of compile_device: the SAME predicate tree over the
         SAME extracted columns, evaluated in numpy on the host — packed keep
@@ -259,6 +314,29 @@ class ColumnarPlan:
                 out += [f32, i32, fl]
             else:
                 out.append(_extract_exists(joined, offsets, sizes, c.path, n_pad, cache))
+        return out
+
+    def zero_device_inputs(self, n_pad: int) -> list:
+        """All-padding device inputs — the dtypes/shapes/arity of
+        extract_device_inputs with zero records (str validity -1 =
+        absent). Keeps the per-kind array layout in ONE place: an empty
+        mesh device shard stacks these so the SPMD input keeps one shape
+        regardless of shard occupancy."""
+        out = []
+        for c in self.dev_cols:
+            if c.kind == "str":
+                out += [
+                    np.zeros((n_pad, c.w), np.uint8),
+                    np.full(n_pad, -1, np.int32),
+                ]
+            elif c.kind == "num":
+                out += [
+                    np.zeros(n_pad, np.float32),
+                    np.zeros(n_pad, np.int32),
+                    np.zeros(n_pad, np.uint8),
+                ]
+            else:
+                out.append(np.zeros(n_pad, np.uint8))
         return out
 
     def extract_projection(self, joined, offsets, sizes, cache=None):

@@ -58,7 +58,15 @@ from redpanda_tpu.ops.pipeline import IN_META, make_packed_pipeline, unpack_resu
 
 logger = logging.getLogger("rptpu.coproc.engine")
 from redpanda_tpu.ops.transforms import TransformSpec
-from redpanda_tpu.coproc import batch_codec, colcache, faults, governor, host_pool, lockwatch
+from redpanda_tpu.coproc import (
+    batch_codec,
+    colcache,
+    faults,
+    governor,
+    host_pool,
+    lockwatch,
+    meshrunner,
+)
 from redpanda_tpu.coproc.column_plan import ColumnarPlan, HostPlan, PayloadPlan, plan_spec
 
 
@@ -714,6 +722,22 @@ def _pack_values(ex, stride: int):
     return rows, sizes
 
 
+def _fit_cols(cols, n_pad: int) -> list:
+    """Pad/trim host predicate columns to a row bucket. Rows beyond the
+    shard's real record count are padding whose predicate bits are
+    discarded ([:n] at unpack), so zero-fill is always safe."""
+    out = []
+    for a in cols:
+        if len(a) == n_pad:
+            out.append(a)
+        elif len(a) > n_pad:
+            out.append(a[:n_pad])
+        else:
+            pad = np.zeros((n_pad - len(a),) + a.shape[1:], dtype=a.dtype)
+            out.append(np.concatenate([a, pad]))
+    return out
+
+
 def _explode_shard(batches):
     """One payload/host-plan explode shard on a pool worker (the
     shard_worker fault domain covers every dispatch-side worker body)."""
@@ -893,6 +917,9 @@ class TpuEngine:
         structural_parse: bool | None = None,
         structural_probe: bool = True,
         device_column_cache_mb: int | None = None,
+        mesh_devices: int | None = None,
+        mesh_backend: str | None = None,
+        mesh_probe: bool = True,
         device_deadline_ms: int | None = None,
         launch_retries: int | None = None,
         retry_backoff_ms: int | None = None,
@@ -1066,6 +1093,32 @@ class TpuEngine:
         )
         self.governor.update_config_snapshot(
             device_column_cache_mb=_cache_mb
+        )
+        # Multi-chip sharded engine (coproc/meshrunner.py): the partition
+        # axis pjit/shard_map-sharded over an N-device mesh, per-device
+        # sub-launches over the host-pool range shard. None/0/1 keeps the
+        # single-device engine (config coproc_mesh_devices wires the
+        # broker knob). mesh_probe=False pins "mesh" unmeasured — parity
+        # tests and bench ablations need the mesh lane deterministically;
+        # True runs the measured mesh-vs-single calibration on the first
+        # representative launch (PROBE_MARGIN posture, journaled).
+        self._meshrunner: meshrunner.MeshRunner | None = None
+        if mesh_devices is not None and int(mesh_devices) >= 2:
+            try:
+                self._meshrunner = meshrunner.MeshRunner(
+                    n_devices=int(mesh_devices), backend=mesh_backend,
+                    probe=mesh_probe,
+                )
+            except Exception as exc:
+                # fewer devices than asked for (or no jax backend): the
+                # engine runs single-device; classified so the demotion
+                # is visible on /metrics rather than silent
+                faults.note_failure("mesh_init", exc)
+                logger.warning("meshrunner unavailable: %s", exc)
+        self.governor.update_config_snapshot(
+            mesh_devices=(
+                self._meshrunner.n_devices if self._meshrunner else 0
+            )
         )
         # per-shard stage splits of the most recent sharded launch (bench
         # artifact + debugging aid; overwritten per launch under the lock)
@@ -1346,6 +1399,8 @@ class TpuEngine:
                 out["parse_probe"] = dict(self._parse_probe)
         if self._colcache is not None:
             out["colcache"] = self._colcache.stats()
+        if self._meshrunner is not None:
+            out["mesh"] = self._meshrunner.stats()
         if self._host_pool_probe is not None:
             out["host_pool_probe"] = dict(self._host_pool_probe)
         if self._host_pool_probe_prev is not None:
@@ -1630,17 +1685,25 @@ class TpuEngine:
         launch.mode = plan.mode
         launch._plan = plan
         all_batches = [b for _, _, item in entries for b in item.batches]
+        # Multi-chip lane (coproc/meshrunner.py): partition axis sharded
+        # over the device mesh, per-device sub-launches, ONE SPMD
+        # predicate program. Declines (single-device decision, open mesh
+        # breaker, small launch) fall through to the standard path —
+        # output is bit-identical either way, which is what the
+        # test_meshrunner parity matrix pins.
+        if plan.mode == "columnar" and self._meshrunner is not None:
+            if self._dispatch_mesh(launch, plan, all_batches):
+                return
         # Device-resident column cache: a repeat launch over an unchanged
         # batch window skips the WHOLE host ladder (decompress, parse,
         # find, extract) and — when the predicate ran on-device — the H2D
         # replay (the cached cols are device-resident). The key is
         # content-addressed (colcache.fingerprint), so an append produces
-        # a clean miss by construction; a key missing twice marks a
-        # repeating workload and this launch dispatches inline to
-        # POPULATE the cache (one slightly slower launch buys every later
-        # identical one a full skip).
+        # a clean miss by construction. Sharded launches consult and
+        # populate the cache PER SHARD inside their workers (the old
+        # second-miss inline self-route is gone), so this launch-wide
+        # lookup serves the inline path and full-launch repeat windows.
         store_key = None
-        skip_shard = False
         if (
             plan.mode == "columnar"
             and self._colcache is not None
@@ -1648,17 +1711,14 @@ class TpuEngine:
             and all_batches
         ):
             key = (script_id, colcache.fingerprint(all_batches))
-            entry, repeat_miss = self._colcache.lookup(key)
+            entry = self._colcache.lookup(key)
             if entry is not None:
-                self._stat_add("n_colcache_hit", 1.0)
-                probes.coproc_colcache_hits.inc()
+                self._count_colcache(True)
                 self._dispatch_columnar_cached(launch, plan, entry)
                 return
-            self._stat_add("n_colcache_miss", 1.0)
-            probes.coproc_colcache_misses.inc()
+            self._count_colcache(False)
             store_key = key
-            skip_shard = repeat_miss
-        if not skip_shard and self._dispatch_sharded(launch, plan, all_batches):
+        if self._dispatch_sharded(launch, plan, all_batches):
             return
         # decide the parse ladder BEFORE the stage timer starts: the first
         # representative launch runs the fused-vs-staged calibration here,
@@ -1706,6 +1766,26 @@ class TpuEngine:
                 exploded = batch_codec.explode_batches(all_batches)
                 self._stat_add("t_explode", time.perf_counter() - t0)
         else:
+            if plan.mode == "payload":
+                # POINTER-TABLE staging lane (ROADMAP item 1 follow-on b):
+                # record (offset, len) parse straight off the decompressed
+                # per-batch payload buffers and staging packs from the
+                # same buffers — the joined blob (and its b"".join copy,
+                # plus _pack_staged's second cache-cold pass over it)
+                # never exists. Bit-identical to the classic lane (the
+                # _pack_staged parity test pins it).
+                pe = batch_codec.explode_ptrs(all_batches)
+                if pe is not None:
+                    self._stat_add("t_explode_ptrs", time.perf_counter() - t0)
+                    launch.ranges = pe.ranges
+                    n = len(pe.sizes)
+                    launch.n = n
+                    self._stat_add("n_records", n)
+                    self._stat_add("n_launches", 1)
+                    with self._stats_lock:
+                        probes.coproc_launch_rows_hist.record(n)
+                    self._dispatch_payload_ptrs(launch, pe, n)
+                    return
             exploded = batch_codec.explode_batches(all_batches)
             self._stat_add("t_explode", time.perf_counter() - t0)
         launch.ranges = exploded.ranges
@@ -2090,24 +2170,66 @@ class TpuEngine:
                     probes.coproc_shard_rows_hist.record(sum(counts[s:e]))
         return True
 
-    def _run_columnar_shard(
-        self, idx: int, launch: _Launch, plan: ColumnarPlan, batches, paths,
-        use_host, structural: bool = False,
-    ) -> _HostShard:
-        """One shard's dispatch-side host stages, on a pool worker: explode
-        + find, predicate column extraction, predicate dispatch (the shard's
-        own device launch or numpy eval — issued as soon as THIS shard's
-        columns land, overlapping later shards' extraction), projection
-        extraction. ``structural`` runs the shard through the fused
-        structural ladder instead (one parse crossing + one extraction
-        crossing — same outputs, the engine-level decision is per launch).
-        Touches only its own shard (SHD6xx)."""
-        shard = _HostShard()
-        t_shard0 = time.perf_counter()
-        # shard-worker fault domain: a fault here (injected or real) fails
-        # the fan-out, and _dispatch_sharded degrades the LAUNCH to the
-        # inline path — stages re-execute launch-wide with exact output
-        faults.inject(faults.SHARD_WORKER)
+    def _count_colcache(self, hit: bool) -> None:
+        if hit:
+            self._stat_add("n_colcache_hit", 1.0)
+            probes.coproc_colcache_hits.inc()
+        else:
+            self._stat_add("n_colcache_miss", 1.0)
+            probes.coproc_colcache_misses.inc()
+
+    def _shard_cache_key(self, script_id: int, batches) -> tuple | None:
+        """Per-shard column-cache key (cross-launch cache for the sharded
+        path, ROADMAP item 1 follow-on c): the SAME content fingerprint as
+        the launch-wide key, over the shard's batch slice. Contiguous
+        range shards of a repeating launch produce identical slices, so
+        every shard of the second identical launch hits."""
+        if self._colcache is None or not batches:
+            return None
+        return (script_id, colcache.fingerprint(batches))
+
+    def _shard_cache_entry(
+        self, shard: _HostShard, plan: ColumnarPlan, cols, n_pad: int,
+        structural: bool,
+    ) -> "colcache.Entry":
+        """The per-shard cache entry for a just-run ladder — ONE builder
+        so the mesh and standard sharded paths can never cache divergent
+        contents for the same shard."""
+        return colcache.Entry(
+            n=shard.n, n_pad=n_pad, ranges=shard.ranges, cols=cols,
+            proj_data=shard.proj_data, proj_ok=shard.proj_ok,
+            exploded=shard.exploded if plan.passthrough else None,
+            parse_mode="structural" if structural else "staged",
+        )
+
+    def _shard_from_entry(
+        self, shard: _HostShard, plan: ColumnarPlan, entry, n_pad: int
+    ):
+        """Fill a _HostShard from a cached per-shard entry (skips the
+        whole host ladder) and return host predicate columns fitted to
+        ``n_pad`` (entries cached under a different launch's row bucket
+        pad/trim to this launch's — padding rows' bits are discarded, so
+        the fit never changes output)."""
+        shard.n = entry.n
+        shard.ranges = list(entry.ranges)
+        if plan.passthrough:
+            shard.exploded = entry.exploded
+            shard.proj_ok = np.ones(entry.n, dtype=bool)
+        else:
+            shard.proj_data = entry.proj_data
+            shard.proj_ok = entry.proj_ok
+        return _fit_cols(entry.cols, n_pad)
+
+    def _shard_ladder(
+        self, shard: _HostShard, plan: ColumnarPlan, batches, paths,
+        structural: bool, n_pad: int | None = None,
+    ):
+        """One shard's host parse/extract ladder (no predicate dispatch):
+        explode + find (structural fused or staged), predicate column
+        extraction, projection extraction. Fills ``shard`` and returns
+        (cols, n_pad). ``n_pad`` pins the row bucket (the mesh path needs
+        one COMMON bucket across every device shard so the stacked SPMD
+        input has one shape); None buckets per shard."""
 
         def stage(key: str, t0: float) -> None:
             dt = time.perf_counter() - t0
@@ -2121,7 +2243,6 @@ class TpuEngine:
         t0 = time.perf_counter()
         cache = None
         cols = None
-        n_pad = 0
         fused_proj = None  # (proj_data, proj_ok) from the fused lane
         sp = (
             batch_codec.explode_find_structural(
@@ -2137,12 +2258,13 @@ class TpuEngine:
             shard.n = n
             if n == 0:
                 shard.proj_ok = np.zeros(0, dtype=bool)
-                return shard
+                return None, n_pad or 0
             # passthrough framing gathers from the joined blob the fused
             # crossing built; projection shards never need raw bytes again
             shard.exploded = sp.exploded() if plan.byte_identity else None
             t0 = time.perf_counter()
-            n_pad = _bucket_rows(n)
+            if n_pad is None:
+                n_pad = _bucket_rows(n)
             cols, proj_data, proj_ok = plan.extract_fused(sp, n_pad)
             stage("t_fused_extract", t0)
             fused_proj = (proj_data, proj_ok)
@@ -2163,55 +2285,19 @@ class TpuEngine:
             shard.n = n
             if n == 0:
                 shard.proj_ok = np.zeros(0, dtype=bool)
-                return shard
+                return None, n_pad or 0
             if cache is None:
                 t0 = time.perf_counter()
                 cache = plan.build_find_cache(ex.joined, ex.offsets, ex.sizes)
                 stage("t_find", t0)
             if plan.dev_cols:
                 t0 = time.perf_counter()
-                n_pad = _bucket_rows(n)
+                if n_pad is None:
+                    n_pad = _bucket_rows(n)
                 cols = plan.extract_device_inputs(
                     ex.joined, ex.offsets, ex.sizes, n_pad, cache
                 )
                 stage("t_extract_pred", t0)
-        if cols is not None:
-            slot = _MaskSlot(n)
-            slot.trace_id = launch.trace_id
-            t0 = time.perf_counter()
-            if use_host:
-                slot._mask_np = plan.eval_host_mask(cols)
-                stage("t_dispatch", t0)
-            else:
-                def leg():
-                    faults.inject(faults.DEVICE_DISPATCH)
-                    fn = plan.compile_device(None)
-                    mask = fn(*cols)
-                    mask.copy_to_host_async()
-                    return mask
-
-                mask = self._try_device_leg(faults.DEVICE_DISPATCH, leg)
-                if mask is None:
-                    # this shard's exact host fallback; sibling shards keep
-                    # their own device launches
-                    slot._mask_np = plan.eval_host_mask(cols)
-                    stage("t_dispatch", t0)
-                    self._count_fallback(n)
-                else:
-                    self._breaker.record_success()  # dispatch-domain verdict
-                    stage("t_dispatch", t0)
-                    self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
-                    self._stat_add("bytes_d2h", n_pad // 8)
-                    slot._mask_dev = mask
-                    slot._cols = cols
-                    slot._mask_event = threading.Event()
-                    slot._mask_state = "queued"
-                    with launch._lock:
-                        launch._pending_slots.append(slot)
-                    self._ensure_harvester()
-                    slot._enq_t = time.perf_counter()
-                    self._harvest_q.put(slot)
-            shard.mask = slot
         if plan.passthrough:
             shard.proj_ok = np.ones(n, dtype=bool)
         elif fused_proj is not None:
@@ -2226,6 +2312,114 @@ class TpuEngine:
             shard.proj_ok = ok
             shard.exploded = None  # framing reads proj_data, not raw records
             stage("t_extract_proj", t0)
+        return cols, (n_pad or 0)
+
+    def _run_columnar_shard(
+        self, idx: int, launch: _Launch, plan: ColumnarPlan, batches, paths,
+        use_host, structural: bool = False,
+    ) -> _HostShard:
+        """One shard's dispatch-side host stages, on a pool worker:
+        per-shard column-cache consult (a hit skips the whole ladder),
+        explode + find, predicate column extraction, predicate dispatch
+        (the shard's own device launch or numpy eval — issued as soon as
+        THIS shard's columns land, overlapping later shards' extraction),
+        projection extraction, cache populate. ``structural`` runs the
+        shard through the fused structural ladder instead (same outputs;
+        the engine-level decision is per launch). Touches only its own
+        shard (SHD6xx)."""
+        shard = _HostShard()
+        t_shard0 = time.perf_counter()
+        # shard-worker fault domain: a fault here (injected or real) fails
+        # the fan-out, and _dispatch_sharded degrades the LAUNCH to the
+        # inline path — stages re-execute launch-wide with exact output
+        faults.inject(faults.SHARD_WORKER)
+        key = self._shard_cache_key(launch.script_id, batches)
+        entry = None
+        dev_cols = None
+        store_entry = None
+        if key is not None:
+            entry = self._colcache.lookup(key)
+            self._count_colcache(entry is not None)
+        if entry is not None:
+            n_pad = _bucket_rows(entry.n) if entry.n else 0
+            cols = self._shard_from_entry(shard, plan, entry, n_pad)
+            if entry.cols_dev is not None and entry.n_pad == n_pad:
+                dev_cols = entry.cols_dev
+        else:
+            cols, n_pad = self._shard_ladder(
+                shard, plan, batches, paths, structural
+            )
+            if key is not None and shard.n and cols is not None:
+                store_entry = self._shard_cache_entry(
+                    shard, plan, cols, n_pad, structural
+                )
+        n = shard.n
+        if n == 0:
+            return shard
+        if cols is not None:
+            slot = _MaskSlot(n)
+            slot.trace_id = launch.trace_id
+            t0 = time.perf_counter()
+            if use_host:
+                slot._mask_np = plan.eval_host_mask(cols)
+                dt = time.perf_counter() - t0
+                self._stat_add("t_shard_dispatch", dt)
+                shard.stages["t_dispatch"] = round(
+                    shard.stages.get("t_dispatch", 0.0) + dt, 6
+                )
+            else:
+                def leg():
+                    faults.inject(faults.DEVICE_DISPATCH)
+                    fn = plan.compile_device(None)
+                    args = dev_cols
+                    if args is None:
+                        if store_entry is not None:
+                            # explicit device_put so the shard's cache
+                            # entry owns committed device arrays — later
+                            # hits launch with zero H2D (the PR-11 device
+                            # residency, now per shard)
+                            import jax
+
+                            args = [jax.device_put(c) for c in cols]
+                            store_entry.cols_dev = args
+                        else:
+                            args = cols
+                    mask = fn(*args)
+                    mask.copy_to_host_async()
+                    return mask
+
+                mask = self._try_device_leg(faults.DEVICE_DISPATCH, leg)
+                dt = time.perf_counter() - t0
+                self._stat_add("t_shard_dispatch", dt)
+                shard.stages["t_dispatch"] = round(
+                    shard.stages.get("t_dispatch", 0.0) + dt, 6
+                )
+                if mask is None:
+                    # this shard's exact host fallback; sibling shards keep
+                    # their own device launches
+                    slot._mask_np = plan.eval_host_mask(cols)
+                    self._count_fallback(n)
+                else:
+                    self._breaker.record_success()  # dispatch-domain verdict
+                    if dev_cols is None:
+                        self._stat_add(
+                            "bytes_h2d", sum(c.nbytes for c in cols)
+                        )
+                    self._stat_add("bytes_d2h", n_pad // 8)
+                    slot._mask_dev = mask
+                    slot._cols = cols
+                    slot._mask_event = threading.Event()
+                    slot._mask_state = "queued"
+                    with launch._lock:
+                        launch._pending_slots.append(slot)
+                    self._ensure_harvester()
+                    slot._enq_t = time.perf_counter()
+                    self._harvest_q.put(slot)
+            shard.mask = slot
+        if store_entry is not None:
+            # put AFTER the dispatch leg so a populated entry carries its
+            # device-resident twins when the device path is live
+            self._colcache.put(key, store_entry)
         tracer.record(
             "coproc.shard",
             (time.perf_counter() - t_shard0) * 1e6,
@@ -2236,9 +2430,260 @@ class TpuEngine:
         )
         return shard
 
-    def _dispatch_payload(self, launch: _Launch, exploded, n: int) -> None:
-        import jax
+    # ------------------------------------------------------ mesh dispatch
+    def _dispatch_mesh(self, launch: _Launch, plan, all_batches) -> bool:
+        """The multi-chip lane (coproc/meshrunner.py): per-device
+        sub-launches over the host-pool range shard, the predicate as ONE
+        SPMD program over stacked [D, n_pad, ...] columns sharded on the
+        mesh's partition axis, per-shard column-cache consult/populate.
 
+        Returns False to send the launch down the standard single-device
+        path: not mesh-eligible, sticky "single" decision, open
+        mesh_dispatch breaker, or a launch too small to be worth an SPMD
+        program. A mesh device-leg failure demotes THIS launch to the
+        exact numpy predicate per shard — bit-identical output, and the
+        breaker verdict routes later launches to the single-device path
+        until the half-open probe re-admits the mesh."""
+        runner = self._meshrunner
+        if (
+            runner is None
+            or plan.mode != "columnar"
+            or not plan.dev_cols
+            or self._force_mode == "columnar_host"
+            or self._mesh is not None
+        ):
+            return False
+        decision = runner.decision
+        if decision == "single":
+            return False
+        counts = [b.header.record_count for b in all_batches]
+        n = sum(counts)
+        if n == 0 or len(all_batches) < 2:
+            return False
+        if decision is None and n < meshrunner.PROBE_MIN_ROWS:
+            return False  # too small to probe on; single, without pinning
+        if decision is None and runner.probe_lock_busy:
+            # a sibling launch is mid-calibration (seconds of jit): its
+            # maybe_calibrate would route this launch single anyway, so
+            # bail BEFORE paying the whole per-shard mesh ladder only to
+            # re-run it launch-wide down the standard path
+            return False
+        if (
+            decision == "mesh"
+            and runner.probe_enabled
+            and n < meshrunner.PROBE_MIN_ROWS
+        ):
+            # steady-state floor for the MEASURED pin: a trickle launch
+            # (flush tail after a calibrated win) isn't worth the stack/
+            # device_put/SPMD overhead — the single path is strictly
+            # cheaper below the probe's own representativeness floor. A
+            # config-forced pin (probe=False) stays unconditional: the
+            # operator asked for the mesh lane, full stop.
+            return False
+        mesh_breaker = self.governor.breaker_for(faults.MESH_DISPATCH)
+        if not mesh_breaker.allow_device():
+            runner.note_demotion()
+            self.governor.record_mode(
+                governor.MESH,
+                "single",
+                "mesh_dispatch breaker open: mesh launches demoted to the "
+                "bit-identical single-device path",
+                {"devices": runner.n_devices},
+                key="path",
+            )
+            return False
+        parts = runner.shard_ranges(counts)
+        # parse ladder decided ONCE per launch (may calibrate, inline) —
+        # shard workers must not race the calibration or mix ladders
+        structural = self._parse_path(plan, all_batches) == "structural"
+        paths = plan.flat_paths()
+        # one COMMON row bucket across every device shard: the stacked
+        # SPMD input is one [D, n_pad, ...] array per column
+        n_pad = _bucket_rows(max(sum(counts[s:e]) for s, e in parts))
+        t0 = time.perf_counter()
+        thunks = [
+            (
+                lambda d=d, s=s, e=e: self._run_mesh_shard(
+                    d, launch, plan, all_batches[s:e], paths, structural,
+                    n_pad,
+                )
+            )
+            for d, (s, e) in enumerate(parts)
+        ]
+        pool = self._host_pool
+        try:
+            results = (
+                pool.run(thunks)
+                if pool is not None and len(thunks) >= 2
+                else [t() for t in thunks]
+            )
+        except Exception as exc:
+            # fail closed per-launch: a faulted shard worker degrades this
+            # launch to the standard path, which re-executes every stage
+            # launch-wide from the original batches (exact output)
+            faults.note_failure(
+                faults.SHARD_WORKER, exc, reraise_programming=True
+            )
+            return False
+        self._stat_add("t_mesh_ladder", time.perf_counter() - t0)
+        shards = [shard for shard, _ in results]
+        shard_cols = [cols for _, cols in results]
+        zeros = plan.zero_device_inputs(n_pad)
+        n_arrays = len(zeros)
+        stacked = []
+        for i in range(n_arrays):
+            blocks = [
+                shard_cols[d][i]
+                if d < len(shard_cols) and shard_cols[d] is not None
+                else zeros[i]
+                for d in range(runner.n_devices)
+            ]
+            stacked.append(np.stack(blocks))
+        if decision is None:
+            # the single-device baseline must see the rows the REAL single
+            # path would launch — each shard trimmed to its true record
+            # count, concatenated, padded to _bucket_rows(n) — not the
+            # D * n_pad padded stack (which inflates t_single up to ~2x on
+            # unbalanced shards and could pin "mesh" on a box where the
+            # single path actually wins)
+            n_flat = _bucket_rows(n)
+            flat = []
+            for i in range(n_arrays):
+                parts_i = [
+                    shard_cols[d][i][: shards[d].n]
+                    for d in range(len(shards))
+                    if shard_cols[d] is not None
+                ]
+                flat.append(
+                    _fit_cols([np.concatenate(parts_i)], n_flat)[0]
+                )
+            decision = runner.maybe_calibrate(
+                self.governor, plan, stacked, flat, n
+            )
+            if decision != "mesh":
+                # the measured pin says single-device: this launch's ladder
+                # re-runs down the standard path (a one-time cost per
+                # engine — the sticky decision skips the mesh lane outright
+                # from the next launch on)
+                return False
+        launch.r_out = plan.r_out
+        t0 = time.perf_counter()
+
+        def leg():
+            faults.inject(faults.MESH_DISPATCH)
+            fn = runner.predicate_fn(plan)
+            args = runner.stack_and_put(stacked)
+            mask = fn(*args)
+            mask.copy_to_host_async()
+            return mask
+
+        mask = self._try_device_leg(faults.MESH_DISPATCH, leg)
+        self._stat_add("t_dispatch", time.perf_counter() - t0)
+        if mask is None:
+            # exhausted mesh envelope: demote THIS launch to the exact
+            # numpy predicate per shard (same columns, identical bits);
+            # the breaker verdict (recorded by _try_device_leg) decides
+            # whether the NEXT launch even tries the mesh
+            runner.note_demotion()
+            self._count_fallback(n)
+            for shard, cols in zip(shards, shard_cols):
+                if shard.n and cols is not None:
+                    slot = _MaskSlot(shard.n)
+                    slot.trace_id = launch.trace_id
+                    slot._mask_np = plan.eval_host_mask(cols)
+                    shard.mask = slot
+        else:
+            mesh_breaker.record_success()
+            self._stat_add("bytes_h2d", sum(a.nbytes for a in stacked))
+            self._stat_add("bytes_d2h", runner.n_devices * (n_pad // 8))
+            for d, (shard, cols) in enumerate(zip(shards, shard_cols)):
+                if shard.n == 0 or cols is None:
+                    continue
+                slot = _MaskSlot(shard.n)
+                slot.trace_id = launch.trace_id
+                # per-device block of the ONE sharded result; fetched
+                # synchronously at harvest under the MASK_FETCH envelope
+                # (no _mask_event -> _resolve_keep fetches directly), with
+                # the exact numpy fallback over the retained columns
+                slot._mask_dev = mask[d]
+                slot._cols = cols
+                shard.mask = slot
+        launch._shards = shards
+        ranges: list[tuple[int, int]] = []
+        rec_base = 0
+        for shard in shards:
+            ranges.extend((a + rec_base, b + rec_base) for a, b in shard.ranges)
+            rec_base += shard.n
+        launch.ranges = ranges
+        launch.n = rec_base
+        if mask is not None:
+            # mesh accounting only when the SPMD program actually ran:
+            # a demoted launch (numpy per shard) must not journal a
+            # healthy "mesh" posture or grow the mesh launch counters —
+            # note_demotion above is its whole story
+            runner.note_launch([shard.n for shard in shards])
+            self.governor.record_mode(
+                governor.MESH,
+                "mesh",
+                f"SPMD launch over the {runner.n_devices}-device mesh: "
+                f"per-device sub-launches via the host-pool range shard, "
+                f"one shard_map predicate program",
+                {"devices": runner.n_devices, "rows": rec_base},
+                key="path",
+            )
+            self._stat_add("n_mesh_launches", 1)
+        self._stat_add("n_records", rec_base)
+        self._stat_add("n_launches", 1)
+        with self._stats_lock:
+            probes.coproc_launch_rows_hist.record(rec_base)
+            for shard in shards:
+                probes.coproc_shard_rows_hist.record(shard.n)
+            self.last_launch_shards = [
+                {"rows": shard.n, **shard.stages} for shard in shards
+            ]
+        return True
+
+    def _run_mesh_shard(
+        self, d: int, launch: _Launch, plan: ColumnarPlan, batches, paths,
+        structural: bool, n_pad: int,
+    ) -> tuple[_HostShard, list | None]:
+        """One mesh device's dispatch-side host ladder (pool worker or
+        inline): per-shard column-cache consult, parse/extract with the
+        LAUNCH-COMMON row bucket, projection extraction, cache populate.
+        NO predicate dispatch — the predicate is one SPMD program over
+        all shards, issued by _dispatch_mesh after the stack assembles.
+        Touches only its own shard (SHD6xx)."""
+        shard = _HostShard()
+        t_shard0 = time.perf_counter()
+        faults.inject(faults.SHARD_WORKER)
+        key = self._shard_cache_key(launch.script_id, batches)
+        entry = self._colcache.lookup(key) if key is not None else None
+        if key is not None:
+            self._count_colcache(entry is not None)
+        if entry is not None:
+            cols = self._shard_from_entry(shard, plan, entry, n_pad)
+        else:
+            cols, _ = self._shard_ladder(
+                shard, plan, batches, paths, structural, n_pad=n_pad
+            )
+            if key is not None and shard.n and cols is not None:
+                self._colcache.put(
+                    key,
+                    self._shard_cache_entry(
+                        shard, plan, cols, n_pad, structural
+                    ),
+                )
+        tracer.record(
+            "coproc.mesh_shard",
+            (time.perf_counter() - t_shard0) * 1e6,
+            launch.trace_id,
+            start_perf=t_shard0,
+            shard=d,
+            rows=shard.n,
+        )
+        return shard, cols
+
+    def _dispatch_payload(self, launch: _Launch, exploded, n: int) -> None:
         fn, r_out = self._pipelines[launch.script_id]
         launch.r_out = r_out
         launch.fits = exploded.sizes <= self._row_stride
@@ -2248,6 +2693,33 @@ class TpuEngine:
         n_pad = _bucket_rows(n)
         staged = self._pack_staged(exploded, n_pad)
         self._stat_add("t_pack", time.perf_counter() - t0)
+        self._launch_payload(launch, staged, n_pad, fn, r_out)
+
+    def _dispatch_payload_ptrs(self, launch: _Launch, pe, n: int) -> None:
+        """The pointer-table twin of _dispatch_payload: staging packs
+        each batch's records straight from its retained decompressed
+        payload buffer (batch_codec.PtrExploded) — byte-identical staged
+        rows, one fewer full copy of the launch's record bytes."""
+        fn, r_out = self._pipelines[launch.script_id]
+        launch.r_out = r_out
+        launch.fits = pe.sizes <= self._row_stride
+        if n == 0:
+            return
+        t0 = time.perf_counter()
+        n_pad = _bucket_rows(n)
+        staged = self._pack_staged_ptrs(pe, n_pad)
+        self._stat_add("t_pack", time.perf_counter() - t0)
+        self._launch_payload(launch, staged, n_pad, fn, r_out)
+
+    def _launch_payload(
+        self, launch: _Launch, staged: np.ndarray, n_pad: int, fn, r_out: int
+    ) -> None:
+        """Issue one payload-plan device launch over a built staging
+        matrix (breaker gate, fault envelope, exact host fallback) —
+        shared by the classic joined-blob and pointer-table staging
+        lanes."""
+        import jax
+
         # retained until the packed result lands: the host fallback re-runs
         # the pipeline on the CPU backend over exactly these rows
         launch._staged_np = staged
@@ -2579,6 +3051,37 @@ class TpuEngine:
                 exploded.joined[o : o + s] for o, s in zip(offsets, np.minimum(sizes, r))
             ]
             staged, _ = pack_rows(vals, stride)
+        staged[:, r : r + 4] = lens.view(np.uint8).reshape(n_pad, 4)
+        staged[:, r + 4 :] = 0
+        return staged
+
+    def _pack_staged_ptrs(self, pe, n_pad: int) -> np.ndarray:
+        """_pack_staged's pointer-table twin: the staging matrix fills
+        straight from each batch's retained decompressed payload buffer
+        (batch_codec.PtrExploded) — no joined blob is ever built or
+        re-read. Byte-identical output to _pack_staged over the merged
+        exploded table (the staging parity test pins it)."""
+        from redpanda_tpu.native import lib
+
+        r = self._row_stride
+        stride = r + IN_META
+        n = len(pe.sizes)
+        staged = np.empty((n_pad, stride), dtype=np.uint8)
+        row = 0
+        for payload, off, ln in zip(pe.payloads, pe.rel_off, pe.rel_len):
+            k = len(ln)
+            if k:
+                # rp_pack_rows clamps sizes to the stride and zero-fills
+                # each row's tail, so per-batch packing into row slices is
+                # byte-identical to one whole-launch pack
+                lib.pack_rows_into(payload, off, ln, staged[row : row + k])
+            row += k
+        if n_pad > n:
+            staged[n:] = 0
+        fits = pe.sizes <= r
+        lens = np.where(fits, pe.sizes, 0).astype("<i4")
+        if n_pad > n:
+            lens = np.concatenate([lens, np.zeros(n_pad - n, "<i4")])
         staged[:, r : r + 4] = lens.view(np.uint8).reshape(n_pad, 4)
         staged[:, r + 4 :] = 0
         return staged

@@ -53,9 +53,14 @@ MASK_FETCH = "mask_fetch"
 HARVEST = "harvest"
 SHARD_WORKER = "shard_worker"
 SANDBOX_COMPILE = "sandbox_compile"
+# multi-chip sharded predicate launch (coproc/meshrunner.py): its own
+# domain so a flaky mesh path demotes MESH launches to the bit-identical
+# single-device path while plain dispatch keeps its own breaker
+MESH_DISPATCH = "mesh_dispatch"
 
 honey_badger.register_probe(
-    MODULE, DEVICE_DISPATCH, MASK_FETCH, HARVEST, SHARD_WORKER, SANDBOX_COMPILE
+    MODULE, DEVICE_DISPATCH, MASK_FETCH, HARVEST, SHARD_WORKER,
+    SANDBOX_COMPILE, MESH_DISPATCH,
 )
 
 

@@ -76,10 +76,16 @@ DIAGNOSIS = "diagnosis"
 # here (coproc/lockwatch.py) — the dynamic validation trail of the
 # pandaraces static acquisition graph
 LOCKWATCH = "lockwatch"
+# multi-chip sharded engine (coproc/meshrunner.py): the measured
+# mesh-vs-single-device decision, the raft device-plane CRC/vote probe,
+# and mesh breaker demotions all journal here (PROBE_MARGIN posture —
+# the mesh must show a real win over the known single-device path)
+MESH = "mesh"
 
 DOMAINS = (
     HOST_POOL, COLUMNAR_BACKEND, DEVICE_LZ4, BREAKER, HARVEST_PATH,
     SHARDED_SEAL, DEADLINE, PARSE_PATH, COLUMN_CACHE, DIAGNOSIS, LOCKWATCH,
+    MESH,
 )
 
 # fault domains that get their own breaker + adaptive deadline. Each
@@ -90,7 +96,10 @@ DOMAINS = (
 # through abandoned attempts and envelope waits, so a burst of timeouts
 # used to inflate the very tail the next deadline was derived from (the
 # 8x cap bounded that feedback; the success-only source removes it).
-BREAKER_DOMAINS = (faults.DEVICE_DISPATCH, faults.MASK_FETCH, faults.HARVEST)
+BREAKER_DOMAINS = (
+    faults.DEVICE_DISPATCH, faults.MASK_FETCH, faults.HARVEST,
+    faults.MESH_DISPATCH,
+)
 
 # Adaptive-deadline shape: derived = clamp(margin * p99.9, floor, cap_x *
 # floor). The cap bounds every waiter sized off envelope_s() (the tick
@@ -108,6 +117,7 @@ _STATE_ENCODING: dict[str, dict[str, float]] = {
     HARVEST_PATH: {"padded": 0.0, "gather": 1.0},
     SHARDED_SEAL: {"inline": 0.0, "sharded": 1.0},
     PARSE_PATH: {"staged": 0.0, "structural": 1.0},
+    MESH: {"single": 0.0, "mesh": 1.0},
 }
 
 _BREAKER_SEVERITY = {
@@ -670,6 +680,7 @@ class Governor:
             HARVEST_PATH: modes.get(HARVEST_PATH),
             SHARDED_SEAL: modes.get(SHARDED_SEAL),
             PARSE_PATH: modes.get(PARSE_PATH),
+            MESH: modes.get(MESH),
             "breakers": self.breakers_snapshot(),
             "deadlines_ms": {
                 d: round(self.deadline_s(d) * 1e3, 3) for d in BREAKER_DOMAINS

@@ -247,6 +247,37 @@ class _NativeLib:
         )
         return dst, truncated
 
+    def pack_rows_into(
+        self, src: bytes, offsets: np.ndarray, sizes: np.ndarray,
+        dst: np.ndarray,
+    ) -> int:
+        """rp_pack_rows into a CALLER-provided [n, stride] row block — a
+        contiguous slice of a larger staging matrix. The pointer-table
+        payload staging lane packs each batch's records straight from its
+        decompressed payload buffer this way, so no joined blob is ever
+        built. The C loop clamps sizes to the stride and zero-fills every
+        row tail (byte parity with a whole-launch pack_rows)."""
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+        n, stride = dst.shape
+        if len(offsets) != n or len(sizes) != n:
+            raise ValueError("pack_rows_into offsets/sizes/dst mismatch")
+        if dst.dtype != np.uint8 or not dst.flags["C_CONTIGUOUS"]:
+            raise ValueError("pack_rows_into dst must be contiguous uint8")
+        src_arr = np.frombuffer(src, dtype=np.uint8)
+        # bounds: the C memcpy is unchecked (sizes clamp to the stride
+        # in-crossing, so the effective span is min(max(size,0), stride))
+        eff = np.minimum(np.maximum(sizes, 0), stride)
+        if n and (
+            offsets.min() < 0
+            or int((offsets + eff).max()) > src_arr.nbytes
+        ):
+            raise ValueError("pack span outside the source buffer")
+        return self._dll.rp_pack_rows(
+            src_arr.ctypes.data, offsets.ctypes.data, sizes.ctypes.data,
+            n, dst.ctypes.data, stride,
+        )
+
     def parse_record_values(self, payload: bytes, count: int) -> tuple[np.ndarray, np.ndarray]:
         """Offsets/lengths of each record's value within a batch payload."""
         val_off = np.empty(count, dtype=np.int64)

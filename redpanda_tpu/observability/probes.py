@@ -92,6 +92,39 @@ coproc_colcache_misses = registry.counter(
     outcome="miss",
 )
 
+# ------------------------------------------------------ multi-chip meshrunner
+# One sharded launch = one SPMD predicate program over the partition-axis
+# device mesh (coproc/meshrunner.py). Demotions are launches the breaker or
+# a failed mesh leg sent down the bit-identical single-device path.
+coproc_mesh_launches = registry.counter(
+    "coproc_mesh_launches_total",
+    "Columnar launches dispatched SPMD over the device mesh",
+)
+coproc_mesh_demotions = registry.counter(
+    "coproc_mesh_demotions_total",
+    "Mesh-eligible launches demoted to the single-device path",
+)
+# per-device record counters, created lazily per mesh device index so the
+# series set matches the mesh actually built (locked check-then-create,
+# same rationale as coproc_failure_counter)
+_mesh_device_rows: dict[int, Counter] = {}
+_mesh_device_lock = threading.Lock()
+
+
+def coproc_mesh_device_rows(device: int) -> Counter:
+    c = _mesh_device_rows.get(device)
+    if c is None:
+        with _mesh_device_lock:
+            c = _mesh_device_rows.get(device)
+            if c is None:
+                c = registry.counter(
+                    "coproc_mesh_device_rows_total",
+                    "Records dispatched to each mesh device shard",
+                    device=str(device),
+                )
+                _mesh_device_rows[device] = c
+    return c
+
 # -------------------------------------------------------- coproc fault domains
 # Classified failure counter, one series per (fault domain, exception kind):
 # every formerly-silent except block in the engine reports here, so no

@@ -6,6 +6,7 @@ from redpanda_tpu.parallel.mesh import (
 )
 from redpanda_tpu.parallel.collectives import (
     make_vote_aggregator,
+    make_crc_vote_step,
     make_sharded_crc_check,
     make_sharded_coproc_step,
 )
@@ -16,6 +17,7 @@ __all__ = [
     "shard_to_mesh",
     "sharded_jit",
     "make_vote_aggregator",
+    "make_crc_vote_step",
     "make_sharded_crc_check",
     "make_sharded_coproc_step",
 ]

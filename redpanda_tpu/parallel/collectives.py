@@ -106,6 +106,43 @@ def make_sharded_coproc_step(mesh, spec_json: str, r_batch: int, r_rec: int):
     return jax.jit(fn)
 
 
+def make_crc_vote_step(mesh, r: int):
+    """The config-5 raft step in ONE sharded launch: batched CRC
+    validation of every partition's batches AND the cross-partition vote
+    tally (BASELINE config 5; SURVEY §2.4).
+
+    Returns fn(rows u8 [D, B, r], lens i32 [D, B], claimed u32 [D, B],
+    votes u8 [D, G]) -> (ok bool [D, B], bad i32 [D], tally i32 [G]).
+
+    The CRC kernel is vmapped over the sharded device axis (each chip
+    CRCs only the batches of the partitions it owns); the tally is the
+    one collective — a psum over 'p' — so every shard (and the host)
+    reads the full per-group count without n_dev separate messages.
+    """
+    crc = make_crc_fn(r)
+
+    def _local(rows, lens, claimed, votes):
+        # block shapes: rows [1, B, r], votes [1, G]
+        got = jax.vmap(crc)(rows, lens)
+        ok = (got == claimed) & (lens > 0)
+        bad = jnp.sum((~ok) & (lens > 0), axis=1).astype(jnp.int32)
+        tally = jax.lax.psum(votes.astype(jnp.int32).sum(axis=0), PARTITION_AXIS)
+        return ok, bad, tally
+
+    fn = shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(
+            P(PARTITION_AXIS, None, None),
+            P(PARTITION_AXIS, None),
+            P(PARTITION_AXIS, None),
+            P(PARTITION_AXIS, None),
+        ),
+        out_specs=(P(PARTITION_AXIS, None), P(PARTITION_AXIS), P()),
+    )
+    return jax.jit(fn)
+
+
 def make_sharded_crc_check(mesh, r: int):
     """Returns fn(rows uint8 [P, B, r], lens int32 [P, B], claimed uint32
     [P, B]) -> (ok bool [P, B], bad_per_partition int32 [P]).

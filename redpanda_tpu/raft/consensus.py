@@ -26,6 +26,7 @@ from redpanda_tpu.models.fundamental import NTP
 from redpanda_tpu.observability import probes
 from redpanda_tpu.observability.trace import tracer
 from redpanda_tpu.models.record import Record, RecordBatch, RecordBatchType
+from redpanda_tpu.raft import device_plane
 from redpanda_tpu.raft.configuration import ConfigurationManager, GroupConfiguration
 from redpanda_tpu.raft.types import (
     ConsistencyLevel,
@@ -682,14 +683,46 @@ class Consensus:
 
     # ---------------------------------------------------------------- append RPC
     async def handle_append_entries(self, req: dict) -> dict:
+        blob = req["batches"]
+        crc_failures = 0
+        batches = None
+        if blob and device_plane.crc_validate_enabled():
+            # BASELINE config 5 (follower half): batched CRC validation of
+            # the whole append in ONE kernel call instead of one host CRC
+            # per batch — the measured probe inside the plane decides
+            # host-vs-device, both bit-exact. Runs BEFORE _op_lock: the
+            # validation is a pure function of the wire bytes, and the
+            # first representative call jit-compiles for seconds — held
+            # under the lock that would queue this group's heartbeats
+            # while the unlocked election-loop staleness check fires a
+            # spurious election against a healthy leader.
+            batches = _decode_batches(blob)
+            if batches:
+                ok = await asyncio.to_thread(
+                    device_plane.default_plane().validate,
+                    [b.crc_region() for b in batches],
+                    [b.header.crc for b in batches],
+                )
+                crc_failures = int((~ok).sum())
+                if crc_failures:
+                    logger.warning(
+                        "group %d: rejecting append, %d/%d batch CRC "
+                        "failures", self.group, crc_failures, len(ok),
+                    )
         async with self._op_lock:
-            return await self._do_handle_append(req, req["batches"], req["flush"])
+            return await self._do_handle_append(
+                req, blob, req["flush"],
+                crc_failures=crc_failures, batches=batches,
+            )
 
     async def handle_heartbeat(self, meta: dict) -> dict:
         async with self._op_lock:
             return await self._do_handle_append(meta, b"", False)
 
-    async def _do_handle_append(self, req: dict, blob: bytes, flush: bool) -> dict:
+    async def _do_handle_append(
+        self, req: dict, blob: bytes, flush: bool,
+        crc_failures: int = 0, batches: list[RecordBatch] | None = None,
+    ) -> dict:
         def reply(result: int) -> dict:
             return {
                 "group": self.group,
@@ -718,7 +751,14 @@ class Consensus:
                 await self._truncate_locked(prev_idx)
                 return reply(1)
         if blob:
-            batches = _decode_batches(blob)
+            if crc_failures:
+                # a corrupted wire batch (caught by the pre-lock batched
+                # CRC validation in handle_append_entries) rejects the
+                # append — the leader retries/recovers — instead of
+                # poisoning the follower log
+                return reply(1)
+            if batches is None:
+                batches = _decode_batches(blob)
             if batches:
                 first = batches[0].base_offset
                 if first <= dirty:

@@ -23,6 +23,10 @@ class HeartbeatManager:
         self.interval_ms = interval_ms
         self._groups: dict[int, object] = {}  # group id -> Consensus
         self._task: asyncio.Task | None = None
+        # last tick's per-group ack counts from the batched device-plane
+        # tally (raft/device_plane.py; empty until raft_device_vote_tally
+        # is on) — a debug/observability view, not an acking input
+        self.last_tick_acks: dict[int, int] = {}
 
     def register(self, consensus) -> None:
         self._groups[consensus.group] = consensus
@@ -61,18 +65,50 @@ class HeartbeatManager:
                 by_node[meta["target"]["id"]].append(meta)
         if not by_node:
             return
-        await asyncio.gather(
+        acks = await asyncio.gather(
             *(self._send_one(nid, metas) for nid, metas in by_node.items())
         )
+        self._tally_acks(acks)
 
-    async def _send_one(self, node_id: int, metas: list[dict]) -> None:
+    def _tally_acks(self, acks: list[dict]) -> None:
+        """BASELINE config 5 (vote half): the per-tick cross-group ack
+        tally as ONE batched reduction over a [replier, group] bit matrix
+        instead of counting one reply message at a time. The plane's
+        measured probe decides host-vs-device; counts are identical
+        either way. Feeds the per-group quorum view (last_tick_acks) the
+        admin/debug surfaces read — replication acking itself stays on
+        the per-reply path (process_heartbeat_reply)."""
+        from redpanda_tpu.raft import device_plane
+
+        if not device_plane.vote_tally_enabled():
+            return
+        groups = sorted(self._groups)
+        if not groups or not acks:
+            return
+        import numpy as np
+
+        col = {g: i for i, g in enumerate(groups)}
+        bits = np.zeros((len(acks), len(groups)), dtype=np.uint8)
+        for row, per_node in enumerate(acks):
+            for g, ok in (per_node or {}).items():
+                if ok and g in col:
+                    bits[row, col[g]] = 1
+        tally = device_plane.default_plane().tally_votes(bits)
+        self.last_tick_acks = {g: int(tally[col[g]]) for g in groups}
+
+    async def _send_one(self, node_id: int, metas: list[dict]) -> dict:
+        """Returns {group: replied_ok} for the ack tally."""
         try:
             reply = await self._client_for(node_id).heartbeat(
                 {"heartbeats": metas}, timeout=self.interval_ms / 1000.0 * 4
             )
         except (RpcError, TransportClosed, OSError):
-            return  # follower timeout detection is the election timer's job
+            # follower timeout detection is the election timer's job
+            return {m["group"]: False for m in metas}
+        acks: dict[int, bool] = {}
         for m in reply["meta"]:
             c = self._groups.get(m["group"])
             if c is not None:
                 c.process_heartbeat_reply(m)
+            acks[m["group"]] = m.get("result", 1) == 0
+        return acks

@@ -308,3 +308,76 @@ def test_columnar_host_ablation_matches_device_mode():
                 va = [bytes(v) for bt in ia.batches for v in bt.record_values()]
                 vb = [bytes(v) for bt in ib.batches for v in bt.record_values()]
                 assert va == vb, (spec.to_json(), va, vb)
+
+
+def test_pack_staged_ptr_lane_bit_parity():
+    """The pointer-table payload staging (_pack_staged_ptrs over
+    batch_codec.explode_ptrs — no joined blob) produces byte-identical
+    staging matrices to the classic joined-blob _pack_staged, across
+    compression, empty batches, varied sizes and records wider than the
+    row stride."""
+    import numpy as np
+
+    from redpanda_tpu.coproc import batch_codec
+    from redpanda_tpu.coproc.engine import _bucket_rows
+    from redpanda_tpu.models.record import Record as R, RecordBatch as RB
+
+    def mk(n, codec=Compression.none, wide=False):
+        recs = [
+            R(
+                offset_delta=i,
+                value=(b"v%03d-" % i) * (40 if wide else (i % 7) + 1),
+            )
+            for i in range(n)
+        ]
+        return RB.build(recs, base_offset=0, compression=codec)
+
+    batches = [mk(12), mk(0), mk(5, Compression.gzip), mk(9, wide=True), mk(3)]
+    pe = batch_codec.explode_ptrs(batches)
+    if pe is None:
+        pytest.skip("native packer unavailable")
+    ex = batch_codec.explode_batches(batches)
+    assert pe.ranges == ex.ranges
+    assert np.array_equal(pe.sizes, ex.sizes)
+    engine = TpuEngine(row_stride=128)
+    n_pad = _bucket_rows(len(ex.sizes))
+    classic = engine._pack_staged(ex, n_pad)
+    ptr = engine._pack_staged_ptrs(pe, n_pad)
+    assert np.array_equal(classic, ptr)
+    engine.shutdown()
+
+
+def test_payload_reply_parity_ptr_vs_classic(monkeypatch):
+    """End to end: a payload-plan reply through the pointer-table lane is
+    byte-identical to the classic lane (forced by disabling explode_ptrs)."""
+    from redpanda_tpu.coproc import batch_codec
+    from redpanda_tpu.ops.transforms import filter_contains
+
+    spec = filter_contains(b"m1")
+
+    def run():
+        engine = TpuEngine(row_stride=256, compress_threshold=10**9)
+        codes = engine.enable_coprocessors([(1, spec.to_json(), ("orders",))])
+        assert codes == [EnableResponseCode.success]
+        req = ProcessBatchRequest([
+            ProcessBatchItem(
+                1, NTP.kafka("orders", p),
+                [_json_batch(10, base_offset=p), _json_batch(4)],
+            )
+            for p in range(3)
+        ])
+        reply = engine.process_batch(req)
+        stats = engine.stats()
+        engine.shutdown()
+        return [
+            (it.script_id, [b.payload for b in it.batches])
+            for it in reply.items
+        ], stats
+
+    got_ptr, st_ptr = run()
+    monkeypatch.setattr(batch_codec, "explode_ptrs", lambda batches: None)
+    got_classic, st_classic = run()
+    assert got_ptr == got_classic
+    if "t_explode_ptrs" in st_ptr:  # native present: the lane engaged
+        assert "t_explode_ptrs" not in st_classic
+        assert "t_explode" in st_classic

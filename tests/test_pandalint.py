@@ -211,6 +211,65 @@ def test_trace_ctx_transport_stays_clean():
     assert not any(f.rule.startswith("TRC") for f in findings)
 
 
+def test_mesh_ctx_rules_exact_lines():
+    got = _active(
+        _lint(
+            os.path.join(FIXTURES, "mesh_ctx.py"),
+            rules={"MSH1301", "MSH1302"},
+        )
+    )
+    assert got == [
+        ("MSH1301", 12),  # time.perf_counter under tracing
+        ("MSH1301", 13),  # numpy host op under tracing
+        ("MSH1302", 14),  # self.last write in the traced body
+        ("MSH1302", 21),  # global mutation in the traced body
+        ("MSH1301", 31),  # print() in a helper REACHED from a mesh body
+    ]
+
+
+def test_mesh_ctx_clean_fn_and_in_tree_mesh_code():
+    """The jnp-only mesh body stays silent, and the in-tree mesh-traced
+    functions (parallel/collectives _local bodies, the stacked predicate,
+    and everything they call) are the proof the rule's bar is the idiom:
+    the package-wide strict gate fails if any of them regresses."""
+    findings = _lint(
+        os.path.join(FIXTURES, "mesh_ctx.py"),
+        rules={"MSH1301", "MSH1302"},
+    )
+    assert not any(f.line >= 36 for f in findings), [
+        (f.rule, f.line) for f in findings
+    ]
+    for rel in (
+        "redpanda_tpu/parallel/collectives.py",
+        "redpanda_tpu/coproc/column_plan.py",
+    ):
+        path = os.path.join(REPO, *rel.split("/"))
+        assert not any(
+            f.rule.startswith("MSH") for f in _lint(path, relpath=rel)
+        )
+
+
+def test_mesh_affinity_propagates_and_stays_out_of_race_contexts():
+    """device_mesh membership flows through resolved calls (the _helper
+    shape) but does NOT join the concurrency contexts — a mesh-traced
+    helper must not start racing host code in the RAC11xx analysis."""
+    import ast
+
+    from tools.pandalint.affinity import Program
+
+    path = os.path.join(FIXTURES, "mesh_ctx.py")
+    with open(path) as fh:
+        tree = ast.parse(fh.read())
+    program = Program([("fixtures/mesh_ctx.py", tree)])
+    by_name = {}
+    for fn in program.funcs.values():
+        by_name.setdefault(fn.qualname, fn)
+    assert by_name["_helper"].mesh
+    assert by_name["Runner._local"].mesh
+    assert not by_name["_helper"].contexts  # mesh is NOT a race context
+    assert not by_name["clean"].mesh  # only the traced body, not its maker
+
+
 def test_bare_except_rules_exact_lines():
     got = _active(_lint(os.path.join(FIXTURES, "bare_except.py")))
     assert got == [

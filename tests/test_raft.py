@@ -485,3 +485,52 @@ def test_state_machine_apply_loop(tmp_path):
             await fx.stop()
 
     run(main())
+
+
+def test_follower_rejects_corrupted_append_crc(tmp_path):
+    # BASELINE config 5, follower half (PR 12): with
+    # raft_device_crc_validate on, handle_append_entries batch-validates
+    # the wire blob BEFORE taking the op lock and rejects the append when
+    # any batch's kafka CRC disagrees with its bytes — the leader
+    # retries/recovers instead of the follower log being poisoned.
+    async def main():
+        from redpanda_tpu.raft import device_plane
+        from redpanda_tpu.raft.consensus import _encode_entries
+
+        fx = await RaftGroupFixture(tmp_path, 3).start()
+        device_plane.configure(crc_validate=True)
+        try:
+            leader_node = await fx.wait_for_stable_leader()
+            leader = leader_node.consensus()
+            # clean replication still commits with validation enabled
+            res = await leader.replicate(
+                [data_batch(b"clean")], ConsistencyLevel.quorum_ack
+            )
+            assert leader.commit_index >= res.last_offset
+            follower = next(
+                n for n in fx.nodes if n.node_id != leader_node.node_id
+            ).consensus()
+            bad = data_batch(b"payload-to-corrupt")
+            bad.header.term = leader.term
+            blob = bytearray(_encode_entries([bad]))
+            blob[-3] ^= 0xFF  # flip a payload byte; header crc still valid
+            dirty = follower.dirty_offset
+            reply = await follower.handle_append_entries({
+                "group": GROUP,
+                "node": {"id": leader_node.node_id, "revision": 0},
+                "target": {"id": follower.self_node.id, "revision": 0},
+                "term": follower.term,
+                "prev_log_index": dirty,
+                "prev_log_term": follower.term_at(dirty),
+                "commit_index": follower.commit_index,
+                "batches": bytes(blob),
+                "flush": True,
+            })
+            assert reply["result"] == 1  # rejected, not appended
+            assert follower.dirty_offset == dirty
+        finally:
+            device_plane.configure(crc_validate=False)
+            device_plane.reset_default_plane()
+            await fx.stop()
+
+    run(main())

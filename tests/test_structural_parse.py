@@ -475,23 +475,25 @@ class TestColumnCache:
         assert cache.put((1, 2), entry(1000))
         assert cache.put((1, 3), entry(1000))
         # refresh (1,1) so (1,2) is LRU, then push it out
-        assert cache.lookup((1, 1))[0] is not None
+        assert cache.lookup((1, 1)) is not None
         assert cache.put((1, 4), entry(1000))
-        assert cache.lookup((1, 2))[0] is None
-        assert cache.lookup((1, 1))[0] is not None
+        assert cache.lookup((1, 2)) is None
+        assert cache.lookup((1, 1)) is not None
         st = cache.stats()
         assert st["evictions"] >= 1 and st["bytes"] <= 3000
-        # an entry bigger than the whole budget is refused, and its key
-        # stops reporting repeat_miss (the engine must not keep routing
-        # that launch inline to populate a cache that can't hold it)
-        assert cache.lookup((1, 9)) == (None, False)
+        # an entry bigger than the whole budget is refused outright
+        assert cache.lookup((1, 9)) is None
         assert not cache.put((1, 9), entry(5000))
-        assert cache.lookup((1, 9)) == (None, False)
-        assert cache.lookup((1, 9)) == (None, False)
+        assert cache.lookup((1, 9)) is None
 
-    def test_repeat_miss_forces_inline_populate_with_pool(self):
-        # pinned-sharded pool: first identical launch shards (miss),
-        # second routes inline to populate, third hits — outputs equal
+    def test_sharded_launches_populate_and_hit_per_shard(self):
+        # Cross-launch cache for the SHARDED path (ROADMAP item 1
+        # follow-on c): the first identical launch's shard workers each
+        # populate their own per-shard entry, and every shard of every
+        # later identical launch hits — no inline self-route. Pinned
+        # counters: per launch, 1 launch-wide miss (the pre-shard lookup)
+        # + 4 shard lookups (workers=4 over 32 distinct batches), so
+        # 3 launches = 3 + 4 = 7 misses and 2 * 4 = 8 hits.
         req = _request(n_items=32, records=64)  # >= _SHARD_MIN_ROWS
         engine = _engine(
             host_workers=4, host_pool_probe=False, device_column_cache_mb=32
@@ -503,7 +505,12 @@ class TestColumnCache:
             r3 = _payloads(engine.process_batch(req))
             assert r1 == r2 == r3
             st = engine.stats()["colcache"]
-            assert st["hits"] == 1 and st["misses"] == 2
+            assert st["hits"] == 8 and st["misses"] == 7
+            assert st["entries"] == 4
+            # the hits actually skipped the ladder: only the first
+            # launch's shards ran a parse crossing
+            n_sharded = engine.stats().get("n_sharded_launches", 0)
+            assert n_sharded == 3
         finally:
             engine.shutdown()
 

@@ -281,6 +281,91 @@ def bench_host_pool_scaling(secs: float) -> dict:
     return out
 
 
+def bench_mesh_scaling(secs: float) -> dict:
+    """Multi-chip mesh scaling: the config-5 sharded CRC+vote step
+    (parallel.collectives.make_crc_vote_step — the device half of the
+    meshrunner's launch) over the SAME total work at 1/2/4/8 devices on
+    the host-platform mesh. Pure device compute, no host ladder: the
+    ratio is what the mesh buys the kernel, not parse noise. Rates are
+    best-of-rounds (min-of-blocks posture). Reports rows/s per device
+    count plus ``mesh_speedup_best`` = best multi-device rate over the
+    1-device mesh — the ``--assert-mesh-speedup`` gate's input.
+
+    Requires the virtual host-platform mesh
+    (XLA_FLAGS=--xla_force_host_platform_device_count=8, set by
+    force_cpu_platform before jax initializes); device counts beyond
+    what the backend offers are skipped and reported as absent.
+
+    Threshold guidance for the gate: virtual host-platform devices share
+    the box's real cores, so the achievable ratio is bounded by the
+    MEASURED parallel capacity reported alongside
+    (``mesh_parallel_capacity``, same diagnostic the host-pool bench
+    carries) — on a quota-limited 1-core box the honest floor is ~1.0
+    (the sharded program must cost nothing over the 1-device mesh: a
+    no-regression gate), while co-located multi-chip ICI justifies 1.5+.
+    The engine itself never trusts this bench: the meshrunner's own
+    PROBE_MARGIN calibration decides mesh-vs-single per process."""
+    from redpanda_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(8)
+    import jax
+
+    from redpanda_tpu.hashing.crc32c import crc32c
+    from redpanda_tpu.parallel import make_crc_vote_step, partition_mesh, shard_to_mesh
+
+    devs = jax.local_devices(backend="cpu")
+    rng = np.random.default_rng(7)
+    n_batches, r, groups = 512, 1024, 64
+    payloads = [rng.bytes(r - (i % 129)) for i in range(n_batches)]
+    rows = np.zeros((n_batches, r), np.uint8)
+    lens = np.empty(n_batches, np.int32)
+    claimed = np.empty(n_batches, np.uint32)
+    for i, p in enumerate(payloads):
+        rows[i, : len(p)] = np.frombuffer(p, np.uint8)
+        lens[i] = len(p)
+        claimed[i] = crc32c(p)
+    out: dict = {"mesh_available_devices": len(devs)}
+    rates: dict[int, float] = {}
+    for d in (1, 2, 4, 8):
+        if d > len(devs) or n_batches % d:
+            continue
+        mesh = partition_mesh(devices=devs[:d])
+        step = make_crc_vote_step(mesh, r)
+        votes = rng.integers(0, 2, (d, groups)).astype(np.uint8)
+        args = shard_to_mesh(
+            mesh,
+            rows.reshape(d, n_batches // d, r),
+            lens.reshape(d, n_batches // d),
+            claimed.reshape(d, n_batches // d),
+            votes,
+        )
+        ok, _bad, tally = step(*args)  # compile + warm off the clock
+        assert bool(np.asarray(ok).all()), "CRC kernel mismatch on probe rows"
+        assert np.array_equal(
+            np.asarray(tally), votes.astype(np.int32).sum(axis=0)
+        ), "vote psum mismatch vs host oracle"
+        best = 0.0
+        t_end = time.perf_counter() + secs
+        while time.perf_counter() < t_end:
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(*args))
+            best = max(best, n_batches / (time.perf_counter() - t0))
+        rates[d] = best
+        out[f"mesh_d{d}_batches_per_s"] = round(best, 1)
+    if 1 in rates and len(rates) > 1:
+        out["mesh_speedup_best"] = round(
+            max(v for d, v in rates.items() if d > 1) / rates[1], 3
+        )
+    # context for ~1.0x results: what thread-level parallelism this box
+    # actually has (virtual devices share the real cores)
+    from redpanda_tpu.coproc import host_pool
+
+    out["mesh_parallel_capacity"] = host_pool.measure_parallel_capacity()[
+        "speedup"
+    ]
+    return out
+
+
 def bench_harvest_path(secs: float) -> dict:
     """Zero-copy harvest: gather vs padded framing on the 64-partition
     JSON-filter workload (a pure where-filter -> passthrough plan, ~1KB
@@ -982,6 +1067,7 @@ BENCHES = {
     "batch_codec": bench_batch_codec,
     "explode_find": bench_explode_find,
     "host_pool_scaling": bench_host_pool_scaling,
+    "mesh_scaling": bench_mesh_scaling,
     "harvest_path": bench_harvest_path,
     "compaction_index": bench_compaction_index,
     "allocation": bench_allocation,
@@ -1063,6 +1149,15 @@ def main(argv=None) -> int:
         "25%% cut); implies the harvest_path bench",
     )
     p.add_argument(
+        "--assert-mesh-speedup",
+        type=float,
+        metavar="RATIO",
+        help="fail (exit 1) if the sharded CRC+vote step's best "
+        "multi-device speedup over the 1-device mesh falls below RATIO "
+        "(e.g. 1.2) on a >=2-device host-platform mesh; implies the "
+        "mesh_scaling bench",
+    )
+    p.add_argument(
         "--assert-explode-speedup",
         type=float,
         metavar="RATIO",
@@ -1089,6 +1184,8 @@ def main(argv=None) -> int:
         names.append("trace_propagation_overhead")
     if args.assert_pool_speedup is not None and "host_pool_scaling" not in names:
         names.append("host_pool_scaling")
+    if args.assert_mesh_speedup is not None and "mesh_scaling" not in names:
+        names.append("mesh_scaling")
     if args.assert_breaker_overhead is not None and "breaker_overhead" not in names:
         names.append("breaker_overhead")
     if args.assert_harvest_speedup is not None and "harvest_path" not in names:
@@ -1149,6 +1246,16 @@ def main(argv=None) -> int:
             print(
                 f"host pool speedup {ratio}x below floor "
                 f"{args.assert_pool_speedup}x",
+                file=sys.stderr,
+            )
+            return 1
+    if args.assert_mesh_speedup is not None:
+        ratio = out.get("mesh_speedup_best", 0.0)
+        if ratio < args.assert_mesh_speedup:
+            print(
+                f"mesh CRC+vote speedup {ratio}x below floor "
+                f"{args.assert_mesh_speedup}x "
+                f"({out.get('mesh_available_devices', 0)} devices)",
                 file=sys.stderr,
             )
             return 1

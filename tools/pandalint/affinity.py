@@ -61,6 +61,20 @@ FINALIZER = "finalizer"
 
 ALL_CONTEXTS = (LOOP, EXECUTOR, DAEMON, POOL_WORKER, FINALIZER)
 
+# The device-mesh execution context is tracked SEPARATELY from the
+# concurrency contexts above (ProgFunc.mesh, not ProgFunc.contexts): a
+# function handed to ``shard_map(fn, ...)`` is a trace-time SPMD program
+# replicated onto every mesh device — it does not RACE host code (tracing
+# happens once, on the caller's thread), it must not TOUCH host state at
+# all (host calls run at trace time, not per launch, and host effects
+# don't shard). Folding it into the race contexts would smear phantom
+# RAC11xx findings across every helper a predicate shares with host
+# paths; the meshctx checker (MSH13xx) consumes the separate flag.
+DEVICE_MESH = "device_mesh"
+
+# call names that seed the device-mesh context at their first argument
+_MESH_SPAWNS = {"shard_map"}
+
 # contexts backed by a multi-threaded pool: two activations of the SAME
 # context can run concurrently (the PR-3 duplicate-jit-trace shape)
 SELF_RACING = frozenset({EXECUTOR, POOL_WORKER})
@@ -123,6 +137,9 @@ class ProgFunc:
     lineno: int
     is_method: bool = False       # a DIRECT class member (not nested)
     contexts: set[str] = field(default_factory=set)
+    # device-mesh (shard_map-traced) membership — separate from contexts,
+    # see DEVICE_MESH above
+    mesh: bool = False
 
     @property
     def qualname(self) -> str:
@@ -347,11 +364,19 @@ class Program:
         neighborhood: ``run_in_executor(ex, pm.engine.submit)`` must seed
         TpuEngine.submit without also smearing ``executor`` onto every
         ``submit`` method in the program — an over-wide seed propagates
-        phantom contexts through whole subsystems."""
+        phantom contexts through whole subsystems. ``ctx=DEVICE_MESH``
+        sets the separate mesh flag instead of a concurrency context."""
+
+        def mark(h: ProgFunc) -> None:
+            if ctx == DEVICE_MESH:
+                h.mesh = True
+            else:
+                h.contexts.add(ctx)
+
         if isinstance(expr, ast.Lambda):
             info = self.info_for(expr)
             if info is not None:
-                info.contexts.add(ctx)
+                mark(info)
             return
         if isinstance(expr, ast.Name):
             hits = self.resolve_name(fn, expr.id)
@@ -364,7 +389,7 @@ class Program:
                     for f in fs
                 ]
             for h in hits:
-                h.contexts.add(ctx)
+                mark(h)
             return
         if isinstance(expr, ast.Attribute):
             chain = dotted(expr)
@@ -375,12 +400,12 @@ class Program:
                 and fn.cls is not None
             ):
                 for h in self._methods.get((fn.cls, parts[1]), []):
-                    h.contexts.add(ctx)
+                    mark(h)
                 return
             near = self._import_neighborhood(fn.modkey)
             for h in self._by_method.get(expr.attr, []):
                 if h.modkey in near:
-                    h.contexts.add(ctx)
+                    mark(h)
 
     def _seed(self) -> None:
         for info in self.funcs.values():
@@ -432,6 +457,11 @@ class Program:
                         if isinstance(a, (ast.List, ast.Tuple)):
                             for el in a.elts:
                                 self._seed_ref(info, el, POOL_WORKER)
+                elif name in _MESH_SPAWNS:
+                    # shard_map(fn, mesh=..., ...): fn (and everything it
+                    # calls) is an SPMD device program over the mesh
+                    if call.args:
+                        self._seed_ref(info, call.args[0], DEVICE_MESH)
             if pool_fanout:
                 # the engine builds its thunk lists (lambdas calling the
                 # real shard bodies) before the pool.run call; every
@@ -457,11 +487,30 @@ class Program:
                     if not fn.contexts <= callee.contexts:
                         callee.contexts |= fn.contexts
                         work.append(callee)
+        self._propagate_mesh()
+
+    def _propagate_mesh(self) -> None:
+        """Separate monotone fixpoint for the device-mesh flag: a callee
+        of a mesh-traced function is itself traced into the SPMD program
+        (no lifecycle exemption — tracing has no startup phase)."""
+        work = [f for f in self.funcs.values() if f.mesh]
+        while work:
+            fn = work.pop()
+            for call in self.calls_in(fn):
+                callees, _amb = self.resolve_call(fn, call)
+                for callee in callees:
+                    if not callee.mesh:
+                        callee.mesh = True
+                        work.append(callee)
 
     # ------------------------------------------------------------ queries
     def contexts_of(self, node: ast.AST) -> frozenset[str]:
         info = self.funcs.get(id(node))
         return frozenset(info.contexts) if info is not None else frozenset()
+
+    def is_mesh(self, node: ast.AST) -> bool:
+        info = self.funcs.get(id(node))
+        return bool(info is not None and info.mesh)
 
 
 def contexts_race(a: frozenset, b: frozenset) -> bool:

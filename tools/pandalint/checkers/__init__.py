@@ -20,6 +20,7 @@ from tools.pandalint.checkers.hdrrecord import HdrRecordChecker
 from tools.pandalint.checkers.races import RaceChecker
 from tools.pandalint.checkers.deadlocks import DeadlockChecker
 from tools.pandalint.checkers.tracectx import TraceCtxChecker
+from tools.pandalint.checkers.meshctx import MeshCtxChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     ReactorChecker,
@@ -37,6 +38,7 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     RaceChecker,
     DeadlockChecker,
     TraceCtxChecker,
+    MeshCtxChecker,
 )
 
 

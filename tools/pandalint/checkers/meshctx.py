@@ -1,0 +1,164 @@
+"""Device-mesh purity (MSH13xx): shard_map-traced functions stay device-only.
+
+A function handed to ``shard_map(fn, mesh=...)`` (and everything it calls
+— the affinity ``device_mesh`` flag propagates over resolved calls) is an
+SPMD program: its body runs under jax tracing once and then replicates
+onto every mesh device. Host-only work inside it is a defect twice over:
+
+- a **host API call** (``time.perf_counter``, ``np.asarray``, ``open``,
+  a lock acquire) executes at TRACE time, not per launch — it silently
+  burns into the compiled program as a constant, or worse, performs a
+  side effect once on the tracing thread that the author believed ran
+  per device per tick (the hot-path impurity HPS2xx flags for jit
+  functions, extended here to the mesh context);
+- a **host state write** (``self.x = ...``, ``global``) from inside a
+  traced body mutates engine state from what LOOKS like device code —
+  the one shape the executor-affinity race analysis cannot see, because
+  the mesh context deliberately does not participate in it
+  (affinity.DEVICE_MESH docs).
+
+Rules fire at the offending line inside the mesh-traced function.
+Host-module detection is import-table based: a call whose receiver chain
+roots at an alias of numpy/time/os/threading/... (or a bare ``open`` /
+``print``) is host work. jax/jnp and arithmetic stay silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.affinity import Program, ProgFunc
+from tools.pandalint.checkers.base import Checker, RawFinding, dotted
+
+# top-level modules whose calls are host work under tracing
+HOST_MODULES = {
+    "numpy", "time", "os", "threading", "queue", "socket", "subprocess",
+    "logging", "random", "struct", "io", "ctypes", "json", "asyncio",
+}
+HOST_BUILTINS = {"open", "print", "input"}
+
+
+def _import_aliases(tree: ast.Module) -> dict[str, str]:
+    """name -> top-level module, over EVERY import in the file (function-
+    level imports included — the engine imports jax/numpy inside legs)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                top = a.name.split(".")[0]
+                out[a.asname or top] = top
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            top = node.module.split(".")[0]
+            for a in node.names:
+                out[a.asname or a.name] = top
+    return out
+
+
+class MeshCtxChecker(Checker):
+    name = "meshctx"
+    program_level = True
+    rules = {
+        "MSH1301": (
+            "mesh-traced function calls a host-only API: the call runs "
+            "once at trace time (not per device per launch) and breaks "
+            "SPMD purity"
+        ),
+        "MSH1302": (
+            "mesh-traced function mutates host state (attribute/global "
+            "write) from inside the traced SPMD body"
+        ),
+    }
+
+    def check_program(
+        self, program: Program, locks
+    ) -> Iterator[tuple[str, RawFinding]]:
+        aliases: dict[str, dict[str, str]] = {
+            rel: _import_aliases(tree) for rel, tree in program.modules
+        }
+        findings: list[tuple[str, RawFinding]] = []
+        for fn in program.funcs.values():
+            if not fn.mesh:
+                continue
+            findings.extend(self._check_fn(fn, aliases.get(fn.relpath, {})))
+        for item in sorted(findings, key=lambda kv: (kv[0], kv[1].line)):
+            yield item
+
+    def _check_fn(
+        self, fn: ProgFunc, aliases: dict[str, str]
+    ) -> Iterator[tuple[str, RawFinding]]:
+        stack = list(ast.iter_child_nodes(fn.node))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue  # nested defs carry their own mesh flag
+            if isinstance(node, ast.Call):
+                chain = dotted(node.func)
+                base = chain.split(".")[0] if chain else ""
+                mod = aliases.get(base)
+                if base in HOST_BUILTINS and base not in aliases:
+                    yield (
+                        fn.relpath,
+                        RawFinding(
+                            "MSH1301",
+                            node.lineno,
+                            node.col_offset,
+                            f"{fn.qualname}() is shard_map-traced "
+                            f"(device_mesh context) but calls host builtin "
+                            f"{base}() — host effects run once at trace "
+                            f"time, not per device; move the call outside "
+                            f"the traced body",
+                        ),
+                    )
+                elif mod in HOST_MODULES:
+                    yield (
+                        fn.relpath,
+                        RawFinding(
+                            "MSH1301",
+                            node.lineno,
+                            node.col_offset,
+                            f"{fn.qualname}() is shard_map-traced "
+                            f"(device_mesh context) but calls {chain}() "
+                            f"from host module '{mod}' — prepare the value "
+                            f"on the host BEFORE tracing (the "
+                            f"_prepare_cmp_consts pattern) or use the jnp "
+                            f"equivalent",
+                        ),
+                    )
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Attribute):
+                        yield (
+                            fn.relpath,
+                            RawFinding(
+                                "MSH1302",
+                                t.lineno,
+                                t.col_offset,
+                                f"{fn.qualname}() is shard_map-traced but "
+                                f"writes {dotted(t)} — host state mutated "
+                                f"from inside the traced SPMD body (runs "
+                                f"once at trace time and is invisible to "
+                                f"the race analysis); hoist the write out "
+                                f"of the mesh program",
+                            ),
+                        )
+            elif isinstance(node, ast.Global):
+                yield (
+                    fn.relpath,
+                    RawFinding(
+                        "MSH1302",
+                        node.lineno,
+                        node.col_offset,
+                        f"{fn.qualname}() is shard_map-traced but declares "
+                        f"`global {', '.join(node.names)}` — host state "
+                        f"mutation from the traced SPMD body",
+                    ),
+                )
+            stack.extend(ast.iter_child_nodes(node))
