@@ -34,6 +34,12 @@ Parity with redpanda/admin_server.cc:
 - GET  /v1/slo?federated=1             (the SLO spec judged over the
   federated scrape; POST /v1/slo/mark?federated=1 brackets cluster-wide
   incident windows; rpk debug slo --federated)
+- GET  /v1/resources                   (resource_mgmt budget plane: account
+  occupancy/peaks, pressure signal, admission + autotune state; rpk debug
+  resources — the loadgen overload gate judges peak occupancy from it)
+- POST /v1/archival/run_once, GET /v1/archival/status (drive one tiered-
+  storage reconcile+upload pass / inspect uploaded-segment state; 409 when
+  cloud_storage_enabled is false)
 - GET  /v1/status/ready
 Served on the owned HTTP server (the reference uses seastar httpd with swagger routes).
 """
@@ -83,6 +89,10 @@ class AdminServer:
         # superusers and arm failure probes.
         self.require_auth = require_auth
         self.auth_token = auth_token
+        # archival scheduler (tiered storage): wired by the application
+        # AFTER start when cloud_storage_enabled — /v1/archival/* answers
+        # 409 otherwise
+        self.archival = None
         self._runner: web.AppRunner | None = None
         self._log_level_restores: dict[str, tuple[int, asyncio.TimerHandle]] = {}
         self._federated_slo = None  # lazy: observability.federation
@@ -149,6 +159,9 @@ class AdminServer:
             web.delete("/v1/failure-probes/{module}/{probe}", self._unset_probe),
             web.get("/v1/coproc/status", self._coproc_status),
             web.get("/v1/governor", self._governor),
+            web.get("/v1/resources", self._resources),
+            web.post("/v1/archival/run_once", self._archival_run_once),
+            web.get("/v1/archival/status", self._archival_status),
             web.get("/v1/slo", self._slo),
             web.post("/v1/slo/mark", self._slo_mark),
             web.get("/metrics", self._metrics),
@@ -538,6 +551,8 @@ class AdminServer:
             honey_badger.set_wedge(module, probe, count)
         elif typ == "terminate":
             honey_badger.set_termination(module, probe, count)
+        elif typ == "corrupt":
+            honey_badger.set_corrupt(module, probe, count)
         else:
             return web.json_response({"error": f"unknown type {typ}"}, status=400)
         body = {"armed": f"{module}.{probe}", "type": typ}
@@ -566,6 +581,57 @@ class AdminServer:
             # check + injection lookup until process restart
             honey_badger.disable()
         return web.json_response({"disarmed": f"{module}.{probe}"})
+
+    # ------------------------------------------------------------ resources
+    async def _resources(self, req: web.Request) -> web.Response:
+        """The budget plane (resource_mgmt): per-account occupancy/peaks,
+        the pressure signal, admission controller stats and the autotune
+        launch knobs — what `rpk debug resources` renders and the loadgen
+        overload gate judges (peak occupancy must stay <= budget)."""
+        plane = getattr(self.broker, "budget_plane", None)
+        if plane is None:
+            return web.json_response(
+                {"enabled": False, "hint": "no budget plane installed"}
+            )
+        body = {"enabled": True, **plane.snapshot()}
+        ctrl = getattr(self.broker, "produce_admission", None)
+        if ctrl is not None:
+            body["produce_admission"] = ctrl.snapshot()
+        api = getattr(self.broker, "coproc_api", None)
+        if api is not None:
+            body["coproc_admission"] = api.engine.stats().get("admission")
+            body["autotune"] = api.engine.governor.autotune_snapshot()
+        return web.json_response(body)
+
+    # ------------------------------------------------------------ archival
+    async def _archival_run_once(self, req: web.Request) -> web.Response:
+        """Drive one reconcile+upload pass NOW (tiered-storage scenarios:
+        loadgen archives closed segments on demand instead of waiting for
+        the scheduler cadence). Returns the number of segment uploads."""
+        arch = self.archival
+        if arch is None:
+            return web.json_response(
+                {"error": "archival disabled (cloud_storage_enabled=false)"},
+                status=409,
+            )
+        uploads = await arch.run_once()
+        return web.json_response({"uploads": uploads})
+
+    async def _archival_status(self, req: web.Request) -> web.Response:
+        arch = self.archival
+        if arch is None:
+            return web.json_response({"enabled": False})
+        return web.json_response({
+            "enabled": True,
+            "interval_s": arch.interval_s,
+            "archivers": {
+                str(ntp): {
+                    "uploaded_segments": len(a.manifest.segments),
+                    "last_uploaded_offset": a.manifest.last_uploaded_offset,
+                }
+                for ntp, a in arch.archivers.items()
+            },
+        })
 
     # ------------------------------------------------------------ coproc
     async def _coproc_status(self, req: web.Request) -> web.Response:

@@ -138,9 +138,28 @@ class Application:
                 base_dir=os.path.join(c.data_directory, "data"),
                 sanitize_files=True,
             )
+        # Budget plane (resource_mgmt): installed BEFORE storage so the
+        # first kvstore/log appends already charge the storage account;
+        # the split + thresholds come from config (memory_groups posture).
+        from redpanda_tpu.resource_mgmt import admission as rm_admission
+        from redpanda_tpu.resource_mgmt import budgets as rm_budgets
+
+        self.budget_plane = rm_budgets.BudgetPlane(
+            total_bytes=c.resource_memory_total_mb << 20,
+            warn_pct=c.resource_pressure_warn_pct,
+            critical_pct=c.resource_pressure_critical_pct,
+            register_gauges=True,
+        )
+        rm_budgets.install(self.budget_plane)
         self.storage = await StorageApi(c.data_directory, log_config).start()
         self._stop_order.append(self.storage)
         self.broker = Broker(self._broker_config(), self.storage)
+        self.broker.budget_plane = self.budget_plane
+        self.broker.produce_admission = rm_admission.AdmissionController(
+            self.budget_plane.account("kafka_produce"), "kafka_produce",
+            warn_pct=self.budget_plane.warn_pct,
+            on_episode=self._journal_admission_episode,
+        )
 
         is_clustered = bool(c.seed_servers)
         if is_clustered:
@@ -203,6 +222,9 @@ class Application:
 
         if c.cloud_storage_enabled:
             await self._start_archival()
+            # admin surface (POST /v1/archival/run_once, GET .../status):
+            # the admin server started earlier, so hand it the scheduler
+            self.admin.archival = self.archival
 
         self._register_metrics()
         await self.storage.log_mgr.start_housekeeping(
@@ -292,7 +314,19 @@ class Application:
                 )
 
         self.group_manager.register_leadership_notification(_on_leadership)
-        proto = rpc.SimpleProtocol(node_id=c.node_id)
+        from redpanda_tpu.resource_mgmt import admission as rm_admission
+
+        proto = rpc.SimpleProtocol(
+            node_id=c.node_id,
+            # dispatch-time shed (STATUS_BACKPRESSURE) once inflight
+            # requests or their body bytes exceed the rpc account — peers
+            # resend; nothing ran, nothing is lost
+            inflight_gate=rm_admission.InflightGate(
+                self.budget_plane.account("rpc"),
+                max_requests=c.rpc_server_max_inflight_requests,
+                on_episode=self._journal_admission_episode,
+            ),
+        )
         self.group_manager.register_service(proto)
         ClusterService(self.controller, dispatcher).register(proto)
         # tx gateway: cross-node marker fan-out + staged-offset routing
@@ -352,6 +386,18 @@ class Application:
         # node registration happens in start() once the admin server is up
         # (its port rides the register_node command for pandascope fan-out)
         self._dispatcher = dispatcher
+
+    @staticmethod
+    def _journal_admission_episode(kind: str, info: dict) -> None:
+        """Shed episodes land in the process decision journal (ADMISSION
+        domain) so /v1/governor reconstructs every shed — one entry per
+        episode boundary, never per request (the ring is bounded)."""
+        from redpanda_tpu.coproc import governor as _governor
+
+        _governor.journal_record(
+            _governor.ADMISSION, kind,
+            f"{info.get('subsystem', '?')} admission {kind}", info,
+        )
 
     async def _start_coproc(self) -> None:
         from redpanda_tpu.coproc.api import CoprocApi
@@ -471,6 +517,16 @@ class Application:
             except Exception:
                 logger.exception("stopping %s failed", type(svc).__name__)
         self._stop_order.clear()
+        # uninstall OUR plane (if still current): a stopped app's module-
+        # level plane would otherwise keep gating later brokers/tests in
+        # this interpreter and pin its gauges' weakref alive forever
+        from redpanda_tpu.resource_mgmt import budgets as rm_budgets
+
+        if (
+            getattr(self, "budget_plane", None) is not None
+            and rm_budgets.current() is self.budget_plane
+        ):
+            rm_budgets.install(None)
         if getattr(self, "_s3_client", None) is not None:
             await self._s3_client.close()
             self._s3_client = None

@@ -450,6 +450,58 @@ async def cmd_debug(args) -> int:
                 print(f"  {k:<28}{stats[k]}")
         return 0
 
+    if args.debug_cmd == "resources":
+        status, body = await _admin_request(args, "GET", "/v1/resources")
+        if status != 200:
+            print(f"admin api returned {status}: {body}")
+            return 1
+        if args.json:
+            print(json.dumps(body, indent=2, sort_keys=True))
+            return 0
+        if not body.get("enabled"):
+            print("no budget plane installed (bare broker?)")
+            return 0
+        print(
+            f"pressure: {body.get('pressure', '?')} "
+            f"(max occupancy {body.get('max_occupancy', 0):.1%} in "
+            f"{body.get('max_occupancy_account') or '(none)'}; warn at "
+            f"{body.get('warn_pct', 0):.0%}, critical at "
+            f"{body.get('critical_pct', 0):.0%})"
+        )
+        print(f"total:    {body.get('total_bytes', 0)} bytes")
+        accounts = body.get("accounts") or {}
+        if accounts:
+            print(
+                f"{'ACCOUNT':<16}{'HELD':>12}{'PEAK':>12}{'LIMIT':>12}"
+                f"{'OCC':>8}"
+            )
+        for name, a in sorted(accounts.items()):
+            print(
+                f"{name:<16}{a.get('held_bytes', 0):>12}"
+                f"{a.get('peak_bytes', 0):>12}{a.get('limit_bytes', 0):>12}"
+                f"{a.get('occupancy', 0):>8.1%}"
+            )
+        for key in ("produce_admission", "coproc_admission"):
+            ctl = body.get(key)
+            if ctl:
+                print(
+                    f"{key}: admitted={ctl.get('admitted', 0)} "
+                    f"sheds={ctl.get('sheds', 0)} "
+                    f"throttle={ctl.get('base_throttle_ms', '?')}-"
+                    f"{ctl.get('max_throttle_ms', '?')}ms"
+                )
+        auto = body.get("autotune")
+        if auto:
+            print(
+                f"autotune: enabled={auto.get('enabled')} "
+                f"group_ticks={auto.get('group_ticks')}"
+                f"/{auto.get('group_ticks_cap')} "
+                f"launch_depth={auto.get('launch_depth')}"
+                f"/{auto.get('launch_depth_cap')} "
+                f"hold={auto.get('hold_s')}s"
+            )
+        return 0
+
     if args.debug_cmd == "governor":
         query = {"limit": str(args.limit)}
         if args.domain:
@@ -651,6 +703,7 @@ async def cmd_debug(args) -> int:
         ("federated_metrics.json", "/v1/federation/metrics"),
         ("coproc.json", "/v1/coproc/status"),
         ("governor.json", "/v1/governor"),
+        ("resources.json", "/v1/resources"),
         ("slo.json", "/v1/slo"),
         ("failpoints.json", "/v1/failure-probes"),
     ]:
@@ -861,6 +914,12 @@ def build_parser() -> argparse.ArgumentParser:
         "coproc", help="engine breaker + fault-domain + stage stats"
     )
     dc.add_argument("--json", action="store_true", help="raw JSON, no rendering")
+    dres = dsub.add_parser(
+        "resources",
+        help="budget plane: account occupancy, pressure, admission + "
+             "autotune state (admin api)",
+    )
+    dres.add_argument("--json", action="store_true", help="raw JSON, no rendering")
     dgov = dsub.add_parser(
         "governor",
         help="coproc decision journal + per-domain posture (admin api)",
@@ -900,7 +959,7 @@ def build_parser() -> argparse.ArgumentParser:
     fpa.add_argument("module")
     fpa.add_argument("probe")
     fpa.add_argument(
-        "type", choices=["exception", "delay", "wedge", "terminate"],
+        "type", choices=["exception", "delay", "wedge", "terminate", "corrupt"],
     )
     fpa.add_argument(
         "--count", type=int, default=None,

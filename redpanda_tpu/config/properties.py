@@ -133,6 +133,35 @@ PROPERTIES: list[Property] = [
     Property("enable_idempotence", "Accept idempotent producers", True, bool),
     Property("enable_transactions", "Accept transactional producers", True, bool),
     Property("transactional_id_expiration_ms", "Idle tx expiry", 15 * 60 * 1000, int, _positive),
+    # --- resource management / budget plane (resource_mgmt/budgets.py;
+    # memory_groups.h posture: one total split into per-subsystem accounts,
+    # admission sheds with retriable backpressure on exhaustion)
+    Property(
+        "resource_memory_total_mb",
+        "Total byte budget the plane carves into per-subsystem accounts "
+        "(kafka_produce 25%, rpc 12.5%, coproc 25%, storage 25%, raft "
+        "12.5% — see resource_mgmt/budgets.py DEFAULT_SPLIT)",
+        512, int, _positive,
+    ),
+    Property(
+        "resource_pressure_warn_pct",
+        "Worst-account occupancy fraction at which MemoryPressure reads "
+        "warn (autotune shrinks launch knobs)",
+        0.75, float, _positive,
+    ),
+    Property(
+        "resource_pressure_critical_pct",
+        "Occupancy fraction at which MemoryPressure reads critical (arena "
+        "free-list trims, column cache halves, launch knobs floor)",
+        0.90, float, _positive,
+    ),
+    Property(
+        "rpc_server_max_inflight_requests",
+        "Concurrent dispatched requests the internal rpc server admits "
+        "before shedding with STATUS_BACKPRESSURE (body bytes are bounded "
+        "separately by the rpc memory account)",
+        1024, int, _positive,
+    ),
     # --- coproc (configuration.h:57-61)
     Property("coproc_enable", "Enable the TPU transform engine", False, bool),
     Property("coproc_max_batch_size", "Max read per ntp per tick", 32 * 1024, int, _positive),
@@ -167,6 +196,37 @@ PROPERTIES: list[Property] = [
         "coproc_device_column_cache_mb",
         "LRU byte budget for the device-resident column cache (repeat scripts over unchanged batch windows skip the host parse/extract ladder and the H2D replay); 0 disables it",
         32, int, _non_negative,
+    ),
+    # --- coproc launch knobs / autotune (governor ADMISSION domain)
+    Property(
+        "coproc_group_ticks_per_launch",
+        "How many ticks' worth of input one coproc launch fuses (the "
+        "per-ntp read budget multiplier); the autotune starting point",
+        1, int, _positive,
+    ),
+    Property(
+        "coproc_group_ticks_max",
+        "Autotune cap on group_ticks_per_launch",
+        8, int, _positive,
+    ),
+    Property(
+        "coproc_launch_depth",
+        "Concurrent submit+harvest regions across all script fibers; the "
+        "autotune starting point",
+        4, int, _positive,
+    ),
+    Property(
+        "coproc_launch_depth_max",
+        "Autotune cap on launch_depth",
+        8, int, _positive,
+    ),
+    Property(
+        "coproc_autotune_launch",
+        "Let the governor move group_ticks_per_launch/launch_depth "
+        "dynamically (hysteresis-bounded, journaled under the admission "
+        "domain) off the success-only dispatch-leg p99.9 and the budget "
+        "plane's occupancy; false pins the static knobs",
+        True, bool,
     ),
     # --- coproc multi-chip mesh (coproc/meshrunner.py)
     Property(

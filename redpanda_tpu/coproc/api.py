@@ -36,6 +36,10 @@ class CoprocApi:
         max_batch = _knob("coproc_max_batch_size", 32 * 1024)
         inflight_bytes = _knob("coproc_max_inflight_bytes", 10 * 1024 * 1024)
         flush_ms = _knob("coproc_offset_flush_interval_ms", 300_000)
+        # budget plane (resource_mgmt): installed on the broker by the
+        # application; bare brokers (unit harnesses) run plane-less, which
+        # keeps admission off and the historical semantics
+        plane = getattr(broker, "budget_plane", None)
         if _knob("coproc_lockwatch", False):
             # must flip BEFORE the engine is built: per-object locks bind
             # their recorder (or lack of one) at construction
@@ -70,10 +74,30 @@ class CoprocApi:
             governor_journal_capacity=_knob(
                 "coproc_governor_journal_capacity", None
             ),
+            budget_plane=plane,
+        )
+        # close the autotune loop: the governor's ADMISSION domain owns
+        # the dynamic group_ticks/launch_depth verdicts, driven by the
+        # success-only dispatch-leg histogram and the plane's occupancy
+        group_ticks = _knob("coproc_group_ticks_per_launch", 1)
+        launch_depth = _knob("coproc_launch_depth", 4)
+        self.engine.governor.configure_autotune(
+            enabled=_knob("coproc_autotune_launch", True),
+            group_ticks=group_ticks,
+            group_ticks_cap=_knob("coproc_group_ticks_max", 8),
+            launch_depth=launch_depth,
+            launch_depth_cap=_knob("coproc_launch_depth_max", 8),
+            pressure_fn=(
+                (lambda: (plane.pressure(), plane.max_occupancy()[1]))
+                if plane is not None
+                else None
+            ),
         )
         self.pacemaker = Pacemaker(
             broker, self.engine,
             max_batch_size=max_batch,
+            group_ticks_per_launch=group_ticks,
+            launch_depth=launch_depth,
             # the byte budget bounds concurrent reads: each read holds at
             # most max_batch_size bytes (configuration.h:57-61 semantics)
             max_inflight_reads=max(1, inflight_bytes // max(max_batch, 1)),

@@ -55,6 +55,7 @@ class Arena:
         self._allocs = 0
         self._reuses = 0
         self._alloc_bytes = 0
+        self._trims = 0
 
     def acquire(self, nbytes: int) -> np.ndarray:
         """A uint8 1-D buffer of AT LEAST nbytes (callers track their own
@@ -81,6 +82,18 @@ class Arena:
                 self._free.append(buf)
             # else: drop — the launch that needed it can re-allocate
 
+    def trim(self) -> int:
+        """Release every parked free-list buffer back to the allocator
+        (memory-pressure hook: under a CRITICAL budget-plane signal the
+        engine prefers reclaiming idle scratch over shedding work).
+        Returns the number of buffers freed; in-flight buffers are
+        untouched and later releases re-park as usual."""
+        with self._lock:
+            n = len(self._free)
+            self._free.clear()
+            self._trims += 1
+        return n
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -88,6 +101,7 @@ class Arena:
                 "reuses": self._reuses,
                 "alloc_bytes": self._alloc_bytes,
                 "free_buffers": len(self._free),
+                "trims": self._trims,
             }
 
 

@@ -112,6 +112,31 @@ class DeviceColumnCache:
         self._misses = 0
         self._evictions = 0
         self._invalidations = 0
+        # memory pressure (resource_mgmt budget plane): while CRITICAL the
+        # effective budget halves — LRU entries beyond it evict immediately
+        # and stay out until the pressure clears
+        self._pressure = False
+        self._pressure_evictions = 0
+
+    def _effective_budget(self) -> int:
+        return self._budget // 2 if self._pressure else self._budget
+
+    def set_pressure(self, critical: bool) -> int:
+        """Enter/leave the reduced-budget posture. Entering evicts LRU
+        entries beyond the halved budget and counts them as pressure
+        evictions; leaving restores the configured budget (repopulation
+        happens naturally on later misses). Idempotent per level."""
+        evicted = 0
+        with self._lock:
+            self._pressure = bool(critical)
+            budget = self._effective_budget()
+            while self._bytes > budget and self._entries:
+                _, entry = self._entries.popitem(last=False)
+                self._bytes -= entry.nbytes
+                self._evictions += 1
+                self._pressure_evictions += 1
+                evicted += 1
+        return evicted
 
     def lookup(self, key: tuple) -> Entry | None:
         """The cached entry (refreshing LRU order) or None. Misses carry
@@ -131,19 +156,20 @@ class DeviceColumnCache:
         """Insert + evict LRU down to the budget. An entry bigger than
         the whole budget is refused outright (storing it would evict
         everything for a guaranteed-evicted tenant)."""
-        if entry.nbytes > self._budget:
-            return False
         with self._lock:
+            budget = self._effective_budget()
+            if entry.nbytes > budget:
+                return False
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old.nbytes
             self._entries[key] = entry
             self._bytes += entry.nbytes
-            while self._bytes > self._budget and len(self._entries) > 1:
+            while self._bytes > budget and len(self._entries) > 1:
                 _, evicted = self._entries.popitem(last=False)
                 self._bytes -= evicted.nbytes
                 self._evictions += 1
-            if self._bytes > self._budget:
+            if self._bytes > budget:
                 # the just-inserted entry is the only one and still over
                 # budget (budget shrank below it): drop it too
                 self._entries.popitem(last=False)
@@ -176,6 +202,8 @@ class DeviceColumnCache:
             self._bytes = 0
             self._hits = self._misses = 0
             self._evictions = self._invalidations = 0
+            self._pressure = False
+            self._pressure_evictions = 0
 
     def stats(self) -> dict:
         with self._lock:
@@ -185,6 +213,9 @@ class DeviceColumnCache:
                 "entries": len(self._entries),
                 "bytes": self._bytes,
                 "budget_bytes": self._budget,
+                "effective_budget_bytes": self._effective_budget(),
                 "evictions": self._evictions,
                 "invalidations": self._invalidations,
+                "pressure": self._pressure,
+                "pressure_evictions": self._pressure_evictions,
             }

@@ -44,6 +44,7 @@ import logging
 import queue
 import threading
 import time
+import weakref
 from collections import defaultdict
 from dataclasses import dataclass, field
 
@@ -68,6 +69,8 @@ from redpanda_tpu.coproc import (
     meshrunner,
 )
 from redpanda_tpu.coproc.column_plan import ColumnarPlan, HostPlan, PayloadPlan, plan_spec
+from redpanda_tpu.resource_mgmt import admission as rm_admission
+from redpanda_tpu.resource_mgmt import budgets as rm_budgets
 
 
 class EnableResponseCode(enum.IntEnum):
@@ -782,10 +785,17 @@ class Ticket:
         self.trace_id: int | None = None
         # (disposition, item, launch, [batch range indices])
         self._slots: list[tuple] = []
+        # bytes reserved from the coproc memory account at submit (0 when
+        # admission is off); released exactly once when result() returns
+        # OR raises — leaking them would starve every later submit
+        self._admitted: int = 0
 
     def result(self) -> ProcessBatchReply:
-        with tracer.span("coproc.harvest", trace_id=self.trace_id):
-            return self._result_impl()
+        try:
+            with tracer.span("coproc.harvest", trace_id=self.trace_id):
+                return self._result_impl()
+        finally:
+            self._engine._release_admission(self)
 
     def _result_impl(self) -> ProcessBatchReply:
         reply = ProcessBatchReply()
@@ -928,6 +938,7 @@ class TpuEngine:
         adaptive_deadline: bool | None = None,
         adaptive_deadline_margin: float | None = None,
         governor_journal_capacity: int | None = None,
+        budget_plane=None,
     ):
         self._handles: dict[int, ScriptHandle] = {}
         # fault domains: every device interaction runs under this envelope
@@ -1120,6 +1131,37 @@ class TpuEngine:
                 self._meshrunner.n_devices if self._meshrunner else 0
             )
         )
+        # Budget plane (resource_mgmt): staged rows acquire from the
+        # 'coproc' account BEFORE any dispatch — exhaustion sheds the
+        # whole submit with a retriable ShedError (the pacemaker backs
+        # off and re-reads the same offsets: nothing lost, nothing
+        # duplicated, never silent queue growth). Bytes release when the
+        # ticket harvests (Ticket.result's finally — the
+        # leak-on-exception tests pin it). Plane-less engines (bare
+        # test/bench constructions) admit everything, the historical
+        # semantics. The pressure listener is weakref-bound: the
+        # process-wide plane must not pin dead engines.
+        self._budget_plane = budget_plane
+        self._admission: rm_admission.AdmissionController | None = None
+        self._pressure_listener = None
+        if budget_plane is not None:
+            acct = budget_plane.accounts.get("coproc")
+            if acct is not None:
+                self._admission = rm_admission.AdmissionController(
+                    acct, "coproc", warn_pct=budget_plane.warn_pct
+                )
+            _ref = weakref.ref(self)
+
+            def _pressure_listener(level, snap, _ref=_ref):
+                eng = _ref()
+                if eng is not None:
+                    eng._on_memory_pressure(level, snap)
+
+            self._pressure_listener = _pressure_listener
+            budget_plane.add_pressure_listener(_pressure_listener)
+        self.governor.update_config_snapshot(
+            admission=self._admission is not None
+        )
         # per-shard stage splits of the most recent sharded launch (bench
         # artifact + debugging aid; overwritten per launch under the lock)
         self.last_launch_shards: list[dict] | None = None
@@ -1132,7 +1174,7 @@ class TpuEngine:
         # mask harvester: one daemon thread pays the D2H confirmation round
         # trip per launch while the caller keeps doing host work (~10 ms of
         # tunnel RTT per harvest otherwise lands on the critical path)
-        self._harvest_q: "queue.Queue[_Launch]" = queue.Queue()
+        self._harvest_q: "queue.Queue[_Launch]" = queue.Queue()  # pandalint: disable=BPR1401 -- bounded upstream: at most launch_depth launches are in flight (pacemaker gate) and each holds coproc-account bytes admitted at submit_group
         self._harvester: threading.Thread | None = None
 
     def _ensure_harvester(self) -> threading.Thread:
@@ -1162,6 +1204,13 @@ class TpuEngine:
             t.join(timeout=60.0)
         if self._host_pool is not None:
             self._host_pool.shutdown()
+        with self._stats_lock:  # concurrent shutdowns: swap-then-remove once
+            listener, self._pressure_listener = self._pressure_listener, None
+        if self._budget_plane is not None and listener is not None:
+            # the plane is process-wide and outlives this engine: leave
+            # the dead closure behind and every later pressure transition
+            # still walks it (the weakref makes it a no-op, not free)
+            self._budget_plane.remove_pressure_listener(listener)
 
     def _harvest_loop(self) -> None:
         while True:
@@ -1399,6 +1448,8 @@ class TpuEngine:
                 out["parse_probe"] = dict(self._parse_probe)
         if self._colcache is not None:
             out["colcache"] = self._colcache.stats()
+        if self._admission is not None:
+            out["admission"] = self._admission.snapshot()
         if self._meshrunner is not None:
             out["mesh"] = self._meshrunner.stats()
         if self._host_pool_probe is not None:
@@ -1437,6 +1488,48 @@ class TpuEngine:
         with cls._columnar_probe_lock:
             cls._columnar_backend = None
             cls._columnar_probe = None
+
+    def _release_admission(self, ticket: "Ticket") -> None:
+        """Return a ticket's reserved coproc-account bytes. Idempotent AND
+        atomic: the zero-swap runs under the stats lock because an
+        abandonment path may release from the loop while the executor
+        thread's ``result()`` finally races the same ticket — an unlocked
+        double-read would free the bytes twice and overcommit the
+        account."""
+        with self._stats_lock:
+            n, ticket._admitted = ticket._admitted, 0
+        if n and self._admission is not None:
+            self._admission.release(n)
+
+    def _on_memory_pressure(self, level: str, snap: dict) -> None:
+        """Budget-plane pressure transition (fired by BudgetPlane on level
+        CHANGE, from whatever thread moved the occupancy). CRITICAL sheds
+        reclaimable memory: the arena free-list is trimmed and the column
+        cache evicts down to half its budget; OK restores the full cache
+        budget. WARN only journals — the admission and autotune layers own
+        the load response. Each transition is one ADMISSION-domain journal
+        entry (level changes are rare by the plane's hysteresis)."""
+        trims = evicted = 0
+        if level == rm_budgets.PRESSURE_CRITICAL:
+            trims = self._arena.trim()
+            if self._colcache is not None:
+                evicted = self._colcache.set_pressure(True)
+            self._stat_add("n_pressure_trims", 1.0)
+            if evicted:
+                self._stat_add("n_pressure_evictions", float(evicted))
+        elif level == rm_budgets.PRESSURE_OK and self._colcache is not None:
+            self._colcache.set_pressure(False)
+        self.governor.record(
+            governor.ADMISSION, level,
+            f"memory pressure {level}: arena buffers freed {trims}, "
+            f"colcache entries evicted {evicted}",
+            {
+                "arena_freed": trims,
+                "colcache_evicted": evicted,
+                "max_occupancy": snap.get("max_occupancy"),
+                "account": snap.get("max_occupancy_account"),
+            },
+        )
 
     def reset_arenas(self) -> None:
         """Swap in a fresh harvest scratch arena. The arena is deliberately
@@ -1633,8 +1726,55 @@ class TpuEngine:
         single staging array: one H2D transfer, one device program, one
         async D2H — the round-trip cost of the device link is paid once per
         group instead of once per request.
+
+        Admission (resource_mgmt budget plane): every request's payload
+        bytes reserve from the 'coproc' account BEFORE anything dispatches,
+        all-or-nothing per group — a shed submit raises ``ShedError``
+        having dispatched NOTHING (shed-before-ack: no offsets move, no
+        materialized write can exist). Reserved bytes release when each
+        ticket harvests, or here on any submit-path exception.
         """
+        admitted: list[int] = []
+        if self._admission is not None:
+            ctrl = self._admission
+            for req in reqs:
+                nbytes = sum(
+                    len(b.payload) for item in req.items for b in item.batches
+                )
+                reserved, retry_ms = ctrl.try_admit(nbytes)
+                if nbytes > 0 and reserved == 0:
+                    for r in admitted:
+                        ctrl.release(r)
+                    acct = ctrl.account
+                    self.governor.note_shed(
+                        "coproc", retry_ms,
+                        {"requested_bytes": nbytes, "held_bytes": acct.held,
+                         "limit_bytes": acct.limit},
+                    )
+                    self._stat_add("n_shed_submits", 1.0)
+                    raise rm_admission.ShedError(
+                        "coproc", retry_ms, f"{nbytes} staged bytes"
+                    )
+                admitted.append(reserved)
+            if any(admitted):
+                # a zero-byte submit is not evidence the account recovered
+                self.governor.note_admitted("coproc")
+        try:
+            return self._submit_group_admitted(reqs, admitted)
+        except BaseException:
+            # nothing was handed back: the caller cannot harvest, so the
+            # reservations must not outlive the failed submit
+            if self._admission is not None:
+                for r in admitted:
+                    self._admission.release(r)
+            raise
+
+    def _submit_group_admitted(
+        self, reqs: list[ProcessBatchRequest], admitted: list[int]
+    ) -> list[Ticket]:
         tickets = [Ticket(self) for _ in reqs]
+        for t, r in zip(tickets, admitted):
+            t._admitted = r
         # script_id -> list of (ticket, slot_idx, item)
         by_script: dict[int, list[tuple]] = {}
         for ticket, req in zip(tickets, reqs):

@@ -187,7 +187,7 @@ _workers_created = 0
 class _FetchWorker(threading.Thread):
     def __init__(self, idx: int):
         super().__init__(name=f"rptpu-fault-fetch-{idx}", daemon=True)
-        self._jobs: "queue.Queue[_Job]" = queue.Queue()
+        self._jobs: "queue.Queue[_Job]" = queue.Queue()  # pandalint: disable=BPR1401 -- one job per worker by construction: a _FetchWorker is checked out of the free list per fetch and holds exactly one job until it completes or is abandoned
         self.start()
 
     def submit(self, job: _Job) -> None:

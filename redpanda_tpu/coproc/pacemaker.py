@@ -31,6 +31,8 @@ from redpanda_tpu.coproc.engine import (
 )
 from redpanda_tpu.models.fundamental import NTP, MaterializedNTP
 from redpanda_tpu.observability.trace import tracer
+from redpanda_tpu.resource_mgmt.admission import ShedError
+from redpanda_tpu.resource_mgmt.budgets import MemoryAccount
 from redpanda_tpu.storage.kvstore import KeySpace
 
 logger = logging.getLogger("rptpu.coproc.pacemaker")
@@ -39,6 +41,21 @@ logger = logging.getLogger("rptpu.coproc.pacemaker")
 class _StopScript(Exception):
     """Raised inside a script's own fiber to end it (deregistration from
     within tick — the fiber cannot await its own cancellation)."""
+
+
+def _release_abandoned(engine):
+    """Done-callback for a submit future whose tick gave up waiting: the
+    orphan ticket will never be harvested, so its admission reservation
+    releases here (a failed submit released its own in submit_group)."""
+
+    def cb(fut):
+        try:
+            ticket = fut.result()
+        except BaseException:  # pandalint: disable=EXC901 -- not a swallow: a raising submit released its own reservation and already classified the failure inside submit_group; this callback only exists for the SUCCESS-after-abandon path
+            return
+        engine._release_admission(ticket)
+
+    return cb
 
 
 class ScriptContext:
@@ -116,11 +133,16 @@ class ScriptContext:
         advancing at read time would drop records on any write failure.
         """
         pm = self.pacemaker
+        knobs = pm.launch_knobs()
         items = []
         read_high: dict[NTP, int] = {}
         t_read0 = time.perf_counter()
+        # group_ticks_per_launch fuses N ticks' worth of input into one
+        # launch (deeper batching amortizes the device round trip; the
+        # governor shrinks it back to 1 under memory pressure)
+        read_budget = pm.max_batch_size * knobs["group_ticks"]
         for ntp in self._input_ntps():
-            batches = await self._read_ntp(ntp)
+            batches = await self._read_ntp(ntp, read_budget)
             if batches:
                 items.append(ProcessBatchItem(self.script_id, ntp, batches))
                 read_high[ntp] = batches[-1].last_offset
@@ -157,16 +179,65 @@ class ScriptContext:
             # the backstop must always sit above the engine's own envelope
             # or it would abandon legitimately mid-envelope ticks.
             deadline_s = pm.tick_deadline_for(pm.engine)
-            with tracer.span("coproc.submit.wait"):
-                ticket = await asyncio.wait_for(
-                    loop.run_in_executor(ex, pm.engine.submit, req),
-                    timeout=deadline_s,
+            # launch_depth bounds concurrent submit+harvest regions across
+            # every script fiber: the staged bytes of at most depth
+            # launches are in flight, which is what keeps the coproc
+            # account's occupancy (and so the pressure signal) meaningful
+            async with pm._launch_cond:
+                while pm._launch_inflight >= knobs["launch_depth"]:
+                    await pm._launch_cond.wait()
+                pm._launch_inflight += 1
+            shed_retry_s = None
+            try:
+                sub_fut = loop.run_in_executor(ex, pm.engine.submit, req)
+                try:
+                    with tracer.span("coproc.submit.wait"):
+                        ticket = await asyncio.wait_for(
+                            asyncio.shield(sub_fut), timeout=deadline_s
+                        )
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    # timeout OR fiber cancellation (script removal): the
+                    # executor thread cannot be cancelled, and the shielded
+                    # submit's eventual ticket will never be harvested —
+                    # hand its reservation back or the account ratchets
+                    # shut one abandoned tick at a time
+                    sub_fut.add_done_callback(_release_abandoned(pm.engine))
+                    raise
+                res_fut = loop.run_in_executor(ex, ticket.result)
+                try:
+                    with tracer.span("coproc.harvest.wait"):
+                        reply = await asyncio.wait_for(
+                            asyncio.shield(res_fut), timeout=deadline_s
+                        )
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    # shield the work item too: an un-started queued
+                    # result() would otherwise be CANCELLED outright and
+                    # its finally (the release) never run. Release here
+                    # for promptness — _release_admission is atomic and
+                    # idempotent, so the racing executor-side finally is
+                    # harmless either way.
+                    pm.engine._release_admission(ticket)
+                    raise
+            except ShedError as exc:
+                # admission refused the staged bytes BEFORE any dispatch:
+                # no offsets moved, nothing was written — back off the
+                # throttle hint and re-read the same records (counted via
+                # coproc_admission_shed_total, journaled as an ADMISSION
+                # shed episode; not a fault, so no note_failure here)
+                logger.debug(
+                    "script %s submit shed: %s", self.name, exc
                 )
-            with tracer.span("coproc.harvest.wait"):
-                reply = await asyncio.wait_for(
-                    loop.run_in_executor(ex, ticket.result),
-                    timeout=deadline_s,
-                )
+                shed_retry_s = min(exc.retry_after_ms / 1000.0, 5.0)
+            finally:
+                async with pm._launch_cond:
+                    pm._launch_inflight -= 1
+                    pm._launch_cond.notify_all()
+            if shed_retry_s is not None:
+                # backoff OUTSIDE the depth gate: under a floored depth a
+                # shed script sleeping inside the slot would head-of-line
+                # block every other script's admissible launch
+                await asyncio.sleep(shed_retry_s)
+                return False
             if self.script_id in reply.deregistered:
                 logger.warning("script %s deregistered by engine policy", self.name)
                 pm.detach_script(self.name)
@@ -196,9 +267,10 @@ class ScriptContext:
             out.extend(pa.ntp for pa in md.assignments.values())
         return out
 
-    async def _read_ntp(self, ntp: NTP) -> list:
+    async def _read_ntp(self, ntp: NTP, max_bytes: int | None = None) -> list:
         """read_ntp (script_context_frontend.cc:80-98): from last_acked+1 up
-        to the LSO, bounded by max batch size + the read semaphore."""
+        to the LSO, bounded by the read budget (max batch size scaled by
+        the group_ticks launch knob) + the read semaphore."""
         pm = self.pacemaker
         p = pm.broker.partition_manager.get(ntp)
         if p is None or not p.is_leader():
@@ -207,8 +279,15 @@ class ScriptContext:
         lso = p.last_stable_offset  # exclusive
         if start >= lso:
             return []
-        async with pm.read_sem:
-            return await p.make_reader(start, pm.max_batch_size, max_offset=lso - 1)
+        budget = max_bytes if max_bytes is not None else pm.max_batch_size
+        reserved = await pm.read_budget.acquire(budget)
+        try:
+            # read what was RESERVED, not what was asked: an oversized
+            # budget clamps to the whole account and must read that much,
+            # or the bytes in flight exceed the bound they reserved against
+            return await p.make_reader(start, reserved, max_offset=lso - 1)
+        finally:
+            pm.read_budget.release(reserved)
 
     async def _write_materialized(self, source: NTP, batches: list) -> bool:
         """do_write_materialized_partition (script_context_backend.cc:40-68):
@@ -243,12 +322,36 @@ class Pacemaker:
         offset_flush_interval_s: float = 5.0,
         idle_sleep_s: float = 0.05,
         tick_deadline_s: float = 120.0,
+        group_ticks_per_launch: int = 1,
+        launch_depth: int = 4,
     ) -> None:
         self.broker = broker
         self.engine = engine
         self.max_batch_size = max_batch_size
         self.tick_deadline_s = tick_deadline_s
-        self.read_sem = asyncio.Semaphore(max_inflight_reads)
+        # The read bound is BYTE-denominated (a FIFO-waiting account of
+        # max_inflight_reads * max_batch_size bytes), not a read-count
+        # semaphore: the group_ticks launch knob scales each read's byte
+        # budget, and a count-based gate sized for one-tick reads would
+        # let concurrent buffers reach group_ticks_cap x the configured
+        # coproc_max_inflight_bytes. An oversized single read clamps to
+        # the whole account and proceeds alone (MemoryAccount semantics).
+        self.read_budget = MemoryAccount(
+            "coproc_read",
+            max(1, int(max_inflight_reads)) * max(1, int(max_batch_size)),
+        )
+        # launch knobs (resource_mgmt / governor ADMISSION domain):
+        # group_ticks_per_launch scales how many ticks' worth of input one
+        # launch fuses (the read budget per ntp), launch_depth bounds
+        # concurrent submit+harvest regions across ALL scripts. Static
+        # here; when the engine's governor has autotune configured
+        # (CoprocApi does), launch_knobs() returns ITS hysteresis-bounded
+        # dynamic verdicts instead — the engine trades launch depth for
+        # latency as memory pressure rises.
+        self.group_ticks_per_launch = max(1, int(group_ticks_per_launch))
+        self.launch_depth = max(1, int(launch_depth))
+        self._launch_inflight = 0
+        self._launch_cond = asyncio.Condition()
         self.offset_flush_interval_s = offset_flush_interval_s
         self.idle_sleep_s = idle_sleep_s
         self._scripts: dict[str, ScriptContext] = {}
@@ -263,6 +366,19 @@ class Pacemaker:
         # timeout, so a small fixed cap would head-of-line block every
         # other script's tick behind a few wedged fetches.
         self._engine_executor: ThreadPoolExecutor | None = None
+
+    def launch_knobs(self) -> dict:
+        """Effective {"group_ticks", "launch_depth"} for the next tick:
+        the governor's dynamic verdict when its autotune is configured
+        (journaled, hysteresis-bounded), the static constructor knobs for
+        bare engines/test doubles."""
+        gov = getattr(self.engine, "governor", None)
+        if gov is not None and gov.autotune_snapshot() is not None:
+            return gov.launch_knobs()
+        return {
+            "group_ticks": self.group_ticks_per_launch,
+            "launch_depth": self.launch_depth,
+        }
 
     def tick_deadline_for(self, engine) -> float:
         """Effective tick backstop: the configured static deadline, never

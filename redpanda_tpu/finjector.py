@@ -36,8 +36,12 @@ EXCEPTION = "exception"
 DELAY = "delay"
 TERMINATE = "terminate"
 WEDGE = "wedge"
+# data-corruption fault: the SITE mutates its own bytes when the claim
+# fires (an injector can't reach into a site's buffers) — used by the raft
+# append path to prove the device-plane CRC validation rejects torn blobs
+CORRUPT = "corrupt"
 
-EFFECTS = (EXCEPTION, DELAY, WEDGE, TERMINATE)
+EFFECTS = (EXCEPTION, DELAY, WEDGE, TERMINATE, CORRUPT)
 
 
 class ProbeTriggered(Exception):
@@ -123,6 +127,22 @@ class HoneyBadger:
 
     def set_wedge(self, module: str, probe: str, count: int | None = None) -> None:
         self._arm(module, probe, WEDGE, count)
+
+    def set_corrupt(self, module: str, probe: str, count: int | None = None) -> None:
+        self._arm(module, probe, CORRUPT, count)
+
+    def corrupt_claim(self, module: str, probe: str) -> bool:
+        """True when an armed CORRUPT probe fires for this call — the SITE
+        then flips its own bytes (count budgets consume per claim, exactly
+        like the other effects). A probe armed with a non-corrupt effect
+        is NOT consumed here: the site's maybe_inject/inject_sync owns it."""
+        if not self._enabled:
+            return False
+        m = self._modules.get(module)
+        if m is None or m.armed.get(probe) != CORRUPT:
+            return False
+        effect, _ = self._claim(module, probe)
+        return effect == CORRUPT
 
     def unset(self, module: str, probe: str) -> None:
         # plain lookup, not the defaultdict: disarming a typo'd name must

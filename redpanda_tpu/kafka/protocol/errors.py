@@ -92,6 +92,10 @@ class ErrorCode(enum.IntEnum):
     fenced_instance_id = 82
     invalid_record = 87
     unstable_offset_commit = 88
+    # KIP-599; retriable — the broker-backpressure shed code the produce
+    # admission gate answers with (resource_mgmt budget plane), paired
+    # with a throttle_time_ms hint
+    throttling_quota_exceeded = 89
 
 
 class KafkaError(Exception):

@@ -87,6 +87,11 @@ class Broker:
         self.security = SecurityManager()
         self.authorizer = Authorizer(self.security.acls, set(config.superusers))
         self.sasl_enabled = config.sasl_enabled
+        # resource_mgmt budget plane + produce admission controller:
+        # installed by the application (app.py). None = admission off —
+        # bare broker harnesses keep the historical semantics.
+        self.budget_plane = None
+        self.produce_admission = None
 
     async def replicate_security_cmd(self, cmd) -> None:
         """Route a user/ACL mutation: through the controller when clustered

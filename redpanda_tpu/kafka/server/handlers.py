@@ -263,29 +263,59 @@ async def _do_handle_produce(ctx) -> dict | None:
         # posture): deliberately break the acks=-1 contract so the
         # linearizability checker can prove it detects lost acked writes.
         level = ConsistencyLevel.leader_ack
-    responses = []
-    for t in ctx.request["topics"]:
-        if not _authorized(ctx, AclOperation.write, t["name"]):
-            responses.append({
-                "name": t["name"],
-                "partitions": [
-                    _produce_partition_error(p["partition_index"], E.topic_authorization_failed)
-                    for p in t["partitions"]
-                ],
-            })
-            continue
-        parts = await asyncio.gather(
-            *(
-                _produce_one(ctx.broker, t["name"], p, level, ctx.api_version)
-                for p in t["partitions"]
-            )
-        )
-        responses.append({"name": t["name"], "partitions": list(parts)})
     n_bytes = sum(
         len(p.get("records") or b"")
         for t in ctx.request["topics"]
         for p in t["partitions"]
     )
+    # Admission (resource_mgmt budget plane): reserve the record bytes
+    # from the kafka_produce account BEFORE anything replicates —
+    # shed-before-ack means a shed request's records never reach a log
+    # and can never be read; the client sees the retriable KIP-599
+    # throttling code plus the occupancy-ramped throttle hint. Bytes
+    # release when the replicate round (and so the inflight copy) is done.
+    ctrl = getattr(ctx.broker, "produce_admission", None)
+    reserved = 0
+    if ctrl is not None:
+        reserved, retry_ms = ctrl.try_admit(n_bytes)
+        if n_bytes > 0 and reserved == 0:
+            if acks == 0:
+                return None  # no response on the wire, shed still counted
+            responses = [
+                {
+                    "name": t["name"],
+                    "partitions": [
+                        _produce_partition_error(
+                            p["partition_index"], E.throttling_quota_exceeded
+                        )
+                        for p in t["partitions"]
+                    ],
+                }
+                for t in ctx.request["topics"]
+            ]
+            return {"responses": responses, "throttle_time_ms": retry_ms}
+    try:
+        responses = []
+        for t in ctx.request["topics"]:
+            if not _authorized(ctx, AclOperation.write, t["name"]):
+                responses.append({
+                    "name": t["name"],
+                    "partitions": [
+                        _produce_partition_error(p["partition_index"], E.topic_authorization_failed)
+                        for p in t["partitions"]
+                    ],
+                })
+                continue
+            parts = await asyncio.gather(
+                *(
+                    _produce_one(ctx.broker, t["name"], p, level, ctx.api_version)
+                    for p in t["partitions"]
+                )
+            )
+            responses.append({"name": t["name"], "partitions": list(parts)})
+    finally:
+        if ctrl is not None:
+            ctrl.release(reserved)
     throttle = ctx.broker.quota_manager.record_produce(ctx.header.client_id, n_bytes)
     if acks == 0:
         return None

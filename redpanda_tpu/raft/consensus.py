@@ -22,6 +22,8 @@ import random
 import struct
 import time
 
+from redpanda_tpu.finjector import honey_badger
+from redpanda_tpu.metrics import registry
 from redpanda_tpu.models.fundamental import NTP
 from redpanda_tpu.observability import probes
 from redpanda_tpu.observability.trace import tracer
@@ -41,6 +43,18 @@ from redpanda_tpu.storage.kvstore import KeySpace
 from redpanda_tpu.storage.snapshot import SnapshotManager
 
 logger = logging.getLogger("rptpu.raft")
+
+# chaos probe: one byte of a received append blob flips before validation
+# (finjector CORRUPT effect — loadgen crc_chaos drives it)
+honey_badger.register_probe("raft", "append_blob")
+
+# follower-side batched-CRC rejections (raft/device_plane.py, config
+# raft_device_crc_validate): the federated scrape must SEE torn appends
+# being refused, not just a leader-side retry
+raft_crc_rejected = registry.counter(
+    "raft_crc_rejected_batches_total",
+    "Append-entries batches rejected by the follower CRC validation",
+)
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -684,6 +698,15 @@ class Consensus:
     # ---------------------------------------------------------------- append RPC
     async def handle_append_entries(self, req: dict) -> dict:
         blob = req["batches"]
+        # chaos probe (finjector CORRUPT): flip one byte of the received
+        # blob BEFORE validation, as a torn wire/disk read would — the
+        # device-plane CRC check below must reject it, the leader's
+        # recovery resend repairs it, and quorum acks ride the healthy
+        # replicas meanwhile (loadgen crc_chaos scenario)
+        if blob and honey_badger.enabled and honey_badger.corrupt_claim(
+            "raft", "append_blob"
+        ):
+            blob = blob[:-1] + bytes([blob[-1] ^ 0xFF])
         crc_failures = 0
         batches = None
         if blob and device_plane.crc_validate_enabled():
@@ -705,6 +728,7 @@ class Consensus:
                 )
                 crc_failures = int((~ok).sum())
                 if crc_failures:
+                    raft_crc_rejected.inc(crc_failures)
                     logger.warning(
                         "group %d: rejecting append, %d/%d batch CRC "
                         "failures", self.group, crc_failures, len(ok),
@@ -1033,6 +1057,20 @@ class _ReplicateBatcher:
         loop = asyncio.get_event_loop()
         enqueued: asyncio.Future = loop.create_future()
         replicated: asyncio.Future = loop.create_future()
+        # raft account (resource_mgmt budget plane): batcher entries are
+        # bounded bytes, held from submit until the append phase resolves
+        # either way. Waiting is bounded backpressure (submitters sit
+        # behind the kafka produce admission gate); plane-less processes
+        # skip it entirely.
+        from redpanda_tpu.resource_mgmt import budgets as _budgets
+
+        acct = _budgets.account_or_none("raft")
+        if acct is not None:
+            n = sum(b.size_bytes for b in batches)
+            reserved = await acct.acquire(n)
+            enqueued.add_done_callback(
+                lambda _f, a=acct, r=reserved: a.release(r)
+            )
         # sample the submitter's ambient trace as the round's owner trace
         # (the flush task itself is deliberately detached); latest non-None
         # submitter wins — ONE resolvable exemplar per flush round is the

@@ -15,98 +15,59 @@ memory units before parsing (connection_context.cc:32). Here:
   per-subsystem attribution (asyncio has no preemptive scheduler to donate
   shares to, so groups bound concurrent tasks and publish aggregate
   runtime to the metrics registry instead).
+
+The BUDGET PLANE (budgets.py + admission.py, re-exported here) grows this
+into the process-wide split: per-subsystem ``MemoryAccount``s carved from
+one configurable total, a derived ok/warn/critical ``MemoryPressure``
+signal, and admission controllers that shed with retriable backpressure
+before the ack (kafka produce, coproc submit, rpc dispatch) instead of
+queueing unboundedly. See budgets.py's docstring for the account map.
 """
 
 from __future__ import annotations
 
 import asyncio
-from collections import deque
 import time
 from dataclasses import dataclass
 
+from redpanda_tpu.resource_mgmt.budgets import (  # noqa: F401  (re-exports)
+    BudgetPlane,
+    MemoryAccount,
+    PRESSURE_CRITICAL,
+    PRESSURE_OK,
+    PRESSURE_WARN,
+)
+from redpanda_tpu.resource_mgmt.admission import (  # noqa: F401
+    AdmissionController,
+    InflightGate,
+    ShedError,
+)
 
-class MemoryBudget:
+
+class MemoryBudget(MemoryAccount):
     """Async byte budget: acquire(n) waits until n bytes are available.
 
     A single request larger than the whole budget is clamped to the budget
     (it proceeds alone rather than deadlocking), matching the reference's
     semaphore-units behavior for oversized requests.
-    """
+
+    ONE implementation, not two: this is the budget plane's
+    ``MemoryAccount`` (budgets.py) under its historical name — the FIFO
+    waiter machinery with its delicate cancel-after-grant and
+    dead-loop-head liveness rules lives there alone, plus the
+    available/in_use views this class's consumers (the kafka frame
+    memory gate) read."""
 
     def __init__(self, limit_bytes: int):
-        self.limit = limit_bytes
-        self._available = limit_bytes
-        # FIFO of (n, future) waiters, granted synchronously by release():
-        # no tasks, no loop lookups — release is safe from any context ON
-        # THE LOOP'S THREAD, including loopless shutdown paths (a lost
-        # wakeup here would hang the produce-path backpressure gate
-        # forever). Cross-thread release is NOT supported: set_result
-        # wakes the waiter via its loop's call_soon, which is not
-        # thread-safe.
-        self._waiters: deque[tuple[int, asyncio.Future]] = deque()
+        super().__init__("memory_budget", limit_bytes)
 
     @property
     def available(self) -> int:
-        return self._available
+        return self.limit - self.held
 
     @property
     def in_use(self) -> int:
-        return self.limit - self._available
-
-    async def acquire(self, n: int) -> int:
-        """Returns the amount actually reserved (clamped to the limit)."""
-        n = min(n, self.limit)
-        # FIFO fairness: even if n fits, queue behind existing waiters so a
-        # stream of small requests cannot starve a parked large one
-        if self._available >= n and not self._waiters:
-            self._available -= n
-            return n
-        fut = asyncio.get_running_loop().create_future()
-        self._waiters.append((n, fut))
-        try:
-            await fut  # resolved by _drain with the bytes already deducted
-        except asyncio.CancelledError:
-            if fut.done() and not fut.cancelled():
-                # grant landed before the cancellation: hand it back
-                self.release(n)
-            else:
-                try:
-                    self._waiters.remove((n, fut))
-                except ValueError:
-                    pass
-                self._drain()  # our slot may unblock the next waiter
-            raise
-        return n
-
-    def release(self, n: int) -> None:
-        self._available = min(self._available + n, self.limit)
-        self._drain()
-
-    def _drain(self) -> None:
-        while self._waiters:
-            n, fut = self._waiters[0]
-            # liveness BEFORE the size gate: a dead head larger than the
-            # budget can never remove itself (its loop is closed, its
-            # CancelledError handler will never run) and would otherwise
-            # block every live waiter behind it forever
-            if fut.cancelled():
-                self._waiters.popleft()
-                continue
-            try:
-                dead = fut.get_loop().is_closed()
-            except RuntimeError:
-                dead = True
-            if dead:
-                # a waiter whose loop is gone can never run: granting it
-                # would leak the bytes AND set_result would raise from the
-                # closed loop's call_soon — skip it like a cancelled one
-                self._waiters.popleft()
-                continue
-            if n > self._available:
-                break  # live head must wait; FIFO order preserved
-            self._waiters.popleft()
-            self._available -= n
-            fut.set_result(None)
+        return self.held
 
 
 @dataclass

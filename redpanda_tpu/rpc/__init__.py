@@ -32,6 +32,7 @@ from redpanda_tpu.rpc.transport import (
     BackoffPolicy,
     ConnectionCache,
     ReconnectTransport,
+    RpcBackpressure,
     RpcError,
     Transport,
     TransportClosed,
@@ -43,5 +44,6 @@ __all__ = [
     "U32", "U64", "Envelope", "Map", "Optional", "S", "Struct", "Vector",
     "Server", "SimpleProtocol", "Client", "MethodDef", "ServiceDef",
     "ServiceHandler", "BackoffPolicy", "ConnectionCache", "ReconnectTransport",
-    "RpcError", "Transport", "TransportClosed", "Header", "WireError",
+    "RpcBackpressure", "RpcError", "Transport", "TransportClosed", "Header",
+    "WireError",
 ]

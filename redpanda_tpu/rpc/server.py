@@ -33,9 +33,15 @@ class SimpleProtocol:
 
     name = "vectorized internal rpc protocol"
 
-    def __init__(self, node_id: int | None = None) -> None:
+    def __init__(self, node_id: int | None = None, inflight_gate=None) -> None:
         self._methods: dict[int, ServiceHandler] = {}
         self.node_id = node_id
+        # resource_mgmt.admission.InflightGate (or None = uncapped, the
+        # historical semantics): bounds concurrent dispatched requests and
+        # their body bytes, shedding WHOLE requests at dispatch with
+        # STATUS_BACKPRESSURE before the handler runs — a shed request did
+        # nothing, so peers resend safely (transport.RpcBackpressure)
+        self.inflight_gate = inflight_gate
 
     def register_service(self, handler: ServiceHandler) -> None:
         for mid in handler.method_ids():
@@ -52,6 +58,23 @@ class SimpleProtocol:
                     h, ctx, body = await wire.read_message(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
+                reserved = None
+                if self.inflight_gate is not None:
+                    reserved = self.inflight_gate.try_enter(len(body))
+                    if reserved is None:
+                        # shed at dispatch: answer backpressure without
+                        # spawning the handler (counted by the gate;
+                        # retriable by contract — nothing ran)
+                        out = wire.frame(
+                            b"", wire.STATUS_BACKPRESSURE, h.correlation_id
+                        )
+                        async with write_lock:
+                            try:
+                                writer.write(out)
+                                await writer.drain()
+                            except (ConnectionResetError, BrokenPipeError):
+                                return
+                        continue
                 # Handlers overlap across requests on one connection; each
                 # response is written atomically under the lock.
                 t = asyncio.ensure_future(
@@ -59,6 +82,16 @@ class SimpleProtocol:
                 )
                 pending.add(t)
                 t.add_done_callback(pending.discard)
+                if reserved is not None:
+                    # release via done-callback, NOT inside the handler: a
+                    # task cancelled before its first step (connection
+                    # torn down in the same read that delivered the
+                    # frame) never enters the coroutine body, so an
+                    # in-handler finally would leak the slot — callbacks
+                    # run for cancelled tasks too
+                    t.add_done_callback(
+                        lambda _t, g=self.inflight_gate, r=reserved: g.leave(r)
+                    )
         finally:
             for t in pending:
                 t.cancel()

@@ -37,6 +37,17 @@ class RpcError(Exception):
         self.status = status
 
 
+class RpcBackpressure(RpcError):
+    """The peer shed the request at dispatch (STATUS_BACKPRESSURE): its
+    handler never ran, so a resend is always safe. Raft's retry loops
+    treat this like any transient send failure — backoff and resend —
+    which is exactly the open-loop-overload contract: shed, counted,
+    never lost."""
+
+    def __init__(self, msg: str = "") -> None:
+        super().__init__(wire.STATUS_BACKPRESSURE, msg or "peer backpressure")
+
+
 class TransportClosed(Exception):
     pass
 
@@ -75,6 +86,8 @@ class Transport:
                     continue
                 if h.meta == wire.STATUS_SUCCESS:
                     fut.set_result(body)
+                elif h.meta == wire.STATUS_BACKPRESSURE:
+                    fut.set_exception(RpcBackpressure())
                 else:
                     fut.set_exception(RpcError(h.meta))
         except asyncio.CancelledError:

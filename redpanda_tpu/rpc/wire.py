@@ -54,6 +54,10 @@ COMPRESSION_ZSTD = 1
 STATUS_SUCCESS = 200
 STATUS_METHOD_NOT_FOUND = 404
 STATUS_REQUEST_TIMEOUT = 408
+# server shed the request at dispatch (rpc inflight cap, resource_mgmt
+# budget plane): retriable backpressure — the handler never ran, so the
+# caller may safely resend
+STATUS_BACKPRESSURE = 429
 STATUS_SERVER_ERROR = 500
 
 # Compress payloads above this size when the transport negotiated zstd.

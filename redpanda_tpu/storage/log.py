@@ -167,11 +167,27 @@ class DiskLog:
         if not batches:
             off = self.offsets()
             return AppendResult(off.dirty_offset + 1, off.dirty_offset, 0)
+        # storage account (resource_mgmt budget plane): append-buffer bytes
+        # inflight through this call. Waiting (not shedding) is correct
+        # here — every producer of appends sits behind an admission gate
+        # (kafka produce, coproc submit, rpc dispatch), so the wait is
+        # bounded backpressure and peak occupancy never breaches the
+        # account. Plane-less processes skip both branches.
+        from redpanda_tpu.resource_mgmt import budgets as _budgets
+
+        acct = _budgets.account_or_none("storage")
+        reserved = 0
+        if acct is not None:
+            reserved = await acct.acquire(
+                sum(b.size_bytes for b in batches)
+            )
         t_probe = time.perf_counter()
         try:
             with tracer.span("storage.append"):
                 return await self._append_locked(batches, term, assign_offsets)
         finally:
+            if acct is not None:
+                acct.release(reserved)
             probes.observe_us(probes.storage_append_hist, t_probe)
 
     async def _append_locked(

@@ -1,0 +1,43 @@
+"""Fixture for the backpressure checker (BPR1401/1402/1403).
+
+Linted with relpath redpanda_tpu/kafka/backpressure.py so the hot-path
+scope applies. Line numbers are asserted exactly in test_pandalint.py.
+"""
+import asyncio
+import queue
+from asyncio import Queue as AQueue
+
+
+class Producer:
+    def __init__(self):
+        self.q_unbounded = asyncio.Queue()                     # BPR1401 line 13
+        self.q_zero = queue.Queue(maxsize=0)                   # BPR1401 line 14
+        self.q_simple = queue.SimpleQueue()                    # BPR1401 line 15
+        self.q_bounded = asyncio.Queue(maxsize=64)             # clean
+        self.q_dynamic = queue.Queue(self._cap())              # clean: non-literal
+        self._pending_batches = []                             # BPR1403's buffer
+        self._done = []                                        # clean: not bufferish
+
+    def _cap(self):
+        return 8
+
+    def push(self, item):
+        self.q_unbounded.put_nowait(item)                      # BPR1402 line 25
+        self.q_bounded.put_nowait(item)                        # clean: bounded
+        self.unknown.put_nowait(item)                          # clean: unresolvable
+
+    async def buffer(self, item):
+        self._pending_batches.append(item)                     # BPR1403 line 30
+        self._done.append(item)                                # clean: name filter
+
+    async def budgeted(self, account, item):
+        reserved = account.try_acquire(len(item))              # the budget escape
+        if reserved:
+            self._pending_batches.append(item)                 # clean: admitted
+
+
+bare = AQueue()                                                # BPR1401 line 39
+
+
+def module_push(item):
+    bare.put_nowait(item)                                      # BPR1402 line 43

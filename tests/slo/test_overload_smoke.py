@@ -1,0 +1,58 @@
+"""Overload gate smoke: the tier-1 slice of the open-loop overload suite.
+
+Drives tools/loadgen.py's ``overload_smoke`` scenario — a real in-process
+broker with a deliberately tiny budget plane, closed-loop calibration then
+open-loop arrivals at 2x the measured knee — and asserts the judged gates
+end to end: throughput plateaus (no collapse), the CO-safe admitted p99
+stays governed, sheds are COUNTED (client-observed == server counter,
+journaled as episodes), the acked-write verification is EXACT (zero loss,
+zero duplicates, no shed record readable), and no account breached its
+budget. The proc-backend acceptance run is ``overload_64p``
+(SLO_r13_overload.json).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from redpanda_tpu.finjector import honey_badger
+from redpanda_tpu.observability import probes, tracer
+
+from tools.loadgen import run_overload_async
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    yield
+    honey_badger.disable()
+    tracer.configure(enabled=False)
+    tracer.reset()
+    probes.reset_exemplars()
+
+
+def test_overload_smoke_sheds_counted_and_exact(tmp_path):
+    report = asyncio.run(run_overload_async(
+        "overload_smoke", base_dir=str(tmp_path),
+        # keep the tier-1 slice short; the knobs still guarantee overload
+        overrides={"calibrate_s": 1.5, "duration_s": 3.0},
+    ))
+    assert report["pass"] is True, report["gates"]
+    ol = report["open_loop"]
+    # the flood genuinely exceeded capacity AND the broker genuinely shed
+    assert ol["shed_ops"] > 0
+    assert ol["acked_ops"] > 0
+    # every client-observed shed is a counted server-side shed
+    assert report["shed_total_server"] >= ol["shed_ops"]
+    # the journal reconstructs the episode(s)
+    verdicts = {e["verdict"] for e in report["admission_journal"]}
+    assert "shed" in verdicts
+    # acked-write verification: exact, and shed records never readable
+    v = report["verification"]
+    assert v["exact"] and v["missing"] == 0 and v["duplicated"] == 0
+    assert v["shed_keys"] > 0 and v["shed_visible"] == 0
+    # per-account peaks within budget on every node
+    for node in report["resources"]:
+        for acct in node["accounts"].values():
+            assert acct["peak_bytes"] <= acct["limit_bytes"]

@@ -211,6 +211,62 @@ def test_trace_ctx_transport_stays_clean():
     assert not any(f.rule.startswith("TRC") for f in findings)
 
 
+def test_backpressure_rules_exact_lines():
+    got = _active(
+        _lint(
+            os.path.join(FIXTURES, "backpressure.py"),
+            relpath="redpanda_tpu/kafka/backpressure.py",
+        )
+    )
+    bpr = sorted(f for f in got if f[0].startswith("BPR"))
+    assert bpr == [
+        ("BPR1401", 13),  # asyncio.Queue() no capacity
+        ("BPR1401", 14),  # queue.Queue(maxsize=0) — the unbounded spelling
+        ("BPR1401", 15),  # SimpleQueue: unboundable by design
+        ("BPR1401", 39),  # module-level from-import alias AQueue()
+        ("BPR1402", 25),  # put_nowait onto the unbounded self attr
+        ("BPR1402", 43),  # put_nowait onto the module-level unbounded queue
+        ("BPR1403", 30),  # async list-append buffer, no budget call
+    ], bpr
+
+
+def test_backpressure_scope_and_escapes():
+    """Bounded/dynamic capacities, unresolvable receivers, non-bufferish
+    list names and budget-acquiring functions all stay clean; outside the
+    hot-path packages the checker is silent wholesale."""
+    findings = _lint(
+        os.path.join(FIXTURES, "backpressure.py"),
+        relpath="redpanda_tpu/kafka/backpressure.py",
+    )
+    bpr_lines = {f.line for f in findings if f.rule.startswith("BPR")}
+    # q_bounded, q_dynamic, bounded put_nowait, unresolvable put_nowait,
+    # non-bufferish append, budgeted append
+    for clean_line in (16, 17, 26, 27, 31, 36):
+        assert clean_line not in bpr_lines, sorted(bpr_lines)
+    # same file linted OUTSIDE the hot-path scope: nothing fires
+    outside = _lint(
+        os.path.join(FIXTURES, "backpressure.py"),
+        relpath="redpanda_tpu/observability/backpressure.py",
+    )
+    assert not any(f.rule.startswith("BPR") for f in outside)
+
+
+def test_backpressure_in_tree_pragmas_reasoned():
+    """The two sanctioned in-tree unbounded queues (the mask-harvester
+    queue bounded by launch_depth admission, the one-job-per-fetch-worker
+    queue) carry reasoned pragmas — suppressed, not invisible."""
+    for rel in (
+        "redpanda_tpu/coproc/engine.py",
+        "redpanda_tpu/coproc/faults.py",
+    ):
+        findings = _lint(os.path.join(REPO, *rel.split("/")), relpath=rel)
+        bpr = [f for f in findings if f.rule.startswith("BPR")]
+        assert bpr, rel
+        assert all(f.suppressed for f in bpr), [
+            (f.rule, f.line) for f in bpr if not f.suppressed
+        ]
+
+
 def test_mesh_ctx_rules_exact_lines():
     got = _active(
         _lint(

@@ -524,6 +524,9 @@ class TestColumnCache:
             assert st == {
                 "hits": 0, "misses": 0, "entries": 0, "bytes": 0,
                 "budget_bytes": 8 << 20, "evictions": 0, "invalidations": 0,
+                # memory-pressure posture (resource_mgmt): reset clears it
+                "effective_budget_bytes": 8 << 20, "pressure": False,
+                "pressure_evictions": 0,
             }
         finally:
             engine.shutdown()

@@ -168,6 +168,86 @@ SCENARIOS: dict[str, dict] = {
         "chaos": {"module": "coproc", "probe": "device_dispatch",
                   "effect": "delay", "delay_ms": 800},
     },
+    # Device-plane CRC chaos (ROADMAP item 2 follow-on c): a 3-node proc
+    # cluster with follower batched-CRC validation ON; --chaos arms the
+    # finjector CORRUPT probe so received append blobs arrive torn on
+    # every node for its first N appends. The device plane must REJECT
+    # them (raft_crc_rejected_batches_total moves in the federated
+    # scrape), the leader's resend repairs each one, and acked writes
+    # ride the healthy quorum meanwhile (workloads_ok requires both).
+    "crc_chaos": {
+        "nodes": 3,
+        "partitions": 16,
+        "replication": 3,
+        "duration_s": 10.0,
+        "producers": 8,
+        "produce_rate": 6.0,
+        "records_per_op": 8,
+        "record_bytes": 256,
+        "group_members": 0,
+        "rebalance_every_s": 0.0,
+        "eos_pairs": 1,
+        "eos_abort_every": 4,
+        "transform_readers": 0,
+        "tiered_readers": 0,
+        "coproc": False,
+        "extra_config": {"raft_device_crc_validate": True},
+        "objectives": _objectives(15_000, 30_000, 8_000, 15_000, 8_000,
+                                  8_000, 20),
+        "chaos": {"module": "raft", "probe": "append_blob",
+                  "effect": "corrupt", "count": 30},
+        "chaos_assert_metric": "raft_crc_rejected_batches_total",
+    },
+}
+
+# Open-loop overload family (ROADMAP item 4 acceptance): arrivals are
+# scheduled at overload_factor x the MEASURED closed-loop capacity and
+# never wait for completions (coordinated-omission-safe: each acked op's
+# latency is measured from its SCHEDULED arrival). The broker memory
+# total is shrunk so the produce admission gate actually bites — the gate
+# is that throughput plateaus at the knee, admitted p99 stays governed,
+# sheds are counted (never lost: acked-write verification is EXACT), no
+# account breaches its budget, and the decision journal reconstructs the
+# shed episodes. Run via --scenario overload_* (run_overload_async).
+OVERLOAD_SCENARIOS: dict[str, dict] = {
+    # seconds-long single-broker smoke (tier-1: tests/slo/test_overload_smoke.py)
+    "overload_smoke": {
+        "nodes": 1,
+        "partitions": 4,
+        "replication": 1,
+        "calibrate_s": 2.0,
+        "duration_s": 4.0,
+        "producers": 4,
+        "records_per_op": 8,
+        "record_bytes": 1024,
+        "overload_factor": 2.0,
+        "coproc": False,
+        "admitted_p99_ms": 10_000,
+        "plateau_floor": 0.5,
+        "extra_config": {
+            # small plane so the flood actually exhausts kafka_produce
+            "resource_memory_total_mb": 4,
+        },
+    },
+    # the acceptance scenario: a REAL broker process (proc backend),
+    # 64-partition topic, >= 2x measured capacity — SLO_r13_overload.json
+    "overload_64p": {
+        "nodes": 1,
+        "partitions": 64,
+        "replication": 1,
+        "calibrate_s": 6.0,
+        "duration_s": 15.0,
+        "producers": 8,
+        "records_per_op": 8,
+        "record_bytes": 1024,
+        "overload_factor": 2.0,
+        "coproc": False,
+        "admitted_p99_ms": 10_000,
+        "plateau_floor": 0.8,
+        "extra_config": {
+            "resource_memory_total_mb": 8,
+        },
+    },
 }
 
 TOPIC = "loadgen"
@@ -250,10 +330,22 @@ class Stack:
                     "cloud_storage_secret_key": "s",
                     "cloud_storage_segment_max_upload_interval_sec": 1,
                 })
+            # per-scenario broker knobs (the overload family shrinks
+            # resource_memory_total_mb so admission actually bites)
+            sets.update(s.get("extra_config") or {})
             for k, v in sets.items():
                 c.set(k, v)
             configs.append(c)
         return configs
+
+    async def archival_run_once(self) -> int:
+        """One reconcile+upload pass on every node; returns total uploads."""
+        total = 0
+        for a in self.apps:
+            arch = getattr(a, "archival", None)
+            if arch is not None:
+                total += await arch.run_once()
+        return total
 
     async def start(self) -> "Stack":
         from redpanda_tpu.app import Application
@@ -344,16 +436,17 @@ class ProcStack:
     in-process registry — removing the one-loop ceiling on offered load:
     the brokers burn their own cores, and the judged histograms live where
     the latency happened. Chaos arming and transform-activation polling go
-    through each node's real admin API. Tiered-storage scenarios are
-    inproc-only (archival run_once has no admin surface yet), so
-    ``tiered_readers`` is forced to 0 in this mode."""
+    through each node's real admin API. Tiered-storage scenarios drive
+    archival through the admin surface (POST /v1/archival/run_once), so
+    ``tiered_readers`` work in this mode too — the S3 imposter runs in
+    THIS process and the broker processes reach it over loopback."""
 
     backend = "proc"
 
     def __init__(self, scenario: dict, base_dir: str, imposter=None):
-        assert imposter is None, "tiered scenarios are inproc-only"
         self.scenario = scenario
         self.base_dir = base_dir
+        self.imposter = imposter
         self.cluster = None
         self.kafka_ports: list[int] = []
         self.admin_ports: list[int] = []
@@ -373,6 +466,17 @@ class ProcStack:
             "trace_enabled": True,
             "trace_slow_threshold_ms": max(1, int(min(thresholds))),
         }
+        if self.imposter is not None:
+            extra.update({
+                "cloud_storage_enabled": True,
+                "cloud_storage_bucket": "loadgen",
+                "cloud_storage_api_endpoint":
+                    f"http://127.0.0.1:{self.imposter.port}",
+                "cloud_storage_access_key": "k",
+                "cloud_storage_secret_key": "s",
+                "cloud_storage_segment_max_upload_interval_sec": 1,
+            })
+        extra.update(s.get("extra_config") or {})
         self.cluster = await ProcCluster(
             self.base_dir, n=s["nodes"], extra_config=extra
         ).start()
@@ -408,6 +512,21 @@ class ProcStack:
                 ):
                     return False
         return True
+
+    async def archival_run_once(self) -> int:
+        """Drive one archival pass per node through the admin surface."""
+        import aiohttp
+
+        total = 0
+        async with aiohttp.ClientSession() as sess:
+            for port in self.admin_ports:
+                async with sess.post(
+                    f"http://127.0.0.1:{port}/v1/archival/run_once",
+                    timeout=aiohttp.ClientTimeout(total=60),
+                ) as r:
+                    if r.status == 200:
+                        total += (await r.json()).get("uploads", 0)
+        return total
 
     async def stop(self) -> None:
         if self.cluster is not None:
@@ -673,12 +792,10 @@ async def _setup_tiered(stack: Stack, client) -> int:
             [_payload(999, seq, j, 512) for j in range(4)],
             acks=-1,
         )
-    # archive the closed segments now (deterministic, no interval wait)
-    uploaded = 0
-    for a in stack.apps:
-        arch = getattr(a, "archival", None)
-        if arch is not None:
-            uploaded += await arch.run_once()
+    # archive the closed segments now (deterministic, no interval wait):
+    # in-proc stacks call the scheduler directly, the proc backend goes
+    # through POST /v1/archival/run_once on every node
+    uploaded = await stack.archival_run_once()
     if uploaded == 0:
         raise RuntimeError("tiered setup: nothing archived")
     hwm = await client.latest_offset(TIERED_TOPIC, 0)
@@ -711,7 +828,12 @@ async def _arm_chaos(stack, chaos: dict) -> dict:
     import aiohttp
 
     delay_ms = int(chaos.get("delay_ms", 50))
-    qs = f"?delay_ms={delay_ms}" if chaos["effect"] == "delay" else ""
+    params = []
+    if chaos["effect"] == "delay":
+        params.append(f"delay_ms={delay_ms}")
+    if chaos.get("count"):
+        params.append(f"count={int(chaos['count'])}")
+    qs = ("?" + "&".join(params)) if params else ""
     body = None
     async with aiohttp.ClientSession() as s:
         for port in stack.admin_ports:
@@ -744,6 +866,41 @@ async def _disarm_chaos(stack, chaos: dict) -> None:
                     pass
             except Exception:
                 pass  # a node lost mid-chaos: nothing to disarm there
+
+
+async def _scrape_counter_total(stack, name: str) -> float:
+    """Sum one counter series across every node's /metrics (uniform for
+    both backends: in-process stacks expose admin /metrics too)."""
+    import re
+
+    import aiohttp
+
+    # the registry renders with its exposition prefix (redpanda_tpu_...)
+    pat = re.compile(
+        rf"^(?:redpanda_tpu_)?{re.escape(name)}(?:\{{[^}}]*\}})? "
+        rf"([0-9.eE+-]+)$",
+        re.MULTILINE,
+    )
+    # in-process stacks share ONE registry: scraping every admin port
+    # would multiply the same counter by the node count
+    ports = (
+        stack.admin_ports[:1]
+        if stack.backend == "inproc"
+        else stack.admin_ports
+    )
+    total = 0.0
+    async with aiohttp.ClientSession() as sess:
+        for port in ports:
+            try:
+                async with sess.get(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as r:
+                    text = await r.text()
+            except Exception:
+                continue
+            total += sum(float(m) for m in pat.findall(text))
+    return total
 
 
 async def _resolve_exemplars(stack: Stack, report: dict) -> None:
@@ -823,8 +980,6 @@ async def run_scenario_async(
     for key in ("producers", "group_members", "eos_pairs",
                 "transform_readers", "tiered_readers"):
         s[key] = max(0 if s[key] == 0 else 1, int(s[key] * clients_scale))
-    if backend == "proc":
-        s["tiered_readers"] = 0  # see ProcStack docstring
 
     tmp = None
     if base_dir is None:
@@ -994,6 +1149,16 @@ async def run_scenario_async(
         else:
             report = slo.evaluate(spec, baseline=baseline)
         await _resolve_exemplars(stack, report)
+        # scenario-declared fault-path proof: the chaos run must show its
+        # counter moved (e.g. crc_chaos: corrupted appends REJECTED by the
+        # follower CRC plane, visible in the federated scrape)
+        chaos_metric = None
+        if chaos_info is not None and s.get("chaos_assert_metric"):
+            mname = s["chaos_assert_metric"]
+            chaos_metric = {
+                "name": mname,
+                "total": await _scrape_counter_total(stack, mname),
+            }
         report.update({
             "backend": stack.backend,
             "chaos": chaos_info,
@@ -1017,13 +1182,16 @@ async def run_scenario_async(
                 ),
             },
             "eos_check": eos_check,
+            "chaos_metric": chaos_metric,
             # the lossless-workload bar: EOS stays exactly-once always;
             # client-visible produce ERRORS (unacked, retriable) are
             # expected bounded degradation under chaos, but a CLEAN run
-            # must not see any
+            # must not see any; a declared chaos metric must have MOVED
+            # (the fault actually exercised its detection path)
             "workloads_ok": (
                 (eos_check is None or eos_check["exact"])
                 and (chaos_info is not None or stats["produce_errors"] == 0)
+                and (chaos_metric is None or chaos_metric["total"] > 0)
             ),
         })
         return report
@@ -1064,6 +1232,364 @@ def run_scenario(name: str, **kw) -> dict:
     return asyncio.run(run_scenario_async(name, **kw))
 
 
+# ================================================================ overload
+def _overload_payload(i: int, seq: int, j: int, size: int) -> bytes:
+    """Unique, parseable key first so the verification sweep can extract
+    it with a prefix scan instead of a JSON parse per record."""
+    doc = '{"k":"%d-%d-%d","pad":"' % (i, seq, j)
+    pad = max(0, size - len(doc) - 2)
+    return (doc + "x" * pad + '"}').encode()
+
+
+def _overload_keys(value: bytes) -> str | None:
+    if not value.startswith(b'{"k":"'):
+        return None
+    end = value.find(b'"', 6)
+    return value[6:end].decode() if end > 0 else None
+
+
+async def _closed_loop_producer(i, client, partitions, k, size, stop, counter):
+    """Calibration phase: back-to-back acked produces, no schedule — the
+    aggregate acked rate IS the closed-loop knee the open-loop phase
+    overloads against. Calibration keys use an id offset so the
+    verification sweep never confuses them with measured-phase records."""
+    part = i % partitions
+    seq = 0
+    while not stop.is_set():
+        part = (part + 1) % partitions
+        values = [
+            _overload_payload(100_000 + i, seq, j, size) for j in range(k)
+        ]
+        seq += 1
+        try:
+            await client.produce(TOPIC, part, values, acks=-1)
+            counter["records"] += k
+        except Exception:
+            counter["errors"] += 1
+
+
+async def _open_loop_producer(
+    i, client, partitions, op_rate, k, size, stop, ostats, lats,
+    acked_keys, shed_keys, max_outstanding=256,
+):
+    """Open-loop overload: arrivals fire on a fixed schedule and NEVER
+    wait for completions — each send runs as its own task, and an acked
+    op's latency is measured from its SCHEDULED arrival time, so slow
+    responses cannot suppress the arrivals that would have observed them
+    (coordinated-omission-safe). A full outstanding window drops the
+    arrival AT THE CLIENT and counts it (bounded client memory, no silent
+    deferral of the schedule)."""
+    from redpanda_tpu.kafka.protocol.errors import ErrorCode, KafkaError
+
+    loop = asyncio.get_event_loop()
+    interval = 1.0 / max(op_rate, 0.001)
+    next_t = loop.time() + (i % 64) / 64.0 * interval
+    outstanding: set = set()
+    seq = 0
+
+    async def one(part, values, keys, sched_t):
+        try:
+            await client.produce(TOPIC, part, values, acks=-1)
+        except KafkaError as e:
+            if e.code == ErrorCode.throttling_quota_exceeded:
+                ostats["shed_ops"] += 1
+                shed_keys.update(keys)
+            else:
+                ostats["error_ops"] += 1
+            return
+        except Exception:
+            ostats["error_ops"] += 1
+            return
+        lats.append(loop.time() - sched_t)
+        ostats["acked_ops"] += 1
+        ostats["acked_records"] += len(values)
+        acked_keys.update(keys)
+
+    while not stop.is_set():
+        now = loop.time()
+        if next_t > now:
+            if await _sleep_or_stop(stop, next_t - now):
+                break
+        sched_t = next_t
+        next_t += interval
+        if next_t < loop.time() - 2.0:
+            # the event loop itself fell behind the schedule (client-side
+            # saturation): re-anchor rather than emitting a burst that
+            # would measure the CLIENT, not the broker
+            skipped = int((loop.time() - next_t) / interval) + 1
+            ostats["client_dropped"] += skipped
+            next_t += skipped * interval
+        if len(outstanding) >= max_outstanding:
+            ostats["client_dropped"] += 1
+            continue
+        part = (i + seq) % partitions
+        keys = [f"{i}-{seq}-{j}" for j in range(k)]
+        values = [_overload_payload(i, seq, j, size) for j in range(k)]
+        seq += 1
+        t = asyncio.create_task(one(part, values, keys, sched_t))
+        outstanding.add(t)
+        t.add_done_callback(outstanding.discard)
+    if outstanding:
+        await asyncio.gather(*outstanding, return_exceptions=True)
+
+
+def _quantile_ms(lats: list[float], q: float) -> float:
+    if not lats:
+        return 0.0
+    xs = sorted(lats)
+    idx = min(len(xs) - 1, int(q / 100.0 * len(xs)))
+    return round(xs[idx] * 1e3, 3)
+
+
+async def _overload_verify(client, partitions, acked_keys, shed_keys) -> dict:
+    """End-of-run EXACT acked-write verification: every acked key appears
+    exactly once (zero loss, zero duplicates), and no shed key is readable
+    anywhere (shed-before-ack). Calibration/warmup records are ignored."""
+    from collections import Counter as _Counter
+
+    seen: _Counter = _Counter()
+    for p in range(partitions):
+        off = 0
+        while True:
+            batches, hwm = await client.fetch(
+                TOPIC, p, off, max_wait_ms=10, max_bytes=1 << 20
+            )
+            if not batches:
+                if off >= hwm:
+                    break
+                off = hwm
+                continue
+            for b in batches:
+                for r in b.records():
+                    key = _overload_keys(r.value or b"")
+                    if key is not None:
+                        seen[key] += 1
+            off = batches[-1].last_offset + 1
+    missing = sum(1 for k in acked_keys if seen[k] == 0)
+    duplicated = sum(1 for k in acked_keys if seen[k] > 1)
+    shed_visible = sum(1 for k in shed_keys if seen[k] > 0)
+    return {
+        "acked_keys": len(acked_keys),
+        "missing": missing,
+        "duplicated": duplicated,
+        "shed_keys": len(shed_keys),
+        "shed_visible": shed_visible,
+        "exact": missing == 0 and duplicated == 0 and shed_visible == 0,
+    }
+
+
+async def _scrape_resources(stack) -> list[dict]:
+    import aiohttp
+
+    out = []
+    async with aiohttp.ClientSession() as sess:
+        for port in stack.admin_ports:
+            try:
+                async with sess.get(
+                    f"http://127.0.0.1:{port}/v1/resources",
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as r:
+                    out.append(await r.json())
+            except Exception as e:  # noqa: BLE001 — reported, judged below
+                out.append({"error": repr(e)})
+    return out
+
+
+async def _scrape_admission_journal(stack) -> list[dict]:
+    import aiohttp
+
+    url = (
+        f"http://127.0.0.1:{stack.admin_ports[0]}"
+        f"/v1/governor?domain=admission&limit=256"
+    )
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(
+                url, timeout=aiohttp.ClientTimeout(total=10)
+            ) as r:
+                doc = await r.json()
+        return doc.get("journal") or []
+    except Exception:
+        return []
+
+
+async def run_overload_async(
+    name: str,
+    *,
+    backend: str = "inproc",
+    duration_s: float | None = None,
+    base_dir: str | None = None,
+    overrides: dict | None = None,
+) -> dict:
+    """The open-loop overload gate (ROADMAP item 4): calibrate the
+    closed-loop knee, then schedule arrivals at overload_factor x that
+    rate and judge survival — plateau (no collapse), governed admitted
+    p99, counted sheds, EXACT acked-write verification, per-account peaks
+    within budget, and an admission journal that reconstructs the run."""
+    from redpanda_tpu.kafka.client import KafkaClient
+
+    s = copy.deepcopy(OVERLOAD_SCENARIOS[name])
+    s.update(overrides or {})
+    if duration_s is not None:
+        s["duration_s"] = float(duration_s)
+    # the stack plumbing (configs, slow-ring threshold) reads these
+    s.setdefault("objectives", _objectives(
+        s["admitted_p99_ms"], 30_000, 8_000, 15_000, 8_000, 8_000, 20
+    ))
+    for key in ("group_members", "eos_pairs", "transform_readers",
+                "tiered_readers"):
+        s.setdefault(key, 0)
+
+    tmp = None
+    if base_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="loadgen-overload-")
+        base_dir = tmp.name
+    stack_cls = ProcStack if backend == "proc" else Stack
+    stack = stack_cls(s, base_dir)
+    clients: list = []
+    k = s["records_per_op"]
+    try:
+        await stack.start()
+        n_clients = max(2, min(8, s["producers"]))
+        clients = await asyncio.gather(*(
+            KafkaClient(stack.bootstrap()).connect() for _ in range(n_clients)
+        ))
+        admin = clients[0]
+        await admin.create_topic(
+            TOPIC, partitions=s["partitions"], replication=s["replication"]
+        )
+        for p in range(s["partitions"]):  # warmup: no first-op costs inside
+            await admin.produce(
+                TOPIC, p,
+                [_overload_payload(200_000, 0, j, 64) for j in range(2)],
+                acks=-1,
+            )
+
+        # ---- phase 1: the closed-loop knee
+        counter = {"records": 0, "errors": 0}
+        stop1 = asyncio.Event()
+        tasks = [
+            asyncio.create_task(_closed_loop_producer(
+                i, clients[i % n_clients], s["partitions"], k,
+                s["record_bytes"], stop1, counter,
+            ))
+            for i in range(s["producers"])
+        ]
+        t0 = time.monotonic()
+        await asyncio.sleep(s["calibrate_s"])
+        stop1.set()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        calib_elapsed = time.monotonic() - t0
+        capacity_rps = counter["records"] / calib_elapsed
+
+        # ---- phase 2: open loop past the knee
+        target_rps = capacity_rps * s["overload_factor"]
+        op_rate = target_rps / k / s["producers"]
+        ostats: dict[str, int] = {
+            key: 0 for key in (
+                "acked_ops", "acked_records", "shed_ops", "error_ops",
+                "client_dropped",
+            )
+        }
+        lats: list[float] = []
+        acked_keys: set[str] = set()
+        shed_keys: set[str] = set()
+        stop2 = asyncio.Event()
+        tasks = [
+            asyncio.create_task(_open_loop_producer(
+                i, clients[i % n_clients], s["partitions"], op_rate, k,
+                s["record_bytes"], stop2, ostats, lats, acked_keys,
+                shed_keys,
+            ))
+            for i in range(s["producers"])
+        ]
+        t0 = time.monotonic()
+        await asyncio.sleep(s["duration_s"])
+        stop2.set()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        elapsed = time.monotonic() - t0
+        admitted_rps = ostats["acked_records"] / elapsed
+
+        # ---- verification + control-plane sweeps
+        verify = await _overload_verify(
+            admin, s["partitions"], acked_keys, shed_keys
+        )
+        resources = await _scrape_resources(stack)
+        budgets_ok = True
+        for node in resources:
+            accounts = node.get("accounts")
+            if not accounts:
+                # an unreachable admin API or a plane-less broker is NOT
+                # evidence the peaks stayed within budget — fail the gate
+                # rather than pass it on missing data
+                budgets_ok = False
+                continue
+            for acct in accounts.values():
+                if acct["peak_bytes"] > acct["limit_bytes"]:
+                    budgets_ok = False
+        journal = await _scrape_admission_journal(stack)
+        shed_total = await _scrape_counter_total(
+            stack, "kafka_produce_admission_shed_total"
+        )
+        p99_ms = _quantile_ms(lats, 99.0)
+        gates = {
+            # the knee held: admitted throughput plateaus, never collapses
+            "throughput_plateau": admitted_rps
+            >= s["plateau_floor"] * capacity_rps,
+            # ADMITTED requests stay governed (CO-safe client clock)
+            "admitted_p99": p99_ms <= s["admitted_p99_ms"],
+            # every client-observed shed is a counted server-side shed,
+            # and the journal carries the episode(s)
+            "shed_counted": ostats["shed_ops"] == 0 or (
+                shed_total >= ostats["shed_ops"]
+                and any(e["verdict"] == "shed" for e in journal)
+            ),
+            "verification_exact": verify["exact"],
+            "budgets_respected": budgets_ok,
+        }
+        return {
+            "scenario": name,
+            "kind": "overload",
+            "backend": stack.backend,
+            "nodes": s["nodes"],
+            "partitions": s["partitions"],
+            "overload_factor": s["overload_factor"],
+            "calibration": {
+                "duration_s": round(calib_elapsed, 3),
+                "capacity_records_per_s": round(capacity_rps, 1),
+                "errors": counter["errors"],
+            },
+            "open_loop": {
+                "duration_s": round(elapsed, 3),
+                "offered_records_per_s": round(target_rps, 1),
+                "admitted_records_per_s": round(admitted_rps, 1),
+                "admitted_p50_ms": _quantile_ms(lats, 50.0),
+                "admitted_p99_ms": p99_ms,
+                "admitted_max_ms": _quantile_ms(lats, 100.0),
+                **ostats,
+            },
+            "shed_total_server": shed_total,
+            "verification": verify,
+            "resources": resources,
+            "admission_journal": journal,
+            "gates": gates,
+            "pass": all(gates.values()),
+        }
+    finally:
+        for c in clients:
+            try:
+                await c.close()
+            except Exception:
+                pass
+        await stack.stop()
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def run_overload(name: str, **kw) -> dict:
+    return asyncio.run(run_overload_async(name, **kw))
+
+
 # ================================================================ cli
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
@@ -1092,7 +1618,31 @@ def main(argv=None) -> int:
             print(f"{name:<16} nodes={s['nodes']} partitions={s['partitions']} "
                   f"duration={s['duration_s']}s producers={s['producers']} "
                   f"chaos={s['chaos']['module']}.{s['chaos']['probe']}")
+        for name, s in OVERLOAD_SCENARIOS.items():
+            print(f"{name:<16} nodes={s['nodes']} partitions={s['partitions']} "
+                  f"duration={s['duration_s']}s producers={s['producers']} "
+                  f"open-loop x{s['overload_factor']} (overload gate)")
         return 0
+    if args.scenario in OVERLOAD_SCENARIOS:
+        report = run_overload(
+            args.scenario, backend=args.backend, duration_s=args.duration,
+        )
+        out = args.report or f"SLO_{args.scenario}.json"
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        print(json.dumps({
+            "scenario": report["scenario"],
+            "verdict": "PASS" if report["pass"] else "FAIL",
+            "gates": report["gates"],
+            "capacity_records_per_s":
+                report["calibration"]["capacity_records_per_s"],
+            "admitted_records_per_s":
+                report["open_loop"]["admitted_records_per_s"],
+            "admitted_p99_ms": report["open_loop"]["admitted_p99_ms"],
+            "shed_ops": report["open_loop"]["shed_ops"],
+            "report": out,
+        }))
+        return 0 if report["pass"] else 1
     if args.scenario not in SCENARIOS:
         p.error(f"unknown scenario {args.scenario!r}; --list shows them")
     report = run_scenario(

@@ -795,6 +795,74 @@ def bench_breaker_overhead(secs: float) -> dict:
                     arm[effect](module, probe)
 
 
+def bench_admission_overhead(secs: float) -> dict:
+    """Cost of the budget-plane admission gate on the UNCONTENDED produce
+    path (resource_mgmt): what every admitted produce pays is exactly ONE
+    ``try_admit`` (an account lock + two compares + a counter) and ONE
+    ``release`` — the shed path is the degraded case and allowed to cost
+    more. Derived like breaker_overhead: min-of-blocks per-pair cost over
+    the min-of-blocks cost of a REAL acked produce op (a full client →
+    broker → storage round trip on an in-process single-node broker),
+    because wall-clock A/B cannot resolve sub-1% on a shared box.
+    ``--assert-admission-overhead 1`` gates the quotient."""
+    import asyncio
+
+    from redpanda_tpu.resource_mgmt import AdmissionController, BudgetPlane
+
+    plane = BudgetPlane(256 << 20)
+    ctrl = AdmissionController(plane.account("kafka_produce"), "bench_adm")
+    n_raw = 20000
+    pair_ns = float("inf")
+    for _ in range(10):
+        t0 = time.perf_counter()
+        for _ in range(n_raw):
+            reserved, _r = ctrl.try_admit(4096)
+            ctrl.release(reserved)
+        pair_ns = min(pair_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+
+    async def produce_op_us() -> float:
+        import tempfile
+
+        from redpanda_tpu.kafka.client import KafkaClient
+        from redpanda_tpu.kafka.server.broker import Broker, BrokerConfig
+        from redpanda_tpu.kafka.server.protocol import KafkaServer
+        from redpanda_tpu.storage.log_manager import StorageApi
+
+        with tempfile.TemporaryDirectory(prefix="mb-adm-") as d:
+            storage = await StorageApi(d).start()
+            broker = Broker(BrokerConfig(data_dir=d), storage)
+            server = await KafkaServer(broker, "127.0.0.1", 0).start()
+            broker.config.advertised_port = server.port
+            client = await KafkaClient(
+                [("127.0.0.1", server.port)]
+            ).connect()
+            try:
+                payload = [b"x" * 512] * 4
+                for _ in range(8):  # warmup: topic create, first appends
+                    await client.produce("bench", 0, payload, acks=-1)
+                best = float("inf")
+                k = 32
+                rounds = max(6, int(secs / 0.05))
+                for _ in range(rounds):
+                    t0 = time.perf_counter()
+                    for _ in range(k):
+                        await client.produce("bench", 0, payload, acks=-1)
+                    best = min(best, (time.perf_counter() - t0) / k)
+                return best * 1e6
+            finally:
+                await client.close()
+                await server.stop()
+                await storage.stop()
+
+    op_us = asyncio.run(produce_op_us())
+    pct = pair_ns / (op_us * 1e3) * 100.0 if op_us else 0.0
+    return {
+        "admission_pair_ns": round(pair_ns, 1),
+        "admission_produce_op_us": round(op_us, 1),
+        "admission_overhead_pct": round(pct, 4),
+    }
+
+
 def bench_governor_overhead(secs: float) -> dict:
     """Cost of the governor's decision-plane hooks on the UNFAULTED coproc
     launch path.
@@ -1077,6 +1145,7 @@ BENCHES = {
     "breaker_overhead": bench_breaker_overhead,
     "slo_eval_overhead": bench_slo_eval_overhead,
     "governor_overhead": bench_governor_overhead,
+    "admission_overhead": bench_admission_overhead,
 }
 
 
@@ -1141,6 +1210,14 @@ def main(argv=None) -> int:
         "governor_overhead bench",
     )
     p.add_argument(
+        "--assert-admission-overhead",
+        type=float,
+        metavar="PCT",
+        help="fail (exit 1) if the uncontended budget-admission pair "
+        "(try_admit + release) exceeds PCT percent of a real acked "
+        "produce op; implies the admission_overhead bench",
+    )
+    p.add_argument(
         "--assert-harvest-speedup",
         type=float,
         metavar="RATIO",
@@ -1196,6 +1273,8 @@ def main(argv=None) -> int:
         names.append("slo_eval_overhead")
     if args.assert_governor_overhead is not None and "governor_overhead" not in names:
         names.append("governor_overhead")
+    if args.assert_admission_overhead is not None and "admission_overhead" not in names:
+        names.append("admission_overhead")
     snap_before = None
     if args.metrics_snapshot:
         from redpanda_tpu.metrics import registry
@@ -1283,6 +1362,15 @@ def main(argv=None) -> int:
             print(
                 f"governor hook overhead {pct}% exceeds budget "
                 f"{args.assert_governor_overhead}%",
+                file=sys.stderr,
+            )
+            return 1
+    if args.assert_admission_overhead is not None:
+        pct = out.get("admission_overhead_pct", 0.0)
+        if pct > args.assert_admission_overhead:
+            print(
+                f"admission pair overhead {pct}% exceeds budget "
+                f"{args.assert_admission_overhead}%",
                 file=sys.stderr,
             )
             return 1

@@ -21,6 +21,7 @@ from tools.pandalint.checkers.races import RaceChecker
 from tools.pandalint.checkers.deadlocks import DeadlockChecker
 from tools.pandalint.checkers.tracectx import TraceCtxChecker
 from tools.pandalint.checkers.meshctx import MeshCtxChecker
+from tools.pandalint.checkers.backpressure import BackpressureChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     ReactorChecker,
@@ -39,6 +40,7 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     DeadlockChecker,
     TraceCtxChecker,
     MeshCtxChecker,
+    BackpressureChecker,
 )
 
 

@@ -1,0 +1,241 @@
+"""Unbounded producer-side buffering in hot paths (backpressure ratchet).
+
+The resource_mgmt budget plane exists so a produce flood degrades into
+judged, counted sheds — but one unbounded ``Queue()`` or an append-only
+list between a producer and a slower consumer silently re-opens the exact
+failure the accounts close: memory grows with offered load instead of with
+admitted load, and the OOM arrives with no shed counter, no pressure
+signal, no journal entry. This checker makes bounded-or-budgeted the
+default posture in the hot-path packages (``redpanda_tpu/{kafka,rpc,
+coproc,raft}``); deliberate exceptions carry a reasoned pragma naming the
+bound that actually exists (an admission gate upstream, a drain that runs
+in the same tick, a shutdown-only path).
+
+Heuristic scope (no type inference):
+
+- BPR1401: an unbounded queue CONSTRUCTION — ``asyncio.Queue()`` /
+  ``queue.Queue()`` (any import alias) with no capacity, an explicit
+  literal ``maxsize=0``, or ``queue.SimpleQueue()`` (unboundable by
+  design). A non-literal capacity gets the benefit of the doubt.
+- BPR1402: a ``.put_nowait(...)`` whose receiver resolves — same-class
+  ``self._x`` attribute or a local/module name assigned in this file — to
+  an unbounded queue: the producer-side push that grows without waiting.
+  Unresolvable receivers (parameters, foreign objects) stay silent
+  rather than guessing.
+- BPR1403: ``self.<buffer>.append(...)`` inside ``async def`` where the
+  attribute was initialized to a bare list in this class and its name
+  says accumulation (pending/queue/backlog/buffer/inflight/batch) — the
+  list-append flood shape — UNLESS the same function also acquires a
+  budget (a call whose dotted name mentions ``acquire``/``admit``: the
+  bytes were admitted before they were parked).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from tools.pandalint.checkers.base import (
+    Checker,
+    FileContext,
+    RawFinding,
+    dotted,
+)
+
+_HOT_PREFIXES = (
+    "redpanda_tpu/kafka/",
+    "redpanda_tpu/rpc/",
+    "redpanda_tpu/coproc/",
+    "redpanda_tpu/raft/",
+)
+
+_BUFFERISH = re.compile(
+    r"(pending|queue|backlog|buffer|inflight|batch)", re.IGNORECASE
+)
+_BUDGET_CALL = re.compile(r"(acquire|admit)", re.IGNORECASE)
+
+# dotted spellings that construct a queue once asyncio/queue aliases are
+# normalized; SimpleQueue has no maxsize parameter at all
+_QUEUE_TAILS = {"Queue", "LifoQueue", "PriorityQueue"}
+_ALWAYS_UNBOUNDED_TAILS = {"SimpleQueue"}
+
+
+def _queue_modules(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(module aliases naming asyncio/queue, bare names imported from
+    them that look like queue classes)."""
+    mod_aliases: set[str] = set()
+    bare_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in ("asyncio", "queue"):
+                    mod_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.ImportFrom) and node.module in (
+            "asyncio", "queue", "asyncio.queues",
+        ):
+            for alias in node.names:
+                if alias.name in _QUEUE_TAILS | _ALWAYS_UNBOUNDED_TAILS:
+                    bare_names.add(alias.asname or alias.name)
+    return mod_aliases, bare_names
+
+
+def _classify_queue_call(call: ast.Call, mod_aliases, bare_names):
+    """None = not a queue construction; else True when UNBOUNDED."""
+    name = dotted(call.func)
+    root, _, tail = name.partition(".")
+    if name in bare_names:
+        tail = name  # from-import: the bare name IS the class
+    elif not (root in mod_aliases and tail in _QUEUE_TAILS | _ALWAYS_UNBOUNDED_TAILS):
+        return None
+    if tail in _ALWAYS_UNBOUNDED_TAILS:
+        return True
+    cap = call.args[0] if call.args else None
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            cap = kw.value
+    if cap is None:
+        return True
+    if isinstance(cap, ast.Constant) and cap.value == 0:
+        return True  # maxsize=0 IS the unbounded spelling
+    return False  # literal bound or non-literal expression: trusted
+
+
+def _receiver_of(call: ast.Call) -> str:
+    """Dotted receiver of an attribute call: `self._q.put_nowait` -> the
+    `self._q` part ('' when the callee isn't an attribute chain)."""
+    if isinstance(call.func, ast.Attribute):
+        return dotted(call.func.value)
+    return ""
+
+
+class BackpressureChecker(Checker):
+    name = "backpressure"
+    rules = {
+        "BPR1401": "unbounded queue construction in a hot-path package",
+        "BPR1402": "put_nowait onto an unbounded queue (producer-side growth)",
+        "BPR1403": "async list-append buffering with no bound or acquired budget",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        rel = ctx.relpath.replace("\\", "/")
+        if not rel.startswith(_HOT_PREFIXES):
+            return
+        mod_aliases, bare_names = _queue_modules(ctx.tree)
+        # nearest enclosing class per node (innermost wins)
+        class_of: dict[ast.AST, str] = {}
+
+        def _map_classes(node: ast.AST, cls_name: str | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                inner = child.name if isinstance(child, ast.ClassDef) else cls_name
+                if cls_name is not None:
+                    class_of[child] = cls_name
+                _map_classes(child, inner)
+
+        _map_classes(ctx.tree, None)
+        # pass 1: constructions. Bounded-ness maps for pass 2/3:
+        #   ('self', ClassName, attr) / ('name', name)
+        unbounded: set[tuple] = set()
+        list_attrs: set[tuple[str, str]] = set()  # (cls, attr) bare lists
+        findings: list[RawFinding] = []
+
+        def record_assign(target: ast.expr, value: ast.expr, scope_cls: str | None):
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+                and scope_cls is not None
+                and isinstance(value, ast.List)
+                and not value.elts
+                and _BUFFERISH.search(target.attr)
+            ):
+                list_attrs.add((scope_cls, target.attr))
+            if not isinstance(value, ast.Call):
+                return
+            verdict = _classify_queue_call(value, mod_aliases, bare_names)
+            if verdict is None:
+                return
+            key = None
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+                and scope_cls is not None
+            ):
+                key = ("self", scope_cls, target.attr)
+            elif isinstance(target, ast.Name):
+                key = ("name", target.id)
+            if verdict:
+                findings.append(RawFinding(
+                    "BPR1401", value.lineno, value.col_offset,
+                    f"{dotted(value.func)}() has no capacity: memory grows "
+                    f"with offered load, not admitted load — pass maxsize "
+                    f"(or acquire from a resource_mgmt account and pragma "
+                    f"the bound)",
+                ))
+                if key is not None:
+                    unbounded.add(key)
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    record_assign(t, node.value, class_of.get(node))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                record_assign(node.target, node.value, class_of.get(node))
+
+        yield from findings
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "put_nowait"
+            ):
+                continue
+            recv = _receiver_of(node)
+            key = None
+            if recv.startswith("self."):
+                cls_name = class_of.get(node)
+                if cls_name is not None:
+                    key = ("self", cls_name, recv[5:])
+            elif recv and "." not in recv:
+                key = ("name", recv)
+            if key is not None and key in unbounded:
+                yield RawFinding(
+                    "BPR1402", node.lineno, node.col_offset,
+                    f"{recv}.put_nowait() onto an unbounded queue: the "
+                    f"producer never waits and never sheds — bound the "
+                    f"queue or admit the bytes through a budget first",
+                )
+
+        # pass 3: async list-append buffering
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            cls_name = class_of.get(fn)
+            if cls_name is None:
+                continue
+            has_budget = any(
+                isinstance(n, ast.Call) and _BUDGET_CALL.search(dotted(n.func) or "")
+                for n in ast.walk(fn)
+            )
+            if has_budget:
+                continue
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append"
+                ):
+                    continue
+                recv = _receiver_of(node)
+                if not recv.startswith("self."):
+                    continue
+                attr = recv[5:]
+                if (cls_name, attr) in list_attrs:
+                    yield RawFinding(
+                        "BPR1403", node.lineno, node.col_offset,
+                        f"{recv}.append() buffers producer-side in async "
+                        f"{fn.name}() with no bound and no acquired "
+                        f"budget — cap it or reserve from a "
+                        f"resource_mgmt account before parking bytes",
+                    )
