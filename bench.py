@@ -400,6 +400,47 @@ def run_config3_identity(engine_cls, force_mode=None, **engine_kw) -> dict:
     return {"record_batches_per_sec": round(rate, 1)}
 
 
+def run_pulse_block() -> dict:
+    """ISSUE 14: the pandapulse block every BENCH artifact carries — one
+    instrumented columnar round with the flight recorder on, so the
+    artifact holds the same per-stage timeline totals `rpk debug profile`
+    would show for the bench's launch shape (plus the recorder/profiler
+    summary). Tracer + pulse state restore after; the measured headline
+    runs above stay uninstrumented."""
+    from redpanda_tpu.coproc import TpuEngine
+    from redpanda_tpu.observability.pulse import pulse
+    from redpanda_tpu.observability.trace import tracer
+
+    was_tracing = tracer.enabled
+    was_pulse = pulse.enabled
+    tracer.configure(enabled=True)
+    pulse.configure(enabled=True)
+    pulse.recorder.reset()
+    try:
+        req = _build_workload(8, topic="bench_pulse")
+        engine = TpuEngine(row_stride=ROW_STRIDE)
+        codes = engine.enable_coprocessors(
+            [(1, _spec().to_json(), ("bench_pulse",))]
+        )
+        assert codes[0] == 0
+        req.trace_id = tracer.new_trace_id()
+        engine.submit(req).result()
+        engine.shutdown()
+        tl = pulse.timeline()
+        return {
+            "recorder": pulse.recorder.summary(),
+            "stage_totals_s": {
+                k: round(v, 6)
+                for k, v in sorted(pulse.recorder.stage_totals().items())
+            },
+            "timeline_events": len(tl["traceEvents"]),
+            "journal_events": tl["journal_events"],
+        }
+    finally:
+        pulse.configure(enabled=was_pulse)
+        tracer.configure(enabled=was_tracing)
+
+
 def run_config3_diagnosis(aa: dict) -> dict:
     """ISSUE 11 satellite: judge the config3_payload_bridge_16p 5682→1439
     rb/s r04→r05 move now that the A/A self-check makes regression-vs-
@@ -717,6 +758,9 @@ def main():
             "journal": gov_mod.journal.summary(),
             "journal_tail": gov_mod.journal.entries(limit=16),
         }
+        # ISSUE 14: the pandapulse block — flight-recorder stage totals +
+        # timeline/journal event counts for one instrumented round
+        extras["pulse"] = run_pulse_block()
     except Exception as exc:  # secondary metrics must never sink the bench
         extras["configs_error"] = repr(exc)
 

@@ -164,6 +164,9 @@ class AdminServer:
             web.get("/v1/archival/status", self._archival_status),
             web.get("/v1/slo", self._slo),
             web.post("/v1/slo/mark", self._slo_mark),
+            web.get("/v1/slo/exemplars", self._slo_exemplars),
+            web.get("/v1/profile", self._profile),
+            web.get("/v1/profile/timeline", self._profile_timeline),
             web.get("/metrics", self._metrics),
             web.get("/v1/trace/recent", self._trace_recent),
             web.get("/v1/trace/slow", self._trace_slow),
@@ -588,6 +591,17 @@ class AdminServer:
         the pressure signal, admission controller stats and the autotune
         launch knobs — what `rpk debug resources` renders and the loadgen
         overload gate judges (peak occupancy must stay <= budget)."""
+        if req.query.get("federated", "").lower() in ("1", "true", "yes"):
+            # the read-side federation plane: every node's account
+            # occupancy merged (limits/held/peaks sum; occupancy and
+            # pressure report the worst node) — `rpk debug resources
+            # --federated`, and the occupancy column for cluster timelines
+            from redpanda_tpu.observability import federation
+
+            body = await federation.assemble_cluster_resources(
+                self._admin_targets(), headers=self._peer_headers()
+            )
+            return web.json_response(body)
         plane = getattr(self.broker, "budget_plane", None)
         if plane is None:
             return web.json_response(
@@ -751,6 +765,61 @@ class AdminServer:
             })
         series = slo.set_mark(name)
         return web.json_response({"mark": name, "series": series})
+
+    async def _slo_exemplars(self, req: web.Request) -> web.Response:
+        """THIS node's breach-exemplar rings (probes.exemplars_snapshot),
+        per series key — the per-node leg the federated SLO plane fans out
+        to so a cluster-level breach entry can carry the CULPRIT node's
+        exemplar trace ids (each resolvable via /v1/trace/cluster/{tid})."""
+        from redpanda_tpu.observability import probes, tracer
+
+        return web.json_response({
+            "node": self.broker.config.node_id,
+            "enabled": tracer.enabled,
+            "exemplars": probes.exemplars_snapshot(),
+        })
+
+    # ------------------------------------------------------------ pulse
+    async def _profile(self, req: web.Request) -> web.Response:
+        """pandapulse status: flight-recorder summary, per-stage totals,
+        wall-profiler folded-stack top — `rpk debug profile` renders this;
+        profile.json in the debug bundle."""
+        from redpanda_tpu.observability.pulse import pulse
+
+        try:
+            top = max(1, int(req.query.get("top", "20")))
+        except ValueError:
+            return web.json_response({"error": "top must be an int"}, status=400)
+        body = pulse.snapshot(top=top)
+        body["node"] = self.broker.config.node_id
+        if req.query.get("stacks", "").lower() in ("1", "true", "yes"):
+            body["stacks"] = pulse.profiler.stacks()
+            body["folded"] = pulse.profiler.folded()
+        return web.json_response(body)
+
+    async def _profile_timeline(self, req: web.Request) -> web.Response:
+        """Chrome trace-event JSON (Perfetto-loadable) of the newest
+        ``?launches=N`` launch lifecycles, governor verdicts + admission
+        episodes as instant events on the same clock. ``?federated=1``
+        assembles the cluster timeline across every broker's admin (the
+        /v1/trace/cluster posture: unreachable nodes reported, not fatal)."""
+        from redpanda_tpu.observability.pulse import pulse
+
+        try:
+            launches = max(0, int(req.query.get("launches", "0")))
+        except ValueError:
+            return web.json_response(
+                {"error": "launches must be an int"}, status=400
+            )
+        if req.query.get("federated", "").lower() in ("1", "true", "yes"):
+            from redpanda_tpu.observability import federation
+
+            body = await federation.assemble_cluster_timeline(
+                self._admin_targets(), launches,
+                headers=self._peer_headers(),
+            )
+            return web.json_response(body)
+        return web.json_response(pulse.timeline(launches=launches))
 
     # ------------------------------------------------------------ metrics
     async def _metrics(self, req: web.Request) -> web.Response:

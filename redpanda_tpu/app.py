@@ -111,6 +111,19 @@ class Application:
             # merge by trace id across brokers, so ids must never collide
             node_id=c.node_id,
         )
+        # pandapulse: the flight recorder rides the tracer's commit path
+        # (span sink — one bounded-deque append per committed span), so it
+        # installs whenever enabled and simply sees nothing until
+        # trace_enabled flips the plane on (the pandascope rollout-gate
+        # posture). The wall profiler is its own low-frequency thread,
+        # profile_hz=0 keeps it entirely absent.
+        from redpanda_tpu.observability.pulse import pulse
+
+        pulse.configure(
+            enabled=c.pulse_enabled,
+            ring_capacity=c.pulse_ring_capacity,
+            profile_hz=float(c.profile_hz),
+        )
         # SLO engine: operator objectives (or the lenient broker defaults)
         # judged at GET /v1/slo; loading arms per-metric breach thresholds
         # so over-threshold observations record trace exemplars
@@ -487,6 +500,18 @@ class Application:
         registry.gauge(
             "trace_spans_recorded", lambda: tracer.spans_recorded,
             "Spans committed to the trace ring since start",
+        )
+        from redpanda_tpu.observability.pulse import pulse as _pulse
+
+        registry.gauge(
+            "pulse_spans_recorded",
+            lambda: float(_pulse.recorder.spans_recorded),
+            "Spans the pandapulse flight recorder has retained-or-rotated",
+        )
+        registry.gauge(
+            "pulse_profile_samples",
+            lambda: float(_pulse.profiler.samples),
+            "Wall-profile sampling ticks taken (profile_hz > 0)",
         )
         from redpanda_tpu.observability.slo import slo as _slo
 

@@ -315,6 +315,13 @@ async def cmd_config(args) -> int:
 
 
 # ================================================================ debug / generate / tune
+def _write_text(path: str, data: str) -> None:
+    """Blocking file write, called via asyncio.to_thread from the async
+    CLI commands (RCT103: no blocking I/O on the loop)."""
+    with open(path, "w") as f:
+        f.write(data)
+
+
 async def cmd_debug(args) -> int:
     """debug diagnostics: bundle (tar.gz of admin state), trace (render
     the broker's recent pandaprobe spans), coproc (engine breaker +
@@ -450,13 +457,123 @@ async def cmd_debug(args) -> int:
                 print(f"  {k:<28}{stats[k]}")
         return 0
 
-    if args.debug_cmd == "resources":
-        status, body = await _admin_request(args, "GET", "/v1/resources")
+    if args.debug_cmd == "profile":
+        if args.perfetto:
+            query = {"launches": str(args.launches)}
+            if args.federated:
+                query["federated"] = "1"
+            status, body = await _admin_request(
+                args, "GET", "/v1/profile/timeline", query=query
+            )
+            if status != 200:
+                print(f"admin api returned {status}: {body}")
+                return 1
+            data = json.dumps(body)
+            await asyncio.to_thread(_write_text, args.perfetto, data)
+            events = body.get("traceEvents") or []
+            extra = ""
+            if body.get("unreachable"):
+                extra = f" (PARTIAL: unreachable {body['unreachable']})"
+            print(
+                f"wrote {args.perfetto}: {len(events)} events, "
+                f"{body.get('launches', 0)} launches, "
+                f"{body.get('journal_events', '?')} journal instants"
+                f"{extra} — load it at https://ui.perfetto.dev"
+            )
+            return 0
+        status, body = await _admin_request(args, "GET", "/v1/profile")
         if status != 200:
             print(f"admin api returned {status}: {body}")
             return 1
         if args.json:
             print(json.dumps(body, indent=2, sort_keys=True))
+            return 0
+        rec = body.get("recorder") or {}
+        prof = body.get("profiler") or {}
+        tracing = (
+            "on" if body.get("tracing")
+            else "OFF — timelines stay empty; set trace_enabled: true"
+        )
+        print(
+            f"flight recorder: {'on' if body.get('enabled') else 'off'} "
+            f"(tracing {tracing})"
+        )
+        print(
+            f"  spans {rec.get('spans', 0)}/{rec.get('capacity', 0)} "
+            f"(committed {rec.get('spans_recorded', 0)}), "
+            f"launches {rec.get('launches', 0)}"
+        )
+        print(
+            f"wall profiler: "
+            f"{'running' if prof.get('running') else 'off'} "
+            f"hz={prof.get('hz', 0)} samples={prof.get('samples', 0)} "
+            f"stacks={prof.get('distinct_stacks', 0)}"
+        )
+        if args.top:
+            rows = body.get("top") or []
+            if not rows:
+                print("no profile samples (set profile_hz, e.g. 19)")
+                return 0
+            print(f"{'SAMPLES':>8}  {'AFFINITY':<12}{'THREAD':<26}FRAME")
+            for r in rows:
+                print(
+                    f"{r.get('samples', 0):>8}  "
+                    f"{r.get('affinity', '?'):<12}"
+                    f"{r.get('thread', '?'):<26}{r.get('frame', '?')}"
+                )
+            return 0
+        totals = body.get("stage_totals_s") or {}
+        if totals:
+            print("stage totals (s, ring window):")
+            ordered = sorted(totals.items(), key=lambda kv: -kv[1])
+            for k, v in ordered[:16]:
+                print(f"  {k:<40}{v:>12.6f}")
+        return 0
+
+    if args.debug_cmd == "resources":
+        query = {"federated": "1"} if args.federated else None
+        status, body = await _admin_request(
+            args, "GET", "/v1/resources", query=query
+        )
+        if status != 200:
+            print(f"admin api returned {status}: {body}")
+            return 1
+        if args.json:
+            print(json.dumps(body, indent=2, sort_keys=True))
+            return 0
+        if args.federated:
+            print(
+                f"cluster pressure: {body.get('pressure', '?')}"
+                + (
+                    f" (worst node {body['pressure_node']})"
+                    if body.get("pressure_node") else ""
+                )
+                + (
+                    f"  PARTIAL: unreachable {body['unreachable']}"
+                    if body.get("unreachable") else ""
+                )
+            )
+            accounts = body.get("accounts") or {}
+            if accounts:
+                print(
+                    f"{'ACCOUNT':<16}{'HELD':>12}{'PEAK':>12}{'LIMIT':>12}"
+                    f"{'WORST-OCC':>11}  NODE"
+                )
+            for name, a in sorted(accounts.items()):
+                print(
+                    f"{name:<16}{a.get('held_bytes', 0):>12}"
+                    f"{a.get('peak_bytes', 0):>12}"
+                    f"{a.get('limit_bytes', 0):>12}"
+                    f"{a.get('max_occupancy', 0):>11.1%}  "
+                    f"{a.get('max_occupancy_node') or '-'}"
+                )
+            for node in sorted(body.get("nodes") or {}):
+                nb = body["nodes"][node]
+                print(
+                    f"node {node}: pressure={nb.get('pressure', '?')} "
+                    f"max_occ={nb.get('max_occupancy', 0):.1%} "
+                    f"in {nb.get('max_occupancy_account') or '(none)'}"
+                )
             return 0
         if not body.get("enabled"):
             print("no budget plane installed (bare broker?)")
@@ -704,6 +821,10 @@ async def cmd_debug(args) -> int:
         ("coproc.json", "/v1/coproc/status"),
         ("governor.json", "/v1/governor"),
         ("resources.json", "/v1/resources"),
+        # pandapulse: profiler/recorder status + the launch timeline (the
+        # Perfetto-loadable artifact — open timeline.json at ui.perfetto.dev)
+        ("profile.json", "/v1/profile"),
+        ("timeline.json", "/v1/profile/timeline"),
         ("slo.json", "/v1/slo"),
         ("failpoints.json", "/v1/failure-probes"),
     ]:
@@ -920,6 +1041,35 @@ def build_parser() -> argparse.ArgumentParser:
              "autotune state (admin api)",
     )
     dres.add_argument("--json", action="store_true", help="raw JSON, no rendering")
+    dres.add_argument(
+        "--federated", action="store_true",
+        help="merge every node's budget-account occupancy (admin fans "
+             "out to peers; occupancy/pressure report the worst node)",
+    )
+    dprof = dsub.add_parser(
+        "profile",
+        help="pandapulse flight recorder + wall profiler (admin api)",
+    )
+    dprof.add_argument("--json", action="store_true", help="raw JSON, no rendering")
+    dprof.add_argument(
+        "--perfetto", default=None, metavar="OUT.json",
+        help="write the Chrome trace-event launch timeline (governor "
+             "verdicts + admission episodes as instant events); load it "
+             "at https://ui.perfetto.dev",
+    )
+    dprof.add_argument(
+        "--top", action="store_true",
+        help="wall-profile leaf-frame attribution table (needs profile_hz)",
+    )
+    dprof.add_argument(
+        "--launches", type=int, default=0,
+        help="with --perfetto: newest N launches (0 = every launch in the ring)",
+    )
+    dprof.add_argument(
+        "--federated", action="store_true",
+        help="with --perfetto: assemble the cluster timeline across "
+             "every broker (like rpk debug trace --cluster)",
+    )
     dgov = dsub.add_parser(
         "governor",
         help="coproc decision journal + per-domain posture (admin api)",

@@ -119,6 +119,28 @@ PROPERTIES: list[Property] = [
     Property("trace_enabled", "Record pandaprobe spans (GET /v1/trace/recent)", False, bool),
     Property("trace_ring_capacity", "Bounded span ring size", 2048, int, _positive),
     Property("trace_slow_threshold_ms", "Spans over this land in the slow-request log", 500, int, _positive),
+    # pandapulse (observability/pulse.py): the flight recorder installs a
+    # span sink on the tracer commit path; it records whenever tracing is
+    # on (trace_enabled is the whole plane's rollout gate). profile_hz
+    # runs the wall-sampling profiler thread; 0 = no thread at all.
+    Property(
+        "pulse_enabled",
+        "Install the pandapulse flight recorder (per-launch lifecycle "
+        "timelines at GET /v1/profile/timeline; records while tracing is on)",
+        True, bool,
+    ),
+    Property(
+        "pulse_ring_capacity",
+        "Bounded flight-recorder span ring size",
+        8192, int, _positive,
+    ),
+    Property(
+        "profile_hz",
+        "Wall-profile sampling rate for the pandapulse profiler thread "
+        "(0 = off, no thread; ~19 Hz recommended when on — prime, aliases "
+        "with nothing periodic)",
+        0.0, float, _non_negative,
+    ),
     Property(
         "slo_objectives_file",
         "YAML/JSON SLO objective spec judged at GET /v1/slo (empty = the "

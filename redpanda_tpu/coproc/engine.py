@@ -695,12 +695,19 @@ class _Launch:
         return self._mat
 
     def _stat(self, key: str, t0: float):
-        dt = time.perf_counter() - t0
+        # harvest-side stage (fetch/assemble/frame/seal): runs on whatever
+        # thread materializes, so the launch's explicit trace id carries
+        # the pulse slice (no ambient there); _stat_stage owns the single
+        # clock read + stat/probe/timeline fan-out
         if self.engine is not None:
-            self.engine._stat_add(key, dt)
-        # harvest-side stage span (fetch/assemble): runs on whatever thread
-        # materializes, so the launch's explicit trace id carries it
-        tracer.record("coproc." + key[2:], dt * 1e6, self.trace_id, start_perf=t0)
+            self.engine._stat_stage(key, t0, trace_id=self.trace_id)
+        else:
+            tracer.record(
+                "coproc.stage." + key[2:],
+                (time.perf_counter() - t0) * 1e6,
+                self.trace_id,
+                start_perf=t0,
+            )
 
 
 def _pack_values(ex, stride: int):
@@ -750,6 +757,10 @@ def _explode_shard(batches):
 
 # Per-slot dispositions inside a Ticket.
 _UNKNOWN, _EMPTY, _DEREGISTERED, _LAUNCHED = range(4)
+
+# "resolve the trace id from the ambient contextvar" sentinel for
+# _stat_stage (None is a real value there: "caller had no trace").
+_AMBIENT = object()
 
 # Sharding threshold: below this many records the pool's fan-out/merge
 # overhead (thread handoff, per-shard native-call fixed costs) eats the
@@ -830,7 +841,7 @@ class Ticket:
                 # slot order there, exactly like the old per-slot loop
                 slot_plans.append(exc)
                 framing_failed.add(launch.script_id)
-        sealed = self._engine._seal_jobs(seal_jobs)
+        sealed = self._engine._seal_jobs(seal_jobs, trace_id=self.trace_id)
         # Phase 2: assemble the reply in slot order under the script's
         # ErrorPolicy — this is the policy boundary (deregister failures
         # ride through here), so programming errors must not bypass it.
@@ -1564,13 +1575,35 @@ class TpuEngine:
             elif key == "n_frame_padded":
                 probes.coproc_harvest_padded.inc(v)
 
+    def _stat_stage(self, key: str, t0: float, trace_id=_AMBIENT) -> float:
+        """Close one stage timer: ONE clock read, stat + probe mirror via
+        ``_stat_add``, and the same duration mirrored as a pandapulse
+        lifecycle span (so timeline slices sum to the ``t_*`` splits by
+        construction — both sides see the identical ``dt``). Submit-side
+        call sites run inside the ``coproc.dispatch`` span, so the ambient
+        trace id resolves on the dispatching thread; pool/mesh workers
+        pass the launch's trace id explicitly (no ambient there). Tracer
+        off → ``tracer.record`` is a cheap early return."""
+        dt = time.perf_counter() - t0
+        self._stat_add(key, dt)
+        if tracer.enabled:
+            tid = tracer.current_trace() if trace_id is _AMBIENT else trace_id
+            if tid is not None:
+                # "coproc.stage." namespace: stage slices must not collide
+                # with the wrapper spans (t_dispatch vs the coproc.dispatch
+                # span around the whole submit fan-out)
+                tracer.record(
+                    "coproc.stage." + key[2:], dt * 1e6, tid, start_perf=t0
+                )
+        return dt
+
     def _count_fallback(self, n: int) -> None:
         """Account records whose stages re-executed on the pure-host
         fallback (exhausted device retries or an open breaker)."""
         self._stat_add("n_fallback_rows", float(n))
         probes.coproc_fallback_rows.inc(n)
 
-    def _seal_jobs(self, jobs: list[tuple]) -> list:
+    def _seal_jobs(self, jobs: list[tuple], trace_id: int | None = None) -> list:
         """Recompress + seal framed payloads into output batches
         (batch_codec.build_output_batch), sharded over the host pool when
         the measured pool decision is on and the reply is big enough.
@@ -1611,8 +1644,10 @@ class TpuEngine:
                     t0 = time.perf_counter()
                     out = [seal_one(*jobs[i]) for i in range(s, e)]
                     # per-chunk CPU-seconds; the fan-out wall time is
-                    # t_sharded_seal (same split discipline as t_shard_*)
-                    self._stat_add("t_shard_seal", time.perf_counter() - t0)
+                    # t_sharded_seal (same split discipline as t_shard_*).
+                    # Explicit trace id: chunks run on pool workers where
+                    # no ambient trace is set.
+                    self._stat_stage("t_shard_seal", t0, trace_id=trace_id)
                     return out
 
                 t0 = time.perf_counter()
@@ -1625,7 +1660,7 @@ class TpuEngine:
                         faults.SHARD_WORKER, exc, reraise_programming=True
                     )
                 else:
-                    self._stat_add("t_sharded_seal", time.perf_counter() - t0)
+                    self._stat_stage("t_sharded_seal", t0)
                     # journaled only once the fan-out COMMITTED: a pool-
                     # machinery failure falls through to the inline loop
                     # below, and recording "sharded" first would both lie
@@ -1652,7 +1687,7 @@ class TpuEngine:
             )
         t0 = time.perf_counter()
         out = [seal_one(*j) for j in jobs]
-        self._stat_add("t_seal", time.perf_counter() - t0)
+        self._stat_stage("t_seal", t0)
         return out
 
     def _abandon_pending_masks(self, launch: _Launch) -> None:
@@ -1884,7 +1919,7 @@ class TpuEngine:
                     all_batches, paths, need_joined=plan.byte_identity
                 )
             if sp is not None:
-                self._stat_add("t_explode_find2", time.perf_counter() - t0)
+                self._stat_stage("t_explode_find2", t0)
                 launch.ranges = sp.ranges
                 n = sp.n
                 launch.n = n
@@ -1901,10 +1936,10 @@ class TpuEngine:
             if fused is not None:
                 exploded, types, vs, ve = fused
                 cache = plan.make_cache_from_tables(exploded, paths, types, vs, ve)
-                self._stat_add("t_explode_find", time.perf_counter() - t0)
+                self._stat_stage("t_explode_find", t0)
             else:
                 exploded = batch_codec.explode_batches(all_batches)
-                self._stat_add("t_explode", time.perf_counter() - t0)
+                self._stat_stage("t_explode", t0)
         else:
             if plan.mode == "payload":
                 # POINTER-TABLE staging lane (ROADMAP item 1 follow-on b):
@@ -1916,7 +1951,7 @@ class TpuEngine:
                 # _pack_staged parity test pins it).
                 pe = batch_codec.explode_ptrs(all_batches)
                 if pe is not None:
-                    self._stat_add("t_explode_ptrs", time.perf_counter() - t0)
+                    self._stat_stage("t_explode_ptrs", t0)
                     launch.ranges = pe.ranges
                     n = len(pe.sizes)
                     launch.n = n
@@ -1927,7 +1962,7 @@ class TpuEngine:
                     self._dispatch_payload_ptrs(launch, pe, n)
                     return
             exploded = batch_codec.explode_batches(all_batches)
-            self._stat_add("t_explode", time.perf_counter() - t0)
+            self._stat_stage("t_explode", t0)
         launch.ranges = exploded.ranges
         n = len(exploded.sizes)
         launch.n = n
@@ -2256,7 +2291,7 @@ class TpuEngine:
                 )
                 self._abandon_pending_masks(launch)
                 return False
-            self._stat_add("t_sharded_dispatch", time.perf_counter() - t0)
+            self._stat_stage("t_sharded_dispatch", t0)
             if breaker_demoted_rows:
                 self._count_fallback(breaker_demoted_rows)
             launch._shards = shards
@@ -2286,7 +2321,7 @@ class TpuEngine:
                     faults.SHARD_WORKER, exc, reraise_programming=True
                 )
                 return False  # degrade this launch to the inline path
-            self._stat_add("t_explode", time.perf_counter() - t0)
+            self._stat_stage("t_explode", t0)
             launch.ranges = exploded.ranges
             n = len(exploded.sizes)
             launch.n = n
@@ -2363,21 +2398,27 @@ class TpuEngine:
     def _shard_ladder(
         self, shard: _HostShard, plan: ColumnarPlan, batches, paths,
         structural: bool, n_pad: int | None = None,
+        trace_id: int | None = None,
     ):
         """One shard's host parse/extract ladder (no predicate dispatch):
         explode + find (structural fused or staged), predicate column
         extraction, projection extraction. Fills ``shard`` and returns
         (cols, n_pad). ``n_pad`` pins the row bucket (the mesh path needs
         one COMMON bucket across every device shard so the stacked SPMD
-        input has one shape); None buckets per shard."""
+        input has one shape); None buckets per shard. ``trace_id`` is the
+        launch's, carried EXPLICITLY because shard ladders run on pool
+        workers where no ambient trace is set."""
 
         def stage(key: str, t0: float) -> None:
-            dt = time.perf_counter() - t0
             # shards run concurrently: summing their durations into the
             # launch-wall t_* keys would inflate those ~workers-fold, so
             # per-shard time lands under t_shard_* (CPU-seconds across
-            # workers); the fan-out's wall time is t_sharded_dispatch
-            self._stat_add("t_shard_" + key[2:], dt)
+            # workers); the fan-out's wall time is t_sharded_dispatch.
+            # _stat_stage mirrors the slice into the pandapulse timeline
+            # under the same t_shard_* name (one clock read, shared dt).
+            dt = self._stat_stage(
+                "t_shard_" + key[2:], t0, trace_id=trace_id
+            )
             shard.stages[key] = round(shard.stages.get(key, 0.0) + dt, 6)
 
         t0 = time.perf_counter()
@@ -2487,7 +2528,8 @@ class TpuEngine:
                 dev_cols = entry.cols_dev
         else:
             cols, n_pad = self._shard_ladder(
-                shard, plan, batches, paths, structural
+                shard, plan, batches, paths, structural,
+                trace_id=launch.trace_id,
             )
             if key is not None and shard.n and cols is not None:
                 store_entry = self._shard_cache_entry(
@@ -2502,8 +2544,9 @@ class TpuEngine:
             t0 = time.perf_counter()
             if use_host:
                 slot._mask_np = plan.eval_host_mask(cols)
-                dt = time.perf_counter() - t0
-                self._stat_add("t_shard_dispatch", dt)
+                dt = self._stat_stage(
+                    "t_shard_dispatch", t0, trace_id=launch.trace_id
+                )
                 shard.stages["t_dispatch"] = round(
                     shard.stages.get("t_dispatch", 0.0) + dt, 6
                 )
@@ -2529,8 +2572,9 @@ class TpuEngine:
                     return mask
 
                 mask = self._try_device_leg(faults.DEVICE_DISPATCH, leg)
-                dt = time.perf_counter() - t0
-                self._stat_add("t_shard_dispatch", dt)
+                dt = self._stat_stage(
+                    "t_shard_dispatch", t0, trace_id=launch.trace_id
+                )
                 shard.stages["t_dispatch"] = round(
                     shard.stages.get("t_dispatch", 0.0) + dt, 6
                 )
@@ -2665,7 +2709,7 @@ class TpuEngine:
                 faults.SHARD_WORKER, exc, reraise_programming=True
             )
             return False
-        self._stat_add("t_mesh_ladder", time.perf_counter() - t0)
+        self._stat_stage("t_mesh_ladder", t0)
         shards = [shard for shard, _ in results]
         shard_cols = [cols for _, cols in results]
         zeros = plan.zero_device_inputs(n_pad)
@@ -2718,7 +2762,7 @@ class TpuEngine:
             return mask
 
         mask = self._try_device_leg(faults.MESH_DISPATCH, leg)
-        self._stat_add("t_dispatch", time.perf_counter() - t0)
+        self._stat_stage("t_dispatch", t0)
         if mask is None:
             # exhausted mesh envelope: demote THIS launch to the exact
             # numpy predicate per shard (same columns, identical bits);
@@ -2804,7 +2848,8 @@ class TpuEngine:
             cols = self._shard_from_entry(shard, plan, entry, n_pad)
         else:
             cols, _ = self._shard_ladder(
-                shard, plan, batches, paths, structural, n_pad=n_pad
+                shard, plan, batches, paths, structural, n_pad=n_pad,
+                trace_id=launch.trace_id,
             )
             if key is not None and shard.n and cols is not None:
                 self._colcache.put(
@@ -2832,7 +2877,7 @@ class TpuEngine:
         t0 = time.perf_counter()
         n_pad = _bucket_rows(n)
         staged = self._pack_staged(exploded, n_pad)
-        self._stat_add("t_pack", time.perf_counter() - t0)
+        self._stat_stage("t_pack", t0)
         self._launch_payload(launch, staged, n_pad, fn, r_out)
 
     def _dispatch_payload_ptrs(self, launch: _Launch, pe, n: int) -> None:
@@ -2848,7 +2893,7 @@ class TpuEngine:
         t0 = time.perf_counter()
         n_pad = _bucket_rows(n)
         staged = self._pack_staged_ptrs(pe, n_pad)
-        self._stat_add("t_pack", time.perf_counter() - t0)
+        self._stat_stage("t_pack", t0)
         self._launch_payload(launch, staged, n_pad, fn, r_out)
 
     def _launch_payload(
@@ -2866,7 +2911,7 @@ class TpuEngine:
         t0 = time.perf_counter()
         if not self._breaker.allow_device():
             launch._packed_dev = launch._payload_host_fallback()
-            self._stat_add("t_dispatch", time.perf_counter() - t0)
+            self._stat_stage("t_dispatch", t0)
             return
 
         def leg():
@@ -2879,13 +2924,13 @@ class TpuEngine:
         packed = self._try_device_leg(faults.DEVICE_DISPATCH, leg)
         if packed is None:
             launch._packed_dev = launch._payload_host_fallback()
-            self._stat_add("t_dispatch", time.perf_counter() - t0)
+            self._stat_stage("t_dispatch", t0)
             return
         # dispatch success IS the dispatch-domain verdict (the device
         # accepted the program); whether the RESULT comes back alive is
         # the harvest domain's verdict, recorded at fetch time
         self._breaker.record_success()
-        self._stat_add("t_dispatch", time.perf_counter() - t0)
+        self._stat_stage("t_dispatch", t0)
         self._stat_add("bytes_h2d", staged.nbytes)
         self._stat_add("bytes_d2h", n_pad * (r_out + 8))
         launch._packed_dev = packed
@@ -2944,7 +2989,7 @@ class TpuEngine:
             # measured-host predicate: SAME extracted columns, numpy —
             # what the probe (or the bench ablation) picked on this link
             launch._mask_np = plan.eval_host_mask(cols)
-            self._stat_add("t_dispatch", time.perf_counter() - t0)
+            self._stat_stage("t_dispatch", t0)
             if breaker_demoted:
                 self._count_fallback(n)
         else:
@@ -2971,11 +3016,11 @@ class TpuEngine:
             mask = self._try_device_leg(faults.DEVICE_DISPATCH, leg)
             if mask is None:
                 launch._mask_np = plan.eval_host_mask(cols)
-                self._stat_add("t_dispatch", time.perf_counter() - t0)
+                self._stat_stage("t_dispatch", t0)
                 self._count_fallback(n)
             else:
                 self._breaker.record_success()  # dispatch-domain verdict
-                self._stat_add("t_dispatch", time.perf_counter() - t0)
+                self._stat_stage("t_dispatch", t0)
                 if dev_cols is None:
                     self._stat_add("bytes_h2d", sum(c.nbytes for c in cols))
                 self._stat_add("bytes_d2h", n_pad // 8)
@@ -3003,7 +3048,7 @@ class TpuEngine:
             cache = plan.build_find_cache(
                 exploded.joined, exploded.offsets, exploded.sizes
             )
-            self._stat_add("t_find", time.perf_counter() - t0)
+            self._stat_stage("t_find", t0)
         entry = None
         cols = None
         n_pad = _bucket_rows(n)
@@ -3012,7 +3057,7 @@ class TpuEngine:
             cols = plan.extract_device_inputs(
                 exploded.joined, exploded.offsets, exploded.sizes, n_pad, cache
             )
-            self._stat_add("t_extract_pred", time.perf_counter() - t0)
+            self._stat_stage("t_extract_pred", t0)
             if store_key is not None and self._colcache is not None:
                 entry = colcache.Entry(
                     n=n, n_pad=n_pad, ranges=launch.ranges, cols=cols,
@@ -3035,7 +3080,7 @@ class TpuEngine:
                 entry.proj_data = data
                 entry.proj_ok = ok
                 entry.nbytes = entry._measure()
-        self._stat_add("t_extract_proj", time.perf_counter() - t0)
+        self._stat_stage("t_extract_proj", t0)
         if entry is not None:
             self._colcache.put(store_key, entry)
 
@@ -3054,7 +3099,7 @@ class TpuEngine:
         t0 = time.perf_counter()
         n_pad = _bucket_rows(n)
         cols, proj_data, proj_ok = plan.extract_fused(sp, n_pad)
-        self._stat_add("t_fused_extract", time.perf_counter() - t0)
+        self._stat_stage("t_fused_extract", t0)
         ex = sp.exploded() if plan.passthrough else None
         if plan.passthrough:
             launch._proj_ok = np.ones(n, bool)

@@ -14,3 +14,8 @@ from redpanda_tpu.observability import probes
 from redpanda_tpu.observability.trace import Tracer, tracer
 
 __all__ = ["Tracer", "probes", "tracer"]
+
+# pandapulse (observability/pulse.py) is imported lazily by its consumers
+# (admin, cli, engine tests): importing it here would make every probes
+# user pay its module load, and the flight recorder only matters where it
+# is explicitly configured.

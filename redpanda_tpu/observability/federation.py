@@ -312,6 +312,35 @@ async def _raise_unreachable():
     raise RuntimeError("no admin address advertised")
 
 
+async def _fan_out_json(
+    targets: list[tuple],
+    path: str,
+    timeout_s: float,
+    headers: dict[str, str] | None = None,
+) -> tuple[list[tuple[str, dict]], list[str]]:
+    """Fetch one admin JSON path from every target concurrently — the
+    shared scaffolding of every cluster-assembly fan-out (traces,
+    timelines, resources). Returns ``([(node, doc), ...], unreachable)``:
+    a target with no advertised admin address or a failing fetch lands in
+    ``unreachable`` (partial degradation, never fatal)."""
+    results = await asyncio.gather(
+        *(
+            _fetch_json(base, path, timeout_s, headers)
+            if base else _raise_unreachable()
+            for _node, base in targets
+        ),
+        return_exceptions=True,
+    )
+    docs: list[tuple[str, dict]] = []
+    unreachable: list[str] = []
+    for (node, _base), res in zip(targets, results):
+        if isinstance(res, BaseException):
+            unreachable.append(str(node))
+        else:
+            docs.append((str(node), res))
+    return docs, unreachable
+
+
 async def federated_snapshot(
     targets: list[tuple], timeout_s: float = SCRAPE_TIMEOUT_S,
     headers: dict[str, str] | None = None,
@@ -359,6 +388,48 @@ class FederatedSlo:
     def marks(self) -> list[str]:
         return sorted(self._marks)
 
+    async def _culprit_exemplars(
+        self, culprits: set[str], since_ts: float | None
+    ) -> dict[str, dict]:
+        """Fetch each culprit node's breach-exemplar rings
+        (``GET /v1/slo/exemplars``) once, window-filtered. Unreachable
+        culprits degrade to an empty map — the breach verdict stands
+        either way; the exemplars are forensics, not evidence."""
+        targets = {
+            str(node): base for node, base in self._targets_fn()
+        }
+        headers = self._headers_fn() if self._headers_fn else None
+
+        async def one(node: str):
+            base = targets.get(node)
+            if not base:
+                raise RuntimeError("no admin address advertised")
+            return await _fetch_json(
+                base, "/v1/slo/exemplars", SCRAPE_TIMEOUT_S, headers
+            )
+
+        nodes = sorted(culprits)
+        results = await asyncio.gather(
+            *(one(n) for n in nodes), return_exceptions=True
+        )
+        out: dict[str, dict] = {}
+        for node, res in zip(nodes, results):
+            if isinstance(res, BaseException):
+                out[node] = {"unreachable": True, "exemplars": {}}
+                continue
+            ex = {
+                series: [
+                    e for e in entries
+                    if since_ts is None or e.get("ts", 0) >= since_ts
+                ]
+                for series, entries in (res.get("exemplars") or {}).items()
+            }
+            out[node] = {
+                "unreachable": False,
+                "exemplars": {k: v for k, v in ex.items() if v},
+            }
+        return out
+
     async def evaluate(
         self,
         spec: SloSpec,
@@ -397,7 +468,45 @@ class FederatedSlo:
                                  "mean_ms", "max_ms")
                     }
                 entry["per_node"] = per_node
+                if entry["status"] == "FAIL":
+                    # name the culprit(s) on the breach's face: the nodes
+                    # whose own window failed the same objective (a
+                    # merged-only breach — every node individually under
+                    # the bar but the cluster tail over it — names nobody
+                    # and says so)
+                    entry["culprit_nodes"] = [
+                        n for n, v in sorted(per_node.items())
+                        if v.get("status") == "FAIL"
+                    ]
             results.append(entry)
+        # per-node breach exemplars (carried PR 10 follow-on): ONE
+        # exemplar fetch per distinct culprit node, then each FAIL entry
+        # picks its own series' trace ids out of that node's rings
+        since_ts = (baseline or {}).get("__meta__", {}).get("ts")
+        culprits = {
+            n for r in results for n in r.get("culprit_nodes", ())
+        }
+        if culprits:
+            per_node_ex = await self._culprit_exemplars(culprits, since_ts)
+            for r in results:
+                if not r.get("culprit_nodes"):
+                    continue
+                series = series_key(
+                    r["metric"],
+                    tuple(sorted((r.get("labels") or {}).items())),
+                )
+                ex = {}
+                for n in r["culprit_nodes"]:
+                    doc = per_node_ex.get(n) or {}
+                    entries = (doc.get("exemplars") or {}).get(series, [])
+                    if entries or doc.get("unreachable"):
+                        ex[n] = {
+                            "unreachable": bool(doc.get("unreachable")),
+                            "trace_ids": [e["trace_id"] for e in entries],
+                            "exemplars": entries,
+                        }
+                if ex:
+                    r["node_exemplars"] = ex
         meta = current.get("__meta__", {})
         report = build_report(
             spec, results,
@@ -475,28 +584,144 @@ async def assemble_cluster_trace(
     """Fan ``GET /v1/trace/id/<tid>`` out to every node's admin and merge
     the surviving spans into one cluster-wide trace. Unreachable nodes are
     reported, not fatal — the trace shows what the cluster still knows."""
-    results = await asyncio.gather(
-        *(
-            _fetch_json(base, f"/v1/trace/id/{trace_id}", timeout_s, headers)
-            if base else _raise_unreachable()
-            for _node, base in targets
-        ),
-        return_exceptions=True,
+    docs, unreachable = await _fan_out_json(
+        targets, f"/v1/trace/id/{trace_id}", timeout_s, headers
     )
-    docs: list[dict] = []
-    unreachable: list[str] = []
-    for (node, _base), res in zip(targets, results):
-        if isinstance(res, BaseException):
-            unreachable.append(str(node))
-        else:
-            docs.append(res)
-    out = _merge_trace_docs(trace_id, docs)
+    out = _merge_trace_docs(trace_id, [d for _n, d in docs])
     out["unreachable"] = unreachable
     return out
 
 
+# ================================================================ timelines
+async def assemble_cluster_timeline(
+    targets: list[tuple],
+    launches: int = 0,
+    timeout_s: float = TRACE_FANOUT_TIMEOUT_S,
+    headers: dict[str, str] | None = None,
+) -> dict:
+    """The cluster flight-recorder view: fan ``GET /v1/profile/timeline``
+    out to every node's admin and merge the per-node Chrome trace events
+    into ONE Perfetto-loadable document.
+
+    Events keep their per-node ``pid`` (each node's spans already carry
+    the span-level node stamp, so process tracks separate cleanly) and
+    re-anchor ``ts`` on each node's tracer wall epoch exactly like
+    ``assemble_cluster_trace``. In-process stacks share one recorder, so
+    every fetch returns the same spans — events dedupe by span id (instant
+    events by journal seq, metadata by identity key). Unreachable nodes
+    are reported, never fatal."""
+    docs, unreachable = await _fan_out_json(
+        targets, f"/v1/profile/timeline?launches={int(launches)}",
+        timeout_s, headers,
+    )
+    epoch0 = min(
+        (d.get("epoch") or 0.0 for _n, d in docs if d.get("traceEvents")),
+        default=0.0,
+    )
+    events: list[dict] = []
+    seen: set = set()
+    n_launches = 0
+    for node, d in docs:
+        shift_us = (d.get("epoch", epoch0) or epoch0) - epoch0
+        shift_us *= 1e6
+        n_launches = max(n_launches, int(d.get("launches") or 0))
+        for ev in d.get("traceEvents", []):
+            ph = ev.get("ph")
+            if ph == "M":
+                key = ("M", ev.get("pid"), ev.get("tid"), ev.get("name"),
+                       str(ev.get("args")))
+            elif ph == "i":
+                key = ("i", (ev.get("args") or {}).get("seq"),
+                       ev.get("name"), ev.get("ts"))
+            else:
+                sid = (ev.get("args") or {}).get("span_id")
+                key = (
+                    ("X", sid)
+                    if sid is not None
+                    else ("X", ev.get("pid"), ev.get("name"), ev.get("ts"),
+                          ev.get("dur"))
+                )
+            if key in seen:
+                continue
+            seen.add(key)
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + shift_us
+            ev.setdefault("args", {})
+            ev["args"].setdefault("src_node", node)
+            events.append(ev)
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+        "nodes": sorted(n for n, _d in docs),
+        "unreachable": unreachable,
+        "partial": bool(unreachable),
+        "launches": n_launches,
+    }
+
+
+# ================================================================ resources
+_PRESSURE_RANK = {"ok": 0, "warn": 1, "critical": 2}
+
+
+async def assemble_cluster_resources(
+    targets: list[tuple],
+    timeout_s: float = SCRAPE_TIMEOUT_S,
+    headers: dict[str, str] | None = None,
+) -> dict:
+    """Merge every node's ``GET /v1/resources`` budget-plane view (the
+    read-side half of the federated autotune follow-on, and the occupancy
+    column for cluster timelines): per-account ``limit/held/peak`` bytes
+    SUM across nodes; ``occupancy`` and the pressure signal report the
+    WORST node (summing occupancies would hide one saturated broker
+    behind two idle ones). Per-node bodies ride along for drill-down."""
+    docs, unreachable = await _fan_out_json(
+        targets, "/v1/resources", timeout_s, headers
+    )
+    nodes: dict[str, dict] = dict(docs)
+    accounts: dict[str, dict] = {}
+    worst_pressure = "ok"
+    worst_node = None
+    for node, body in sorted(nodes.items()):
+        if not body.get("enabled"):
+            continue
+        p = str(body.get("pressure", "ok"))
+        if _PRESSURE_RANK.get(p, 0) > _PRESSURE_RANK.get(worst_pressure, 0):
+            worst_pressure, worst_node = p, node
+        for name, acct in (body.get("accounts") or {}).items():
+            a = accounts.setdefault(name, {
+                "limit_bytes": 0, "held_bytes": 0, "peak_bytes": 0,
+                "max_occupancy": 0.0, "max_occupancy_node": None,
+                "nodes": {},
+            })
+            a["limit_bytes"] += int(acct.get("limit_bytes", 0))
+            a["held_bytes"] += int(acct.get("held_bytes", 0))
+            a["peak_bytes"] += int(acct.get("peak_bytes", 0))
+            occ = float(acct.get("occupancy", 0.0))
+            if occ >= a["max_occupancy"]:
+                a["max_occupancy"] = occ
+                a["max_occupancy_node"] = node
+            a["nodes"][node] = {
+                "held_bytes": acct.get("held_bytes"),
+                "peak_bytes": acct.get("peak_bytes"),
+                "occupancy": occ,
+            }
+    return {
+        "federated": True,
+        "enabled": any(b.get("enabled") for b in nodes.values()),
+        "pressure": worst_pressure,
+        "pressure_node": worst_node,
+        "accounts": accounts,
+        "nodes": nodes,
+        "unreachable": unreachable,
+        "partial": bool(unreachable),
+    }
+
+
 __all__ = [
     "FederatedSlo",
+    "assemble_cluster_resources",
+    "assemble_cluster_timeline",
     "assemble_cluster_trace",
     "federated_snapshot",
     "merge_scrapes",

@@ -178,6 +178,10 @@ class Tracer:
         self.slow_threshold_us = float(slow_threshold_ms) * 1000.0
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._slow: collections.deque = collections.deque(maxlen=slow_capacity)
+        # Committed-span sink (pandapulse flight recorder). One attribute
+        # check per commit when unset; the sink itself must be cheap and
+        # never raise (it runs inside every instrumented hot path).
+        self._sink = None
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._span_ids = itertools.count(1)
@@ -227,6 +231,12 @@ class Tracer:
             self._ring.clear()
             self._slow.clear()
             self._recorded = 0
+
+    def set_sink(self, sink) -> None:
+        """Install (or clear, with ``None``) the committed-span sink — the
+        pandapulse flight recorder's feed. Exactly one sink: the recorder
+        owns fan-out if it ever needs one."""
+        self._sink = sink
 
     # ------------------------------------------------------------ ids
     def new_trace_id(self) -> int:
@@ -370,6 +380,11 @@ class Tracer:
                 slow = True
             else:
                 slow = False
+        sink = self._sink
+        if sink is not None:
+            # outside the lock: the recorder has its own bounded ring and
+            # must never serialize behind the tracer's
+            sink(span)
         if slow:
             logger.warning(
                 "slow span %s: %.1f ms (trace %d, thread %s)",

@@ -1,0 +1,79 @@
+"""Fixture for the PRF15xx raw pair-timing checker (exact-line tests)."""
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+
+def logged_not_routed():
+    t0 = time.perf_counter()
+    do_work()
+    dt = time.perf_counter() - t0          # line 11: PRF1501 (only logged)
+    logger.info("stage took %.3fs", dt)
+
+
+def stored_in_dict(self):
+    t0 = time.perf_counter()
+    do_work()
+    self.timings["x"] = time.perf_counter() - t0   # line 18: PRF1501
+
+
+def dropped_on_the_floor():
+    t0 = time.monotonic()
+    do_work()
+    elapsed = t0 - time.monotonic()        # line 24: PRF1501 (never used)
+    del elapsed
+
+
+def mixed_clocks():
+    t0 = time.monotonic()
+    do_work()
+    return time.perf_counter() - t0        # line 31: PRF1502 (mixed epochs)
+
+
+def nested_scope_unrouted():
+    def inner():
+        t0 = time.perf_counter()
+        do_work()
+        print(time.perf_counter() - t0)    # line 38: PRF1501 (own scope)
+    inner()
+
+
+def routed_through_stat(self):
+    t0 = time.perf_counter()
+    do_work()
+    self._stat_add("t_stage", time.perf_counter() - t0)  # ok: _stat sink
+
+
+def routed_through_probe(hist):
+    t0 = time.perf_counter()
+    do_work()
+    record_us(hist, int((time.perf_counter() - t0) * 1e6))  # ok: record sink
+
+
+def routed_by_return():
+    t0 = time.perf_counter()
+    do_work()
+    return time.perf_counter() - t0        # ok: caller owns routing
+
+
+def routed_via_min_then_return():
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        do_work()
+        best = min(best, time.perf_counter() - t0)  # ok: flows into return
+    return {"best_s": round(best, 6)}
+
+
+def deadline_math_is_not_measurement(timeout_s):
+    start = time.monotonic()
+    while time.monotonic() - start < timeout_s:    # ok: comparison
+        do_work()
+    dt = time.monotonic() - start
+    if dt > timeout_s:                             # ok: var in comparison
+        raise TimeoutError
+
+
+def do_work():
+    pass

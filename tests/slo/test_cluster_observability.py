@@ -239,3 +239,17 @@ def test_federated_slo_fails_under_rpc_delay_with_resolvable_trace(tmp_path):
     assert local_by_name["rpc_p99"]["status"] == "FAIL"
     assert assembled is not None, "no exemplar resolved to a cluster trace"
     assert len(assembled["nodes"]) >= 2, assembled["nodes"]
+    # ISSUE 14 satellite: the FEDERATED breach entry names its culprit
+    # node(s) and carries their exemplar trace ids (fetched over the
+    # per-node /v1/slo/exemplars fan-out), each resolvable exactly like
+    # the local exemplar above
+    fed_rpc = fed_by_name["rpc_p99"]
+    assert fed_rpc.get("culprit_nodes"), fed_rpc
+    node_ex = fed_rpc.get("node_exemplars") or {}
+    assert node_ex, "federated breach carries no per-node exemplars"
+    fed_tids = {t for d in node_ex.values() for t in d.get("trace_ids", [])}
+    local_tids = {
+        ex["trace_id"]
+        for ex in (local_by_name["rpc_p99"].get("exemplars") or [])
+    }
+    assert fed_tids & local_tids, (fed_tids, local_tids)

@@ -302,6 +302,171 @@ def test_federated_slo_judges_merged_window():
     assert o["per_node"]["0"]["status"] == "PASS"
 
 
+def test_federated_breach_fetches_culprit_exemplars(monkeypatch):
+    """ISSUE 14 satellite: a FAIL entry names the node(s) whose own
+    window failed and carries that node's exemplar trace ids, fetched
+    once per culprit over /v1/slo/exemplars and filtered to the incident
+    window; an unreachable culprit degrades visibly."""
+    regs = {str(i): MetricsRegistry() for i in range(3)}
+    for r in regs.values():
+        for _ in range(50):
+            r.histogram(KEY, "x").record(1_000)
+    for _ in range(200):
+        regs["1"].histogram(KEY, "x").record(50_000_000)  # node 1 breaches
+
+    fetched = []
+
+    async def fake_fetch(base, path, timeout_s, headers=None):
+        fetched.append((base, path))
+        if base == "http://n1":
+            return {
+                "node": 1,
+                "exemplars": {
+                    KEY: [
+                        {"trace_id": 42, "value_us": 50_000_000,
+                         "bucket_us": 50_331_648, "ts": 10.0},
+                        {"trace_id": 7, "value_us": 49_000_000,
+                         "bucket_us": 50_331_648, "ts": 1.0},  # pre-window
+                    ],
+                    "other_series": [
+                        {"trace_id": 9, "value_us": 1, "ts": 10.0}
+                    ],
+                },
+            }
+        raise RuntimeError("down")
+
+    monkeypatch.setattr(fed, "_fetch_json", fake_fetch)
+
+    class FakeFed(fed.FederatedSlo):
+        async def snapshot(self):
+            snap = fed.merge_scrapes({
+                n: fed.parse_prometheus(r.render_prometheus())
+                for n, r in regs.items()
+            })
+            snap["__meta__"] = {
+                "ts": 5.0, "nodes": sorted(regs), "unreachable": [],
+            }
+            return snap
+
+    spec = SloSpec("fedtest", [Objective("p99", KEY, 100.0, 99.0, 10)])
+    engine = FakeFed(lambda: [("1", "http://n1"), ("2", "http://n2")])
+    # mark first so since_ts (5.0) filters the pre-window exemplar
+    asyncio.run(engine.set_mark("inc"))
+    for _ in range(200):
+        regs["1"].histogram(KEY, "x").record(50_000_000)
+    report = asyncio.run(engine.evaluate(spec, mark="inc"))
+    o = report["objectives"][0]
+    assert o["status"] == "FAIL"
+    assert o["culprit_nodes"] == ["1"]
+    ex = o["node_exemplars"]["1"]
+    assert ex["unreachable"] is False
+    assert ex["trace_ids"] == [42]  # windowed: ts 1.0 dropped
+    # only the culprit was fetched, and only once
+    assert [f for f in fetched if f[1] == "/v1/slo/exemplars"] == [
+        ("http://n1", "/v1/slo/exemplars")
+    ]
+    # an unreachable culprit degrades to a visible empty entry
+    engine2 = FakeFed(lambda: [("1", None)])
+    report2 = asyncio.run(engine2.evaluate(spec))
+    o2 = report2["objectives"][0]
+    assert o2["culprit_nodes"] == ["1"]
+    assert o2["node_exemplars"]["1"]["unreachable"] is True
+
+
+def test_assemble_cluster_resources_merges_accounts(monkeypatch):
+    bodies = {
+        "http://n0": {
+            "enabled": True, "pressure": "ok",
+            "max_occupancy": 0.10, "max_occupancy_account": "rpc",
+            "accounts": {
+                "coproc": {"limit_bytes": 100, "held_bytes": 10,
+                           "peak_bytes": 20, "occupancy": 0.10},
+                "rpc": {"limit_bytes": 50, "held_bytes": 5,
+                        "peak_bytes": 6, "occupancy": 0.10},
+            },
+        },
+        "http://n1": {
+            "enabled": True, "pressure": "warn",
+            "max_occupancy": 0.80, "max_occupancy_account": "coproc",
+            "accounts": {
+                "coproc": {"limit_bytes": 100, "held_bytes": 80,
+                           "peak_bytes": 90, "occupancy": 0.80},
+            },
+        },
+    }
+
+    async def fake_fetch(base, path, timeout_s, headers=None):
+        assert path == "/v1/resources"
+        return bodies[base]
+
+    monkeypatch.setattr(fed, "_fetch_json", fake_fetch)
+    out = asyncio.run(fed.assemble_cluster_resources(
+        [("0", "http://n0"), ("1", "http://n1"), ("2", None)]
+    ))
+    assert out["federated"] and out["enabled"]
+    assert out["unreachable"] == ["2"] and out["partial"]
+    assert out["pressure"] == "warn" and out["pressure_node"] == "1"
+    cop = out["accounts"]["coproc"]
+    assert cop["limit_bytes"] == 200
+    assert cop["held_bytes"] == 90
+    assert cop["peak_bytes"] == 110
+    assert cop["max_occupancy"] == 0.80
+    assert cop["max_occupancy_node"] == "1"
+    assert set(cop["nodes"]) == {"0", "1"}
+    # rpc exists on one node only; the merge still carries it
+    assert out["accounts"]["rpc"]["limit_bytes"] == 50
+
+
+def test_assemble_cluster_timeline_dedupes_and_reanchors(monkeypatch):
+    shared_span = {
+        "name": "coproc.tick", "ph": "X", "ts": 10.0, "dur": 5,
+        "pid": 0, "tid": 1, "args": {"span_id": 77, "trace_id": 1},
+    }
+    docs = {
+        "http://n0": {
+            "epoch": 100.0, "launches": 1,
+            "traceEvents": [
+                {"ph": "M", "name": "thread_name", "pid": 0, "tid": 1,
+                 "args": {"name": "MainThread [loop]"}},
+                dict(shared_span),
+            ],
+        },
+        "http://n1": {
+            "epoch": 101.0, "launches": 1,
+            "traceEvents": [
+                # the SAME span (in-process stacks share one recorder):
+                # must dedupe by span id even with a different epoch
+                dict(shared_span),
+                {"name": "coproc.stage.seal", "ph": "X", "ts": 3.0,
+                 "dur": 2, "pid": 1, "tid": 2,
+                 "args": {"span_id": 88, "trace_id": 1}},
+                {"name": "admission:shed", "ph": "i", "s": "p", "ts": 4.0,
+                 "pid": 1, "tid": 3, "args": {"seq": 5}},
+            ],
+        },
+    }
+
+    async def fake_fetch(base, path, timeout_s, headers=None):
+        assert path.startswith("/v1/profile/timeline")
+        return docs[base]
+
+    monkeypatch.setattr(fed, "_fetch_json", fake_fetch)
+    out = asyncio.run(fed.assemble_cluster_timeline(
+        [("0", "http://n0"), ("1", "http://n1"), ("2", None)], launches=4
+    ))
+    assert out["nodes"] == ["0", "1"]
+    assert out["unreachable"] == ["2"] and out["partial"]
+    xs = [e for e in out["traceEvents"] if e.get("ph") == "X"]
+    # span 77 deduped to ONE event despite arriving from both nodes
+    assert [e["args"]["span_id"] for e in xs].count(77) == 1
+    # node 1's events re-anchored onto node 0's (earlier) epoch: +1s
+    seal = next(e for e in xs if e["args"]["span_id"] == 88)
+    assert seal["ts"] == pytest.approx(3.0 + 1e6)
+    inst = next(e for e in out["traceEvents"] if e.get("ph") == "i")
+    assert inst["ts"] == pytest.approx(4.0 + 1e6)
+    assert any(e.get("ph") == "M" for e in out["traceEvents"])
+
+
 def test_parse_prometheus_escaped_labels_and_inf():
     text = (
         "# TYPE redpanda_tpu_h us histogram\n"

@@ -267,6 +267,70 @@ def test_backpressure_in_tree_pragmas_reasoned():
         ]
 
 
+def test_perf_timing_rules_exact_lines():
+    got = _active(
+        _lint(
+            os.path.join(FIXTURES, "perf_timing.py"),
+            relpath="redpanda_tpu/coproc/perf_timing.py",
+        )
+    )
+    prf = sorted(f for f in got if f[0].startswith("PRF"))
+    assert prf == [
+        ("PRF1501", 11),  # delta only logged — the recorder never sees it
+        ("PRF1501", 18),  # delta stored into a dict, never routed
+        ("PRF1501", 24),  # delta dropped on the floor
+        ("PRF1501", 38),  # nested def is its own scope; print is no sink
+        ("PRF1502", 31),  # monotonic start, perf_counter end: no shared epoch
+    ], prf
+
+
+def test_perf_timing_routed_shapes_stay_clean():
+    """_stat/record/observe sinks, returns, min()-fold-then-return and
+    deadline comparisons are all routed/exempt; outside the hot-path
+    packages the checker is silent wholesale."""
+    findings = _lint(
+        os.path.join(FIXTURES, "perf_timing.py"),
+        relpath="redpanda_tpu/coproc/perf_timing.py",
+    )
+    prf_lines = {f.line for f in findings if f.rule.startswith("PRF")}
+    # routed_through_stat / routed_through_probe / routed_by_return /
+    # min-fold / deadline-math lines must stay clean
+    for clean_line in (45, 51, 57, 64, 71, 73, 74):
+        assert clean_line not in prf_lines, sorted(prf_lines)
+    for scope_rel, expect in (
+        ("redpanda_tpu/kafka/x.py", True),
+        ("redpanda_tpu/rpc/x.py", True),
+        ("redpanda_tpu/raft/x.py", True),
+        ("redpanda_tpu/observability/x.py", False),
+        ("redpanda_tpu/storage/x.py", False),
+    ):
+        found = any(
+            f.rule.startswith("PRF")
+            for f in _lint(
+                os.path.join(FIXTURES, "perf_timing.py"), relpath=scope_rel
+            )
+        )
+        assert found is expect, scope_rel
+
+
+def test_perf_timing_in_tree_clean():
+    """The hot-path packages themselves must carry no unrouted raw
+    pair-timing — the pulse single-source-of-timing invariant. (The
+    strict gate enforces this too; this test names the contract.)"""
+    eng = LintEngine(rules={"PRF1501", "PRF1502"}, cache_path=None)
+    reports = eng.lint_paths([
+        os.path.join(REPO, "redpanda_tpu", sub)
+        for sub in ("coproc", "kafka", "rpc", "raft")
+    ])
+    active = [
+        (r.relpath, f.rule, f.line)
+        for r in reports
+        for f in r.findings
+        if not f.suppressed
+    ]
+    assert active == [], active
+
+
 def test_mesh_ctx_rules_exact_lines():
     got = _active(
         _lint(

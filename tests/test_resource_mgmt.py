@@ -529,6 +529,22 @@ def test_admin_resources_endpoint(tmp_path):
             assert body["accounts"]["coproc"]["peak_bytes"] == 1234
             assert body["pressure"] == "ok"
             assert body["produce_admission"]["sheds"] == 0
+            # ISSUE 14 satellite: ?federated=1 merges the budget plane
+            # over the admin fan-out (single node here: self only) —
+            # `rpk debug resources --federated`
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{admin.port}/v1/resources?federated=1"
+                ) as r:
+                    assert r.status == 200
+                    fed_body = await r.json()
+            assert fed_body["federated"] is True
+            assert fed_body["enabled"] is True
+            assert fed_body["unreachable"] == []
+            cop = fed_body["accounts"]["coproc"]
+            assert cop["held_bytes"] == 1234
+            assert cop["max_occupancy_node"] == "0"
+            assert "0" in fed_body["nodes"]
             # archival surface answers 409 when tiered storage is off
             async with aiohttp.ClientSession() as s:
                 async with s.post(

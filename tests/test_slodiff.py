@@ -1,0 +1,179 @@
+"""tools/slodiff.py: the SLO-gated release diff (ROADMAP item 6 cap).
+
+Verdict semantics under noise bands: worse-beyond-band = REGRESS,
+worse-within-band = WEATHER, improved/flat = PASS, PASS->FAIL status
+flips = REGRESS regardless of the band (the threshold is the contract),
+idle objectives judge nothing. BENCH artifacts default their band to the
+larger of the two runs' measured A/A skew.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tools import slodiff
+
+
+def _obj(name, observed_ms, status="PASS", threshold_ms=100.0):
+    return {
+        "name": name, "metric": f"{name}_us", "quantile": 99.0,
+        "threshold_ms": threshold_ms, "status": status,
+        "observed_ms": observed_ms, "samples": 500,
+    }
+
+
+def _report(objs, produced=1000.0):
+    return {
+        "scenario": "mixed_64p",
+        "objectives": objs,
+        "throughput": {
+            "produced_records_per_s": produced,
+            "produce_ops_per_s": produced / 8.0,
+        },
+    }
+
+
+def test_slo_verdicts_pass_weather_regress():
+    old = _report([
+        _obj("a", 10.0), _obj("b", 10.0), _obj("c", 10.0),
+    ])
+    new = _report([
+        _obj("a", 9.0),    # improved -> PASS
+        _obj("b", 11.5),   # +15% inside the 20% band -> WEATHER
+        _obj("c", 14.0),   # +40% beyond the band -> REGRESS
+    ])
+    d = slodiff.diff_artifacts(old, new, band_pct=20.0)
+    verdicts = {o["name"]: o["verdict"] for o in d["objectives"]}
+    assert verdicts == {"a": "PASS", "b": "WEATHER", "c": "REGRESS"}
+    assert d["verdict"] == "REGRESS"
+    assert d["kind"] == "slo"
+
+
+def test_status_flip_regresses_even_inside_the_band():
+    old = _report([_obj("a", 99.0, status="PASS")])
+    new = _report([_obj("a", 101.0, status="FAIL")])
+    d = slodiff.diff_artifacts(old, new, band_pct=50.0)
+    o = d["objectives"][0]
+    assert o["verdict"] == "REGRESS"
+    assert "PASS -> FAIL" in o["detail"]
+
+
+def test_recovery_and_no_data_judge_nothing_bad():
+    old = _report([
+        _obj("a", 150.0, status="FAIL"),
+        _obj("idle", None, status="NO_DATA"),
+    ])
+    new = _report([
+        _obj("a", 50.0, status="PASS"),      # recovered
+        _obj("idle", None, status="NO_DATA"),
+        _obj("brand_new", 5.0),              # no baseline objective
+    ])
+    d = slodiff.diff_artifacts(old, new, band_pct=20.0)
+    verdicts = {o["name"]: o["verdict"] for o in d["objectives"]}
+    assert verdicts["a"] == "PASS"
+    assert verdicts["idle"] == "NO_DATA"
+    assert verdicts["brand_new"] == "NO_DATA"
+    assert d["verdict"] == "PASS"
+
+
+def test_relabeled_objective_is_not_compared():
+    """Same objective NAME over a different series (metric or labels
+    changed): the values are apples-to-oranges and must read NO_DATA
+    with the change named, not a verdict."""
+    old_o = _obj("coproc_p95", 0.188)
+    old_o["labels"] = {"stage": "explode"}
+    new_o = _obj("coproc_p95", 0.158)
+    new_o["labels"] = {"stage": "explode_ptrs"}
+    d = slodiff.diff_artifacts(_report([old_o]), _report([new_o]))
+    o = d["objectives"][0]
+    assert o["verdict"] == "NO_DATA"
+    assert "series changed" in o["detail"]
+    assert "explode" in o["detail"] and "explode_ptrs" in o["detail"]
+
+
+def test_all_no_data_diff_is_not_a_pass():
+    """A diff that judged nothing must say NO_DATA, not PASS (the
+    overload-report shape: no objectives, no throughput keys)."""
+    d = slodiff.diff_artifacts(
+        {"objectives": []}, {"objectives": []}, band_pct=20.0
+    )
+    assert d["verdict"] == "NO_DATA"
+
+
+def test_throughput_drop_judged_higher_is_better():
+    old = _report([_obj("a", 10.0)], produced=1000.0)
+    new = _report([_obj("a", 10.0)], produced=600.0)  # -40%
+    d = slodiff.diff_artifacts(old, new, band_pct=20.0)
+    thr = {t["name"]: t["verdict"] for t in d["throughput"]}
+    assert thr["produced_records_per_s"] == "REGRESS"
+    assert d["verdict"] == "REGRESS"
+
+
+def test_load_confounded_regress_carries_caveat():
+    """p99 worse while throughput rose beyond the band: the REGRESS
+    verdict stands but the diff names the confound on its face."""
+    old = _report([_obj("a", 10.0)], produced=600.0)
+    new = _report([_obj("a", 14.0)], produced=1000.0)  # +67% load
+    d = slodiff.diff_artifacts(old, new, band_pct=20.0)
+    assert d["verdict"] == "REGRESS"
+    assert d.get("caveats"), d
+    assert "load-confounded" in d["caveats"][0]
+    # no caveat when load did not rise beyond the band
+    d2 = slodiff.diff_artifacts(
+        _report([_obj("a", 10.0)], produced=1000.0),
+        _report([_obj("a", 14.0)], produced=1010.0),
+        band_pct=20.0,
+    )
+    assert not d2.get("caveats")
+
+
+def test_bench_band_defaults_to_measured_aa_skew():
+    old = {
+        "metric": "m", "value": 100_000.0, "aa_skew_pct": 12.0,
+        "cfg": {"record_batches_per_sec": 5000.0},
+    }
+    new = {
+        "metric": "m", "value": 91_000.0, "aa_skew_pct": 8.0,  # -9% < 12%
+        "cfg": {"record_batches_per_sec": 3000.0},             # -40%
+    }
+    d = slodiff.diff_artifacts(old, new)
+    assert d["kind"] == "bench"
+    assert d["band_pct"] == 12.0  # the larger of the two A/A skews
+    by = {c["name"]: c["verdict"] for c in d["configs"]}
+    assert by["headline"] == "WEATHER"
+    assert by["cfg"] == "REGRESS"
+    assert d["verdict"] == "REGRESS"
+
+
+def test_cli_round_trip_and_exit_codes(tmp_path, capsys):
+    old_p, new_p = tmp_path / "old.json", tmp_path / "new.json"
+    old_p.write_text(json.dumps(_report([_obj("a", 10.0)])))
+    new_p.write_text(json.dumps(_report([_obj("a", 11.0)])))
+    assert slodiff.main([str(old_p), str(new_p)]) == 0  # WEATHER exits 0
+    out = capsys.readouterr().out
+    assert "WEATHER" in out and "verdict:" in out
+    new_p.write_text(json.dumps(_report([_obj("a", 40.0)])))
+    assert slodiff.main([str(old_p), str(new_p), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["verdict"] == "REGRESS"
+    # driver-wrapped artifacts unwrap under "parsed"
+    wrapped = tmp_path / "wrapped.json"
+    wrapped.write_text(json.dumps({"parsed": _report([_obj("a", 10.0)])}))
+    assert slodiff.main([str(wrapped), str(old_p)]) == 0
+
+
+def test_unrecognized_artifact_raises():
+    with pytest.raises(ValueError):
+        slodiff.diff_artifacts({"x": 1}, {"y": 2})
+
+
+def test_committed_artifacts_diff_cleanly():
+    """The repo's own artifacts stay parseable by the release flow."""
+    old = slodiff._load("SLO_r10.json")
+    d = slodiff.diff_artifacts(old, old)
+    assert d["verdict"] == "PASS"  # self-diff can never regress
+    assert all(
+        o["verdict"] in ("PASS", "NO_DATA") for o in d["objectives"]
+    )

@@ -981,6 +981,143 @@ def bench_governor_overhead(secs: float) -> dict:
     }
 
 
+def bench_pulse_overhead(secs: float) -> dict:
+    """Cost of the pandapulse flight recorder on a real columnar launch.
+
+    The recorder rides the tracer's commit path: with pulse OFF the
+    marginal cost is one attribute check inside ``Tracer._commit``; with
+    pulse ON it is one bounded-deque append (+ a counter lock) per
+    committed span. The tracer itself is priced and gated separately
+    (``tracer_overhead`` / ``trace_propagation_overhead``) — this bench
+    answers the ISSUE 14 acceptance question: recorder-on vs recorder-off
+    on the SAME traced launch.
+
+    Derived min-of-blocks discipline (wall A/B can't resolve sub-1% on a
+    shared box): (per-span sink cost x spans-per-launch, both measured) /
+    (per-launch cost). ``pulse_overhead_with_tracer_pct`` reports the
+    tracer-inclusive number for context — what a fully dark launch pays
+    to become a timeline. Also pins the profiler-off posture: profile_hz=0
+    must run NO sampler thread."""
+    import json as _json
+    import threading as _threading
+
+    from redpanda_tpu.coproc import TpuEngine, ProcessBatchRequest
+    from redpanda_tpu.coproc.engine import ProcessBatchItem
+    from redpanda_tpu.models import NTP, Record, RecordBatch
+    from redpanda_tpu.observability.pulse import FlightRecorder
+    from redpanda_tpu.observability.trace import Tracer, tracer
+    from redpanda_tpu.ops.exprs import field
+    from redpanda_tpu.ops.transforms import Int, Str, map_project, where
+
+    engine = TpuEngine(
+        row_stride=256, compress_threshold=10**9,
+        force_mode="columnar_host", host_workers=0,
+    )
+    spec = where(field("level") == "error") | map_project(
+        Int("code"), Str("msg", 16)
+    )
+    engine.enable_coprocessors([(1, spec.to_json(), ("orders",))])
+    recs = [
+        Record(
+            offset_delta=i, timestamp_delta=i,
+            value=_json.dumps(
+                {"level": ["error", "info"][i % 2], "code": i, "msg": f"m{i}"},
+                separators=(",", ":"),
+            ).encode(),
+        )
+        for i in range(512)
+    ]
+    batch = RecordBatch.build(recs, base_offset=0, first_timestamp=1000)
+    req = ProcessBatchRequest(
+        [ProcessBatchItem(1, NTP.kafka("orders", 0), [batch])]
+    )
+
+    def op():
+        engine.process_batch(req)
+
+    def timed_block(fn, k: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(k):
+            fn()
+        return time.perf_counter() - t0
+
+    op()  # warmup
+    per_op = min(timed_block(op, 2) / 2 for _ in range(3))
+    k = max(2, int(0.01 / per_op))
+    rounds = max(12, int(secs / (k * per_op)))
+    best_op = min(timed_block(op, k) / k for _ in range(rounds))
+
+    # spans one traced launch commits (recorder installed, fresh ring):
+    # the multiplier in the derived overhead
+    was_enabled = tracer.enabled
+    was_sink = tracer._sink
+    probe_rec = FlightRecorder()
+    tracer.configure(enabled=True)
+    tracer.set_sink(probe_rec.record)
+    try:
+        req.trace_id = tracer.new_trace_id()
+        op()
+    finally:
+        tracer.set_sink(was_sink)
+        tracer.configure(enabled=was_enabled)
+        req.trace_id = None
+    spans_per_launch = len(probe_rec.spans())
+
+    # per-call costs on PRIVATE instances (the live tracer/recorder rings
+    # must not absorb bench spam): the sink append alone (the recorder-on
+    # delta) and the full enabled commit+sink (tracer-inclusive context)
+    scratch_rec = FlightRecorder(capacity=4096)
+    scratch_tr = Tracer(enabled=True, capacity=2048)
+    span_dict = {
+        "trace_id": 1, "name": "coproc.stage.bench", "start_us": 0,
+        "dur_us": 5, "thread": "bench", "span_id": 1,
+    }
+    sink_ns = commit_ns = commit_dark_ns = float("inf")
+    n_raw = 5000
+    for _ in range(10):
+        t0 = time.perf_counter()
+        for _ in range(n_raw):
+            scratch_rec.record(span_dict)
+        sink_ns = min(sink_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+        scratch_tr._sink = scratch_rec.record
+        t0 = time.perf_counter()
+        for _ in range(n_raw):
+            scratch_tr.record("coproc.stage.bench", 5.0, 1)
+        commit_ns = min(commit_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+        scratch_tr._sink = None
+        t0 = time.perf_counter()
+        for _ in range(n_raw):
+            scratch_tr.record("coproc.stage.bench", 5.0, 1)
+        commit_dark_ns = min(
+            commit_dark_ns, (time.perf_counter() - t0) / n_raw * 1e9
+        )
+    engine.shutdown()
+    launch_ns = best_op * 1e9
+    pct = spans_per_launch * sink_ns / launch_ns * 100.0 if launch_ns else 0.0
+    with_tracer_pct = (
+        spans_per_launch * commit_ns / launch_ns * 100.0 if launch_ns else 0.0
+    )
+    profiler_threads = sum(
+        1 for t in _threading.enumerate()
+        if t.name == "rptpu-pulse-profiler"
+    )
+    out = {
+        "pulse_sink_append_ns": round(sink_ns, 1),
+        "pulse_span_commit_sink_ns": round(commit_ns, 1),
+        "pulse_span_commit_dark_ns": round(commit_dark_ns, 1),
+        "pulse_spans_per_launch": spans_per_launch,
+        "pulse_launch_cost_us": round(best_op * 1e6, 1),
+        "pulse_overhead_pct": round(pct, 3),
+        "pulse_overhead_with_tracer_pct": round(with_tracer_pct, 3),
+    }
+    if profiler_threads:
+        # profiler-off steady state: NO sampler thread may exist. The key
+        # only appears on violation (the assert flag reads .get(..., 0),
+        # and the all-benches positivity smoke would trip on a good 0).
+        out["pulse_profiler_off_threads"] = profiler_threads
+    return out
+
+
 def bench_trace_propagation_overhead(secs: float) -> dict:
     """Cost of pandascope trace propagation on an rpc round trip.
 
@@ -1146,6 +1283,7 @@ BENCHES = {
     "slo_eval_overhead": bench_slo_eval_overhead,
     "governor_overhead": bench_governor_overhead,
     "admission_overhead": bench_admission_overhead,
+    "pulse_overhead": bench_pulse_overhead,
 }
 
 
@@ -1218,6 +1356,15 @@ def main(argv=None) -> int:
         "produce op; implies the admission_overhead bench",
     )
     p.add_argument(
+        "--assert-pulse-overhead",
+        type=float,
+        metavar="PCT",
+        help="fail (exit 1) if the pandapulse flight recorder's derived "
+        "share of a real columnar launch exceeds PCT (e.g. 1 = 1%%), or "
+        "if a profiler thread exists with profile_hz=0; implies the "
+        "pulse_overhead bench",
+    )
+    p.add_argument(
         "--assert-harvest-speedup",
         type=float,
         metavar="RATIO",
@@ -1271,6 +1418,8 @@ def main(argv=None) -> int:
         names.append("explode_find")
     if args.assert_slo_overhead is not None and "slo_eval_overhead" not in names:
         names.append("slo_eval_overhead")
+    if args.assert_pulse_overhead is not None and "pulse_overhead" not in names:
+        names.append("pulse_overhead")
     if args.assert_governor_overhead is not None and "governor_overhead" not in names:
         names.append("governor_overhead")
     if args.assert_admission_overhead is not None and "admission_overhead" not in names:
@@ -1362,6 +1511,22 @@ def main(argv=None) -> int:
             print(
                 f"governor hook overhead {pct}% exceeds budget "
                 f"{args.assert_governor_overhead}%",
+                file=sys.stderr,
+            )
+            return 1
+    if args.assert_pulse_overhead is not None:
+        pct = out.get("pulse_overhead_pct", 0.0)
+        if pct > args.assert_pulse_overhead:
+            print(
+                f"pulse recorder overhead {pct}% exceeds budget "
+                f"{args.assert_pulse_overhead}%",
+                file=sys.stderr,
+            )
+            return 1
+        if out.get("pulse_profiler_off_threads", 0) != 0:
+            print(
+                "pulse profiler thread running with profile_hz=0 "
+                "(disabled profiler must add ZERO hot-path work)",
                 file=sys.stderr,
             )
             return 1

@@ -22,6 +22,7 @@ from tools.pandalint.checkers.deadlocks import DeadlockChecker
 from tools.pandalint.checkers.tracectx import TraceCtxChecker
 from tools.pandalint.checkers.meshctx import MeshCtxChecker
 from tools.pandalint.checkers.backpressure import BackpressureChecker
+from tools.pandalint.checkers.perftiming import PerfTimingChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
     ReactorChecker,
@@ -41,6 +42,7 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     TraceCtxChecker,
     MeshCtxChecker,
     BackpressureChecker,
+    PerfTimingChecker,
 )
 
 

@@ -1,0 +1,243 @@
+"""Raw pair-timing discipline: hot-path durations go through the probes.
+
+pandapulse (observability/pulse.py) turns the engine's stage timers into
+per-launch timelines BY CONSTRUCTION: every duration that flows through
+``_stat_add``/``_stat_stage``/``tracer.record``/``probes.record_us`` lands
+in /metrics AND (when tracing) in the flight recorder, so the timeline's
+per-stage sums equal the ``stats()`` splits. A raw
+``time.perf_counter()``/``time.monotonic()`` pair in a hot-path package
+whose delta is logged, stored or dropped WITHOUT reaching one of those
+sinks is a stage the recorder silently misses — the measurement exists,
+but no timeline, no histogram and no SLO objective will ever see it.
+
+Heuristic scope: the hot-path packages (``redpanda_tpu/coproc``,
+``kafka``, ``rpc``, ``raft`` — see config.DEFAULT_SCOPES). Per-function
+analysis, no type inference:
+
+- PRF1501: a pair-timing delta (``clock() - t0`` / ``t1 - t0`` where the
+  operands came from a raw clock) that never reaches a timing sink in the
+  function. Routed shapes are exempt: the delta (or the variable it was
+  assigned to) passed to a call whose dotted name mentions a sink token
+  (``_stat`` / ``record`` / ``observe`` / ``journal`` / ``probe`` /
+  ``pulse`` / ``hist``...), RETURNED/YIELDED (the caller owns routing),
+  or used only in comparisons (deadline/timeout control flow is
+  arithmetic, not measurement).
+- PRF1502: clock MIXING — a delta whose start came from ``monotonic``
+  and whose end from ``perf_counter`` (or vice versa). The two clocks
+  share no epoch; the delta is garbage on every platform, always a bug.
+
+A site that is genuinely not a measurement (or measures something the
+probes deliberately must not see) carries a reasoned
+``# pandalint: disable=PRF1501 -- ...`` pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.checkers.base import (
+    Checker,
+    FileContext,
+    RawFinding,
+    dotted,
+)
+
+# a call whose dotted name ends in one of these reads a raw clock
+_CLOCKS = {
+    "perf_counter": "perf",
+    "perf_counter_ns": "perf",
+    "monotonic": "mono",
+    "monotonic_ns": "mono",
+}
+
+# a call whose dotted name mentions one of these consumes timings into
+# the probes/trace/pulse plane (or an explicitly-timing-shaped sink)
+_SINK_TOKENS = (
+    "_stat", "stat_add", "stat_stage", "record", "observe", "journal",
+    "probe", "pulse", "hist", "metric", "latency", "timing", "span",
+    "note_launch", "elapsed",
+)
+
+
+def _clock_kind(node: ast.expr) -> str | None:
+    """'perf'/'mono' when node is a raw clock call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted(node.func)
+    leaf = name.rsplit(".", 1)[-1] if name else ""
+    return _CLOCKS.get(leaf)
+
+
+def _is_sink_call(call: ast.Call) -> bool:
+    name = dotted(call.func).lower()
+    return bool(name) and any(tok in name for tok in _SINK_TOKENS)
+
+
+class _FunctionScope(ast.NodeVisitor):
+    """One function's (or the module body's) pair-timing analysis. Nested
+    defs/lambdas get their own scope — a closure's delta routes (or
+    doesn't) in the frame that computes it."""
+
+    def __init__(self) -> None:
+        self.clock_vars: dict[str, str] = {}   # var -> 'perf' | 'mono'
+        # delta expr id -> (node, kinds) candidates found in pass 1
+        self.deltas: list[tuple[ast.BinOp, set[str]]] = []
+        # var -> EVERY delta node whose value flowed into it (a var
+        # reassigned from two different timers carries both)
+        self.delta_vars: dict[str, set[int]] = {}
+        self._by_id: dict[int, ast.BinOp] = {}
+        self.routed: set[int] = set()          # id(delta node)
+        self.mixed: list[ast.BinOp] = []
+
+    # -------------------------------------------------------- pass 1
+    def collect(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # a nested def is its own scope (see check())
+            self._collect_stmt(stmt)
+
+    def _iter_own(self, node: ast.AST):
+        """Children of ``node`` excluding nested function/lambda bodies."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield child
+            yield from self._iter_own(child)
+
+    def _collect_stmt(self, stmt: ast.stmt) -> None:
+        for node in [stmt, *self._iter_own(stmt)]:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    kind = _clock_kind(node.value)
+                    if kind is not None:
+                        self.clock_vars[tgt.id] = kind
+                        continue
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                kinds = set()
+                for side in (node.left, node.right):
+                    k = _clock_kind(side)
+                    if k is None and isinstance(side, ast.Name):
+                        k = self.clock_vars.get(side.id)
+                    if k is not None:
+                        kinds.add(k)
+                    else:
+                        kinds.clear()
+                        break
+                if kinds:
+                    self.deltas.append((node, kinds))
+
+    # -------------------------------------------------------- pass 2
+    def analyze(self, body: list[ast.stmt]) -> None:
+        body = [
+            s for s in body
+            if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        delta_ids = {id(n) for n, _ in self.deltas}
+        self._by_id = {id(n): n for n, _ in self.deltas}
+        # delta-ness propagates over assignments to fixpoint:
+        # ``t = min(t, clock() - t0)`` makes ``t`` carry the delta,
+        # ``speedup = a / b`` inherits EVERY delta flowing into either
+        # operand — so routing only has to see the FINAL variable reach a
+        # sink / return / comparison.
+        changed = True
+        while changed:
+            changed = False
+            for stmt in body:
+                for node in [stmt, *self._iter_own(stmt)]:
+                    if not (
+                        isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                    ):
+                        continue
+                    tgt = node.targets[0].id
+                    carried = self.delta_vars.get(tgt, set())
+                    before = len(carried)
+                    for sub in [node.value, *self._iter_own(node.value)]:
+                        if id(sub) in delta_ids:
+                            carried = carried | {id(sub)}
+                        elif (
+                            isinstance(sub, ast.Name)
+                            and sub.id in self.delta_vars
+                        ):
+                            carried = carried | self.delta_vars[sub.id]
+                    if len(carried) > before:
+                        self.delta_vars[tgt] = carried
+                        changed = True
+        for stmt in body:
+            self._route_stmt(stmt, delta_ids)
+
+    def _routed_names_and_nodes(self, kids, delta_ids: set[int]) -> None:
+        for kid in kids:
+            for sub in [kid, *self._iter_own(kid)]:
+                if id(sub) in delta_ids:
+                    self.routed.add(id(sub))
+                elif isinstance(sub, ast.Name) and sub.id in self.delta_vars:
+                    self.routed.update(self.delta_vars[sub.id])
+
+    def _route_stmt(self, stmt: ast.stmt, delta_ids: set[int]) -> None:
+        for node in [stmt, *self._iter_own(stmt)]:
+            routed_kids: list[ast.AST] = []
+            if isinstance(node, ast.Call) and _is_sink_call(node):
+                routed_kids = [*node.args, *(kw.value for kw in node.keywords)]
+            elif isinstance(node, (ast.Return, ast.Yield, ast.Compare)):
+                routed_kids = list(ast.iter_child_nodes(node))
+            elif isinstance(node, (ast.If, ast.While)):
+                routed_kids = [node.test]
+            if routed_kids:
+                self._routed_names_and_nodes(routed_kids, delta_ids)
+
+    # -------------------------------------------------------- verdicts
+    def findings(self) -> Iterator[RawFinding]:
+        for node, kinds in self.deltas:
+            if len(kinds) > 1:
+                yield RawFinding(
+                    "PRF1502",
+                    node.lineno,
+                    node.col_offset,
+                    "pair-timing mixes monotonic and perf_counter: the "
+                    "clocks share no epoch, so this delta is meaningless "
+                    "— take both samples from ONE clock",
+                )
+                continue
+            if id(node) not in self.routed:
+                yield RawFinding(
+                    "PRF1501",
+                    node.lineno,
+                    node.col_offset,
+                    "raw pair-timing whose delta never reaches a probes/"
+                    "trace/pulse sink: a stage measured here is invisible "
+                    "to /metrics, the SLO engine and the flight-recorder "
+                    "timeline — route it through _stat_stage/_stat_add, "
+                    "tracer.record or probes.record_us/observe_us",
+                )
+
+
+class PerfTimingChecker(Checker):
+    name = "perf-timing"
+    rules = {
+        "PRF1501": "raw perf_counter/monotonic pair-timing in a hot-path "
+                   "package not routed through a probes/trace/pulse sink "
+                   "(the flight recorder silently misses the stage)",
+        "PRF1502": "pair-timing delta mixing monotonic and perf_counter "
+                   "samples (no shared epoch: the delta is garbage)",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        yield from self._scope(ctx.tree.body)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._scope(node.body)
+            elif isinstance(node, ast.Lambda):
+                yield from self._scope([ast.Expr(value=node.body)])
+
+    @staticmethod
+    def _scope(body: list[ast.stmt]) -> Iterator[RawFinding]:
+        scope = _FunctionScope()
+        scope.collect(body)
+        scope.analyze(body)
+        yield from scope.findings()

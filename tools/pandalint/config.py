@@ -61,6 +61,14 @@ DEFAULT_SCOPES: dict[str, tuple[str, ...]] = {
     # subtree must fail the gate.
     "races": (),
     "deadlocks": (),
+    # Raw pair-timing routed through probes/trace/pulse is a HOT-PATH
+    # contract (the pandapulse flight recorder's single-source-of-timing
+    # invariant); elsewhere (cli, tools, archival) a throwaway timer is
+    # legitimate and the rule would only breed pragmas.
+    "perf-timing": (
+        "redpanda_tpu/coproc", "redpanda_tpu/kafka", "redpanda_tpu/rpc",
+        "redpanda_tpu/raft",
+    ),
 }
 
 DEFAULT_PACKAGE_ROOT = "redpanda_tpu"
