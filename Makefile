@@ -1,0 +1,19 @@
+# Developer entry points. The lint gate is pandalint (tools/pandalint/);
+# lint-fast scopes the REPORT to the git diff (merge-base with main,
+# plus untracked files) while still analyzing the whole tree, so
+# program-level rules (DLK/RSL/affinity) keep their full call graph and
+# the content-hash cache keeps unchanged files cheap — pre-commit runs
+# cost seconds, not the full package sweep.
+
+PY ?= python
+
+.PHONY: lint lint-fast test
+
+lint:
+	$(PY) -m tools.pandalint redpanda_tpu/ --strict
+
+lint-fast:
+	$(PY) -m tools.pandalint redpanda_tpu/ --strict --changed-only
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

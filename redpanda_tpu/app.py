@@ -157,6 +157,12 @@ class Application:
         from redpanda_tpu.resource_mgmt import admission as rm_admission
         from redpanda_tpu.resource_mgmt import budgets as rm_budgets
 
+        if getattr(c, "coproc_leakwatch", False):
+            # must flip BEFORE the plane is built: accounts bind their
+            # balance recorder (or lack of one) at construction
+            from redpanda_tpu.coproc import leakwatch
+
+            leakwatch.enable()
         self.budget_plane = rm_budgets.BudgetPlane(
             total_bytes=c.resource_memory_total_mb << 20,
             warn_pct=c.resource_pressure_warn_pct,

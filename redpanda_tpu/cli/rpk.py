@@ -451,7 +451,7 @@ async def cmd_debug(args) -> int:
         for k in (
             "columnar_backend", "host_pool_probe", "host_pool_probe_prev",
             "host_pool_recal", "columnar_probe", "parse_path", "parse_probe",
-            "colcache", "arena", "breakers", "lockwatch",
+            "colcache", "arena", "breakers", "lockwatch", "leakwatch",
         ):
             if stats.get(k) is not None:
                 print(f"  {k:<28}{stats[k]}")

@@ -326,6 +326,11 @@ PROPERTIES: list[Property] = [
         "Debug: wrap the engine's named locks in a lock-order recorder that journals acquisition edges into the governor 'lockwatch' domain (validates the pandalint static acquisition graph); off = no wrapper installed, zero overhead",
         False, bool,
     ),
+    Property(
+        "coproc_leakwatch",
+        "Debug: wrap the broker's budget accounts/gates/arenas in an acquire-release balance recorder that journals per-site deltas into the governor 'leakwatch' domain (validates the pandalint RSL16xx lifecycle model); off = no proxy installed, zero overhead",
+        False, bool,
+    ),
     # --- tiered storage (cloud_storage_* group)
     Property("cloud_storage_enabled", "Enable tiered storage", False, bool),
     Property("cloud_storage_bucket", "S3 bucket", ""),

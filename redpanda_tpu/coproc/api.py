@@ -46,6 +46,12 @@ class CoprocApi:
             from redpanda_tpu.coproc import lockwatch
 
             lockwatch.enable()
+        if _knob("coproc_leakwatch", False):
+            # same contract: the engine's admission controller and arena
+            # bind their balance recorder (or lack of one) at construction
+            from redpanda_tpu.coproc import leakwatch
+
+            leakwatch.enable()
         # None -> the engine resolves min(4, cores); the property default
         # matches, so an unset config and a default config agree
         self.engine = TpuEngine(

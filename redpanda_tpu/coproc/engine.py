@@ -65,6 +65,7 @@ from redpanda_tpu.coproc import (
     faults,
     governor,
     host_pool,
+    leakwatch,
     lockwatch,
     meshrunner,
 )
@@ -1064,7 +1065,7 @@ class TpuEngine:
         # operator escape hatch), and framing scratch reuses across
         # launches through the arena (reset_arenas() for tests).
         self._gather_frame = bool(gather_frame)
-        self._arena = batch_codec.Arena()
+        self._arena = leakwatch.wrap(batch_codec.Arena(), "engine.arena")
         # Structural-index parse path (native rp_explode_find2 +
         # rp_extract_cols2): fused-vs-staged is a MEASURED per-engine
         # decision with the host-pool posture — the first representative
@@ -1158,8 +1159,11 @@ class TpuEngine:
         if budget_plane is not None:
             acct = budget_plane.accounts.get("coproc")
             if acct is not None:
-                self._admission = rm_admission.AdmissionController(
-                    acct, "coproc", warn_pct=budget_plane.warn_pct
+                self._admission = leakwatch.wrap(
+                    rm_admission.AdmissionController(
+                        acct, "coproc", warn_pct=budget_plane.warn_pct
+                    ),
+                    "engine.admission",
                 )
             _ref = weakref.ref(self)
 
@@ -1453,6 +1457,10 @@ class TpuEngine:
             # debug mode only: the observed lock-order edge count rides
             # stats() into /v1/coproc/status, rpk debug coproc and BENCH
             out["lockwatch"] = lockwatch.snapshot()
+        if leakwatch.enabled():
+            # same posture: outstanding balances + imbalance count ride
+            # stats() into the status/debug surfaces
+            out["leakwatch"] = leakwatch.snapshot()
         with self._parse_decision_lock:
             out["parse_path"] = self._parse_decision
             if self._parse_probe is not None:
@@ -1548,7 +1556,7 @@ class TpuEngine:
         and bench ablations need deterministic alloc/reuse accounting —
         and an engine parked after a giant launch can use this to return
         the held buffers to the allocator."""
-        self._arena = batch_codec.Arena()
+        self._arena = leakwatch.wrap(batch_codec.Arena(), "engine.arena")
 
     def reset_stats(self) -> None:
         with self._stats_lock:

@@ -76,6 +76,10 @@ DIAGNOSIS = "diagnosis"
 # here (coproc/lockwatch.py) — the dynamic validation trail of the
 # pandaraces static acquisition graph
 LOCKWATCH = "lockwatch"
+# coproc_leakwatch: first-seen acquire sites and any balance imbalance
+# journal here (coproc/leakwatch.py) — the dynamic validation trail of
+# the pandaleak static resource-lifecycle model
+LEAKWATCH = "leakwatch"
 # multi-chip sharded engine (coproc/meshrunner.py): the measured
 # mesh-vs-single-device decision, the raft device-plane CRC/vote probe,
 # and mesh breaker demotions all journal here (PROBE_MARGIN posture —
@@ -90,7 +94,7 @@ ADMISSION = "admission"
 DOMAINS = (
     HOST_POOL, COLUMNAR_BACKEND, DEVICE_LZ4, BREAKER, HARVEST_PATH,
     SHARDED_SEAL, DEADLINE, PARSE_PATH, COLUMN_CACHE, DIAGNOSIS, LOCKWATCH,
-    MESH, ADMISSION,
+    LEAKWATCH, MESH, ADMISSION,
 )
 
 # fault domains that get their own breaker + adaptive deadline. Each

@@ -23,7 +23,7 @@ import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-from redpanda_tpu.coproc import faults
+from redpanda_tpu.coproc import faults, leakwatch
 from redpanda_tpu.coproc.engine import (
     ProcessBatchItem,
     ProcessBatchRequest,
@@ -336,9 +336,12 @@ class Pacemaker:
         # let concurrent buffers reach group_ticks_cap x the configured
         # coproc_max_inflight_bytes. An oversized single read clamps to
         # the whole account and proceeds alone (MemoryAccount semantics).
-        self.read_budget = MemoryAccount(
-            "coproc_read",
-            max(1, int(max_inflight_reads)) * max(1, int(max_batch_size)),
+        self.read_budget = leakwatch.wrap(
+            MemoryAccount(
+                "coproc_read",
+                max(1, int(max_inflight_reads)) * max(1, int(max_batch_size)),
+            ),
+            "pacemaker.read_budget",
         )
         # launch knobs (resource_mgmt / governor ADMISSION domain):
         # group_ticks_per_launch scales how many ticks' worth of input one

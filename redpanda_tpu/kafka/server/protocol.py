@@ -155,10 +155,17 @@ class Connection:
         # drains (or the connection dies).
         reserved = await self.server.memory.acquire(size)
         try:
-            return await self.reader.readexactly(size), reserved
+            frame = await self.reader.readexactly(size)
         except (asyncio.IncompleteReadError, ConnectionError):
             self._release(reserved)
             return None, 0
+        except BaseException:
+            # Cancellation (connection teardown racing a slow body read)
+            # must give the bytes back: this reservation is not yet in
+            # self._reserved, so the close path can't see it.
+            self._release(reserved)
+            raise
+        return frame, reserved
 
     def _release(self, reserved: int) -> None:
         if reserved:
@@ -345,9 +352,17 @@ class KafkaServer:
 
         gh.register_group_handlers(self.handlers)
         th.register_tx_handlers(self.handlers)
+        from redpanda_tpu.coproc import leakwatch
         from redpanda_tpu.resource_mgmt import MemoryBudget
 
-        self.memory = MemoryBudget(broker.config.kafka_request_max_memory)
+        # leakwatch: the request-memory budget is THE account the
+        # _read_frame cancellation path reserves from — with
+        # coproc_leakwatch on, a torn connection leaking its frame
+        # reservation shows up as nonzero outstanding balance
+        self.memory = leakwatch.wrap(
+            MemoryBudget(broker.config.kafka_request_max_memory),
+            "kafka.request_memory",
+        )
         from redpanda_tpu.kafka.server.qdc import QdcMonitor
 
         cfg = broker.config

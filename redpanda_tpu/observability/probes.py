@@ -167,6 +167,10 @@ coproc_lockwatch_edges = registry.counter(
     "coproc_lockwatch_edges_total",
     "Distinct lock-order edges observed by the coproc_lockwatch recorder",
 )
+coproc_leakwatch_imbalance = registry.counter(
+    "coproc_leakwatch_imbalance_total",
+    "Resource balances driven negative under the coproc_leakwatch recorder",
+)
 
 # Breaker-state gauges moved to the governor (coproc/governor.py): they
 # are per-DOMAIN labeled series (coproc_breaker_state{domain=...}) owned by
@@ -400,6 +404,7 @@ __all__ = [
     "coproc_harvest_padded",
     "coproc_host_pool_busy",
     "coproc_launch_rows_hist",
+    "coproc_leakwatch_imbalance",
     "coproc_lockwatch_edges",
     "coproc_retries_total",
     "coproc_shard_rows_hist",

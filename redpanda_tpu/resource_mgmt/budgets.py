@@ -236,9 +236,18 @@ class BudgetPlane:
         split = dict(split or DEFAULT_SPLIT)
         self.warn_pct = float(warn_pct)
         self.critical_pct = float(critical_pct)
+        # leakwatch (coproc/leakwatch.py): with coproc_leakwatch on, each
+        # account is handed out through a balance-recording proxy; when
+        # off, wrap() returns the raw account — zero steady-state cost.
+        # Deferred import: resource_mgmt must not pull coproc eagerly.
+        from redpanda_tpu.coproc import leakwatch
+
         self.accounts: dict[str, MemoryAccount] = {
-            name: MemoryAccount(
-                name, max(1, int(self.total_bytes * frac)), plane=self
+            name: leakwatch.wrap(
+                MemoryAccount(
+                    name, max(1, int(self.total_bytes * frac)), plane=self
+                ),
+                f"account.{name}",
             )
             for name, frac in split.items()
         }

@@ -41,6 +41,12 @@ class SimpleProtocol:
         # their body bytes, shedding WHOLE requests at dispatch with
         # STATUS_BACKPRESSURE before the handler runs — a shed request did
         # nothing, so peers resend safely (transport.RpcBackpressure)
+        if inflight_gate is not None:
+            # leakwatch balance recorder with coproc_leakwatch on; the raw
+            # gate untouched (zero overhead) otherwise
+            from redpanda_tpu.coproc import leakwatch
+
+            inflight_gate = leakwatch.wrap(inflight_gate, "rpc.inflight_gate")
         self.inflight_gate = inflight_gate
 
     def register_service(self, handler: ServiceHandler) -> None:

@@ -1,0 +1,79 @@
+"""Fixture for the cancellation-safety rule (RSL1602) — the three PR-13
+leak shapes, minimized, plus every async escape hatch.
+
+RSL1602 fires where a held resource crosses an ``await`` with no
+finally/except-BaseException discipline, or rides into a spawned task
+with no done-callback: a task cancelled before its first step never
+enters the coroutine body, so an in-coroutine ``finally`` cannot run.
+Line numbers are asserted exactly in test_pandalint.py.
+"""
+
+import asyncio
+
+
+class Leaky:
+    async def held_across_await(self, account, n):
+        reserved = await account.acquire(n)            # RSL1602 line 16
+        await self.flush()                             # cancel here leaks
+        account.release(reserved)
+
+    async def cancelled_before_first_step(self, gate, body):
+        # PR-13 shape 1: the handler task owns the slot, but a task
+        # cancelled before its first step never enters run_handler's
+        # body — its in-coroutine finally can never release.
+        reserved = gate.try_enter(len(body))           # RSL1602 line 24
+        if reserved is None:
+            return None
+        t = asyncio.create_task(self.run_handler(body, reserved))
+        return t
+
+    async def abandoned_tick(self, account, n):
+        # PR-13 shape 2: the orphan reservation — enqueue parks, the
+        # caller times out and abandons the tick, the release after the
+        # await is never reached.
+        reserved = await account.acquire(n)            # RSL1602 line 34
+        result = await self.enqueue(n)                 # abandonment point
+        account.release(reserved)
+        return result
+
+
+class Clean:
+    async def finally_discipline(self, account, n):
+        reserved = await account.acquire(n)
+        try:
+            await self.flush()                         # cancel -> finally
+        finally:
+            account.release(reserved)
+
+    async def base_exception_discipline(self, ctrl, n):
+        reserved, retry_ms = ctrl.try_admit(n)
+        if n > 0 and reserved == 0:
+            raise RuntimeError(retry_ms)               # refusal, not held
+        try:
+            await self.replicate(n)
+        except BaseException:
+            ctrl.release(reserved)                     # incl. CancelledError
+            raise
+        ctrl.release(reserved)
+
+    async def done_callback_discipline(self, gate, body):
+        # the PR-13 FIX shape: release rides the task object, not the
+        # coroutine body, so cancelled-before-first-step still releases
+        reserved = gate.try_enter(len(body))
+        if reserved is None:
+            return None
+        t = asyncio.create_task(self.run_handler(body, reserved))
+        t.add_done_callback(lambda _t, g=gate, r=reserved: g.leave(r))
+        return t
+
+    async def handed_into_await(self, account, store, n):
+        reserved = await account.acquire(n)
+        await store.append_reserved(reserved)          # callee owns it now
+
+    async def refusal_guarded_await(self, account, n):
+        reserved = account.try_acquire(n)
+        if not reserved:
+            await self.backoff()                       # nothing held here
+            return None
+        account.release(reserved)
+        return n

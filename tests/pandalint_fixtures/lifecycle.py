@@ -1,0 +1,112 @@
+"""Fixture for the resource-lifecycle checker (RSL1601/RSL1603).
+
+Sync acquire/release pairing: leaks on early return, raise, and
+fall-through; every escape hatch (finally, refusal guard, with-adapter,
+handle returned/stored/handed off, rebind) stays clean; the nested-def
+blind spot is pinned as DOCUMENTED behavior. Line numbers are asserted
+exactly in test_pandalint.py.
+"""
+
+
+class Leaky:
+    def early_return(self, account, n):
+        reserved = account.try_acquire(n)              # RSL1601 line 13
+        if n > 9000:
+            return None                                # exit skips release
+        account.release(reserved)
+        return n
+
+    def raise_path(self, account, n):
+        reserved = account.try_acquire(n)              # RSL1601 line 20
+        if n < 0:
+            raise ValueError(n)                        # exit skips release
+        account.release(reserved)
+
+    def fall_through(self, account, n):
+        reserved = account.try_acquire(n)              # RSL1601 line 26
+        self.count = n                                 # never released
+
+    def double_mechanism(self, account, fut, n):
+        reserved = account.try_acquire(n)
+        fut.add_done_callback(lambda _f: account.release(reserved))
+        account.release(reserved)                      # RSL1601 line 32
+
+
+class Clean:
+    def finally_release(self, account, n):
+        reserved = account.try_acquire(n)
+        try:
+            if n > 9000:
+                return None                            # finally still runs
+            return n
+        finally:
+            account.release(reserved)
+
+    def refusal_guard(self, account, n):
+        reserved = account.try_acquire(n)
+        if not reserved:
+            return None                                # nothing was held
+        account.release(reserved)
+        return n
+
+    def with_adapter(self, adapter):
+        with adapter.acquire(64) as buf:               # adapter releases
+            return len(buf)
+
+    def returns_handle(self, account, n):
+        reserved = account.try_acquire(n)
+        return reserved                                # caller owns it now
+
+    def stores_handle(self, account, n):
+        reserved = account.try_acquire(n)
+        self._reserved = reserved                      # teardown releases
+
+    def hands_off(self, account, ledger, n):
+        reserved = account.try_acquire(n)
+        ledger.track(reserved)                         # ownership transfer
+
+    def rebind_ends_tracking(self, pool):
+        worker = pool.free_workers.pop() if pool.free_workers else None
+        if worker is None:
+            worker = object()                          # fresh: no claim held
+        return worker
+
+    def nested_def_blind_spot(self, account, n):
+        reserved = account.try_acquire(n)
+
+        def finish():                                  # closure owns it —
+            account.release(reserved)                  # DOCUMENTED blind spot
+
+        return finish
+
+    def reasoned_pragma(self, account, n):
+        reserved = account.try_acquire(n)  # pandalint: disable=RSL1601 -- exercises the reasoned-pragma escape hatch
+
+
+class Orphaned:
+    def __init__(self, workers):
+        self.engine = TpuEngine(workers)               # RSL1603 line 88
+
+    def run(self, batch):
+        return self.engine.process(batch)              # no teardown at all
+
+
+class Owned:
+    def __init__(self, workers):
+        self.engine = TpuEngine(workers)               # clean: stop() reaches
+        self.pool = HostStagePool(workers)             # clean: via _halt()
+
+    def stop(self):
+        self.engine.shutdown()
+        self._halt()
+
+    def _halt(self):
+        self.pool.shutdown()                           # teardown via helper
+
+
+def TpuEngine(workers):                                # stand-in ctor: the
+    return object()                                    # vocabulary is by NAME
+
+
+def HostStagePool(workers):
+    return object()

@@ -125,6 +125,7 @@ def test_unknown_script_gets_empty_reply():
         ProcessBatchRequest([ProcessBatchItem(42, NTP.kafka("t", 0), [_json_batch(2)])])
     )
     assert reply.items[0].batches == [] and reply.items[0].script_id == 42
+    engine.shutdown()
 
 
 def test_error_policy_deregister():
@@ -308,6 +309,8 @@ def test_columnar_host_ablation_matches_device_mode():
                 va = [bytes(v) for bt in ia.batches for v in bt.record_values()]
                 vb = [bytes(v) for bt in ib.batches for v in bt.record_values()]
                 assert va == vb, (spec.to_json(), va, vb)
+        dev.shutdown()
+        host.shutdown()
 
 
 def test_pack_staged_ptr_lane_bit_parity():

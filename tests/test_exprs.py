@@ -216,16 +216,19 @@ class TestEngineColumnar:
         ]
         batch = RecordBatch.build(recs, base_offset=0, first_timestamp=5)
         eng = TpuEngine(row_stride=256, **engine_kw)
-        codes = eng.enable_coprocessors([(1, spec.to_json(), ("t",))])
-        assert codes[0] == 0
-        req = ProcessBatchRequest([ProcessBatchItem(1, NTP.kafka("t", 0), [batch])])
-        reply = eng.process_batch(req)
-        assert len(reply.items) == 1
-        out = []
-        for b in reply.items[0].batches:
-            assert b.verify_kafka_crc()
-            out.extend(r.value for r in b.records())
-        return out
+        try:
+            codes = eng.enable_coprocessors([(1, spec.to_json(), ("t",))])
+            assert codes[0] == 0
+            req = ProcessBatchRequest([ProcessBatchItem(1, NTP.kafka("t", 0), [batch])])
+            reply = eng.process_batch(req)
+            assert len(reply.items) == 1
+            out = []
+            for b in reply.items[0].batches:
+                assert b.verify_kafka_crc()
+                out.extend(r.value for r in b.records())
+            return out
+        finally:
+            eng.shutdown()
 
     def test_filter_project(self):
         spec = where(
@@ -323,6 +326,7 @@ class TestEngineColumnar:
         reply = eng.process_batch(req)
         out = [r.value for b in reply.items[0].batches for r in b.records()]
         assert out == [json.dumps({"c": i * 2}).encode() for i in range(6) if i % 2 == 0]
+        eng.shutdown()
 
     def test_mesh_columnar(self, eight_devices):
         from redpanda_tpu.parallel.mesh import partition_mesh
@@ -353,6 +357,7 @@ class TestEngineColumnar:
         codes = eng.enable_coprocessors([(1, spec.to_json(), ("t",))])
         assert codes[0] == 0  # v2 specs have no payload compilation
         assert eng._plans[1].mode == "columnar"
+        eng.shutdown()
 
     def test_bad_constant_fails_enable(self):
         bad = json.dumps(
@@ -362,6 +367,7 @@ class TestEngineColumnar:
         eng = TpuEngine()
         codes = eng.enable_coprocessors([(1, bad, ("t",))])
         assert codes[0] == 1  # internal_error at enable, not at first batch
+        eng.shutdown()
 
     def test_int_min_projection_dropped(self):
         docs = [{"code": -(2**31)}, {"code": -999_999_999}]
@@ -405,6 +411,7 @@ class TestEngineColumnar:
         assert "t_explode_find" in st or ("t_explode" in st and "t_find" in st)
         assert st["bytes_d2h"] < st["bytes_h2d"]
         assert st["n_records"] == len(DOCS)
+        eng.shutdown()
 
 
 class TestFindMultiParity:

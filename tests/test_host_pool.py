@@ -316,6 +316,7 @@ def test_calibration_failure_falls_back_inline(monkeypatch):
     reply = engine.process_batch(req)
     assert reply.items[0].batches
     assert engine._pool_decision == "inline"
+    engine.shutdown()
 
 
 def test_measure_pool_ratio_runs_real_stages(monkeypatch):
@@ -333,6 +334,7 @@ def test_measure_pool_ratio_runs_real_stages(monkeypatch):
         plan, batches, [b.header.record_count for b in batches]
     )
     assert t_inline > 0 and t_sharded > 0
+    engine.shutdown()
 
 
 def test_measure_parallel_capacity_shape():
@@ -355,5 +357,6 @@ def test_reset_columnar_probe():
         assert TpuEngine._columnar_backend is None
         assert TpuEngine._columnar_probe is None
         assert "columnar_backend" not in engine.stats()
+        engine.shutdown()
     finally:
         TpuEngine._columnar_backend, TpuEngine._columnar_probe = saved

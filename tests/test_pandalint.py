@@ -530,6 +530,110 @@ def test_race_affinity_sees_through_helper_chains(tmp_path):
     assert ("RAC1101", 19) in got  # the write inside _bump
 
 
+# --------------------------------------------------------------- lifecycle
+def test_lifecycle_rules_exact_lines():
+    """RSL1601 at the early-return, raise-path, fall-through and
+    double-mechanism leaks; RSL1603 at the owner that never tears its
+    engine down. Every escape hatch (finally, refusal guard,
+    with-adapter, handle returned/stored/handed off, rebind, nested-def
+    blind spot, teardown-via-helper) stays clean."""
+    got = _active(_lint(os.path.join(FIXTURES, "lifecycle.py")))
+    assert got == [
+        ("RSL1601", 13),  # early return skips release
+        ("RSL1601", 20),  # raise path skips release
+        ("RSL1601", 26),  # fall-through, never released
+        ("RSL1601", 32),  # direct release RACES the done-callback
+        ("RSL1603", 88),  # Orphaned: no stop/shutdown/close at all
+    ]
+
+
+def test_cancellation_rules_exact_lines():
+    """RSL1602 at the held-across-await leak and both PR-13 task shapes;
+    finally/except-BaseException/done-callback/handoff/refusal-guard
+    disciplines stay clean."""
+    got = _active(_lint(os.path.join(FIXTURES, "cancellation.py")))
+    assert got == [
+        ("RSL1602", 16),  # held across await, no finally
+        ("RSL1602", 24),  # slot rides a spawned task, no done-callback
+        ("RSL1602", 34),  # abandoned-tick orphan reservation
+    ]
+
+
+def test_lifecycle_scope_is_package_wide(tmp_path):
+    """Acquire/release pairs exist anywhere in the broker (rpc, raft,
+    storage, kafka); a leak injected in ANY subtree must fail the gate."""
+    for sub in ("raft", "storage", "archival"):
+        pkg = tmp_path / "redpanda_tpu" / sub
+        pkg.mkdir(parents=True)
+        dst = pkg / "leaky.py"
+        shutil.copyfile(os.path.join(FIXTURES, "lifecycle.py"), dst)
+        report = LintEngine(Config()).lint_file(
+            str(dst), f"redpanda_tpu/{sub}/leaky.py"
+        )
+        assert any(f.rule.startswith("RSL") for f in report.findings), sub
+
+
+def test_lifecycle_reasoned_pragma_suppresses():
+    findings = _lint(os.path.join(FIXTURES, "lifecycle.py"))
+    suppressed = [
+        (f.rule, f.suppress_reason) for f in findings if f.suppressed
+    ]
+    assert (
+        "RSL1601",
+        "exercises the reasoned-pragma escape hatch",
+    ) in suppressed
+
+
+def test_pr13_leak_shapes_reproduce_as_findings():
+    """Regression pin: the three hand-found PR-13 leak shapes each
+    reproduce as an exact-line RSL finding on their minimized
+    reproduction — the checker provably would have caught them."""
+    cancel = _lint(os.path.join(FIXTURES, "cancellation.py"))
+    by_line = {f.line: f for f in cancel if not f.suppressed}
+    # shape 1: handler task cancelled before its first step never enters
+    # the coroutine body, so the in-coroutine finally can't release
+    assert by_line[24].rule == "RSL1602"
+    assert "never enters the coroutine body" in by_line[24].message
+    # shape 2: the abandoned tick's orphan reservation parks forever
+    assert by_line[34].rule == "RSL1602"
+    assert "cancellation there leaks it forever" in by_line[34].message
+    # shape 3: double-release race between the finally and the callback
+    life = _lint(os.path.join(FIXTURES, "lifecycle.py"))
+    double = {f.line: f for f in life if not f.suppressed}[32]
+    assert double.rule == "RSL1601"
+    assert "done-callback" in double.message
+    assert "zero-swap" in double.message
+
+
+def test_lifecycle_arena_replacement_contract(tmp_path):
+    """The grown-by-replacement scratch contract: the out= call's bound
+    result is an ALIAS the caller must release; releasing dst and the
+    not-replaced scratch is the clean in-tree shape, while dropping dst
+    on the floor leaks."""
+    clean = (
+        "def frame(arena, lib, joined, n):\n"
+        "    scratch = arena.acquire(n)\n"
+        "    dst, total = lib.pack(joined, out=scratch)\n"
+        "    use(dst[:total])\n"
+        "    arena.release(dst)\n"
+        "    if dst is not scratch:\n"
+        "        arena.release(scratch)\n"
+        "    return total\n"
+    )
+    p = tmp_path / "framing.py"
+    p.write_text(clean)
+    assert _active(_lint(str(p))) == []
+    leaky = (
+        "def frame(arena, lib, joined, n):\n"
+        "    scratch = arena.acquire(n)\n"
+        "    dst, total = lib.pack(joined, out=scratch)\n"
+        "    return total\n"
+    )
+    p2 = tmp_path / "framing_bad.py"
+    p2.write_text(leaky)
+    assert _active(_lint(str(p2))) == [("RSL1601", 2)]
+
+
 def test_stale_suppression_reported():
     findings = _lint(os.path.join(FIXTURES, "stale_pragma.py"))
     got = _active(findings)
@@ -751,6 +855,98 @@ def test_cli_list_suppressions(capsys):
     assert "live suppression: the sleep is the fixture's point" in out
     # the inventory counts every pragma, stale ones flagged
     assert "1 stale" in out
+
+
+def _git(cwd, *cmd):
+    import subprocess
+
+    subprocess.run(
+        ("git",) + cmd, cwd=cwd, check=True, capture_output=True, text=True
+    )
+
+
+LEAK_SHAPE = (
+    "def f(account, n):\n"
+    "    reserved = account.try_acquire(n)\n"  # fall-through: RSL1601
+)
+
+
+def test_cli_changed_only_scopes_report_to_diff(tmp_path, capsys, monkeypatch):
+    """--changed-only still analyzes every given path (program rules
+    need the graph) but the gate only counts findings in files changed
+    since the merge-base with main — plus untracked files."""
+    _git(tmp_path, "init", "-q", "-b", "main")
+    _git(tmp_path, "config", "user.email", "t@example.com")
+    _git(tmp_path, "config", "user.name", "t")
+    (tmp_path / "old.py").write_text(LEAK_SHAPE, encoding="utf-8")
+    _git(tmp_path, "add", "old.py")
+    _git(tmp_path, "commit", "-qm", "seed")
+    _git(tmp_path, "checkout", "-qb", "feature")
+    (tmp_path / "new.py").write_text(
+        LEAK_SHAPE.replace("def f", "def g"), encoding="utf-8"
+    )
+    _git(tmp_path, "add", "new.py")
+    _git(tmp_path, "commit", "-qm", "add new")
+    monkeypatch.chdir(tmp_path)
+
+    # both files carry the same RSL1601; only the changed one reports
+    rc = pandalint_main(
+        ["old.py", "new.py", "--strict", "--changed-only", "--no-cache"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "new.py:2" in out and "old.py:2" not in out
+    assert "changed-only" in out
+
+    # nothing in the diff touches old.py -> the strict gate passes even
+    # though old.py still has a finding
+    rc = pandalint_main(["old.py", "--strict", "--changed-only", "--no-cache"])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_changed_only_sees_untracked_and_explicit_ref(
+    tmp_path, capsys, monkeypatch
+):
+    _git(tmp_path, "init", "-q", "-b", "main")
+    _git(tmp_path, "config", "user.email", "t@example.com")
+    _git(tmp_path, "config", "user.name", "t")
+    (tmp_path / "old.py").write_text(LEAK_SHAPE, encoding="utf-8")
+    _git(tmp_path, "add", "old.py")
+    _git(tmp_path, "commit", "-qm", "seed")
+    # untracked file: always in the changed set
+    (tmp_path / "scratch.py").write_text(
+        LEAK_SHAPE.replace("def f", "def h"), encoding="utf-8"
+    )
+    monkeypatch.chdir(tmp_path)
+    rc = pandalint_main(
+        ["old.py", "scratch.py", "--strict", "--changed-only", "--no-cache"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "scratch.py:2" in out and "old.py:2" not in out
+
+    # explicit REF: diff against a named ref instead of the merge-base
+    rc = pandalint_main(
+        [
+            "old.py",
+            "scratch.py",
+            "--strict",
+            "--changed-only",
+            "HEAD",
+            "--no-cache",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "scratch.py:2" in out and "old.py:2" not in out
+
+    # a ref git cannot resolve is a usage error, not a silent all-pass
+    rc = pandalint_main(
+        ["old.py", "--strict", "--changed-only", "no-such-ref", "--no-cache"]
+    )
+    capsys.readouterr()
+    assert rc == 2
 
 
 # --------------------------------------------------------------- speed

@@ -428,7 +428,7 @@ def test_kafka_produce_shed_before_ack(tmp_path):
         client = await KafkaClient([("127.0.0.1", server.port)]).connect()
         try:
             acct = plane.account("kafka_produce")
-            filler = acct.try_acquire(acct.limit)
+            filler = acct.try_acquire(acct.limit)  # pandalint: disable=RSL1602 -- deliberate budget-fill to force the shed; released right after the raises block
             with pytest.raises(KafkaError) as ei:
                 await client.produce("t", 0, [(b"k", b"shed-me")], acks=-1)
             assert ei.value.code == ErrorCode.throttling_quota_exceeded

@@ -214,3 +214,5 @@ def test_engine_enable_sandboxed_and_policies():
     reply2 = engine2.process_batch(req2)
     assert reply2.deregistered == [3]
     assert engine2.heartbeat() == 0
+    engine.shutdown()
+    engine2.shutdown()

@@ -66,6 +66,18 @@ def _build_parser() -> argparse.ArgumentParser:
         help="print every suppression pragma (with staleness) and exit",
     )
     p.add_argument(
+        "--changed-only",
+        nargs="?",
+        const="__merge-base__",
+        default=None,
+        metavar="REF",
+        help="report only findings in files changed since REF (default: "
+        "the merge-base with main) plus untracked files; the whole tree "
+        "is still analyzed — program-level rules need the full call "
+        "graph and the content-hash cache keeps unchanged files cheap — "
+        "but the gate and the output are scoped to the diff",
+    )
+    p.add_argument(
         "--jobs",
         type=int,
         default=default_jobs(),
@@ -86,6 +98,45 @@ def _build_parser() -> argparse.ArgumentParser:
         help="disable the findings cache for this run",
     )
     return p
+
+
+def _changed_files(ref: str) -> set[str] | None:
+    """Repo-root-relative posix paths changed since `ref` (diff + staged
+    + untracked). None when git is unusable — the caller degrades to a
+    usage error rather than silently linting nothing."""
+    import subprocess
+
+    def git(*cmd: str):
+        try:
+            return subprocess.run(
+                ("git",) + cmd, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired):  # pragma: no cover
+            return None
+
+    if ref == "__merge-base__":
+        base = None
+        for upstream in ("main", "origin/main", "master"):
+            mb = git("merge-base", "HEAD", upstream)
+            if mb is not None and mb.returncode == 0:
+                base = mb.stdout.strip()
+                break
+        if base is None:
+            # detached/shallow checkout: diff against HEAD (uncommitted
+            # work) is still the useful pre-commit scope
+            base = "HEAD"
+    else:
+        base = ref
+    diff = git("diff", "--name-only", base)
+    if diff is None or diff.returncode != 0:
+        return None
+    changed = {ln.strip() for ln in diff.stdout.splitlines() if ln.strip()}
+    untracked = git("ls-files", "--others", "--exclude-standard")
+    if untracked is not None and untracked.returncode == 0:
+        changed |= {
+            ln.strip() for ln in untracked.stdout.splitlines() if ln.strip()
+        }
+    return changed
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -119,6 +170,17 @@ def main(argv: list[str] | None = None) -> int:
     if args.jobs < 1:
         print("pandalint: --jobs must be >= 1", file=sys.stderr)
         return 2
+
+    changed: set[str] | None = None
+    if args.changed_only is not None:
+        changed = _changed_files(args.changed_only)
+        if changed is None:
+            print(
+                "pandalint: --changed-only needs a git checkout "
+                f"(cannot diff against {args.changed_only!r})",
+                file=sys.stderr,
+            )
+            return 2
 
     cache_path = None if args.no_cache else (
         args.cache_file or default_cache_path()
@@ -181,6 +243,17 @@ def main(argv: list[str] | None = None) -> int:
             print(f"pandalint: cannot load baseline: {e}", file=sys.stderr)
             return 2
 
+    if changed is not None:
+        # Scope the REPORT to the diff; the analysis above already ran
+        # over everything so program-level rules saw the full call graph.
+        import posixpath
+
+        all_findings = [
+            f
+            for f in all_findings
+            if posixpath.normpath(f.path) in changed
+        ]
+
     active = [
         f
         for f in all_findings
@@ -218,9 +291,14 @@ def main(argv: list[str] | None = None) -> int:
         n_base = sum(
             1 for f in all_findings if not f.suppressed and f.fingerprint() in baselined
         )
+        scope = (
+            f" (changed-only: {len(changed)} changed path(s))"
+            if changed is not None
+            else ""
+        )
         print(
             f"pandalint: {len(reports)} file(s), {len(active)} active, "
-            f"{len(suppressed)} suppressed, {n_base} baselined"
+            f"{len(suppressed)} suppressed, {n_base} baselined{scope}"
         )
 
     if parse_errors:

@@ -61,6 +61,10 @@ DEFAULT_SCOPES: dict[str, tuple[str, ...]] = {
     # subtree must fail the gate.
     "races": (),
     "deadlocks": (),
+    # Resource lifecycle (RSL16xx) pairs acquires with releases over the
+    # same whole-program graph; leaks can hide in any subtree that touches
+    # a budget account, gate, arena, pool, or engine — package-wide.
+    "lifecycle": (),
     # Raw pair-timing routed through probes/trace/pulse is a HOT-PATH
     # contract (the pandapulse flight recorder's single-source-of-timing
     # invariant); elsewhere (cli, tools, archival) a throwaway timer is

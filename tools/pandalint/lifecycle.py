@@ -1,0 +1,987 @@
+"""Whole-program resource-lifecycle + cancellation-safety analysis (RSL16xx).
+
+The costliest bug class in this repo's history is lifecycle leaks on
+exception/cancellation paths: PR 13's review rounds were almost entirely
+hand-found admission-reservation leaks (a cancelled submit leaking its
+admitted bytes forever, rpc InflightGate slots eaten by handler tasks
+cancelled before their first step, a double-free on the abandonment
+race). This checker makes the class mechanical, over the same affinity
+call graph the race/deadlock rules use.
+
+It models the repo's REAL resource vocabulary:
+
+- ``MemoryAccount.try_acquire / acquire`` ↔ ``release`` (budget bytes)
+- ``AdmissionController.try_admit / admit`` ↔ ``release``
+- ``InflightGate.try_enter`` ↔ ``leave``
+- ``Arena.acquire`` ↔ ``release`` — including the grown-by-replacement
+  scratch contract from the PR-5 native framing: a buffer passed as the
+  ``out=`` keyword may be REPLACED by the callee, in which case the
+  call's bound result becomes an alias the caller must release too
+- fetch-pool claim (``_free_workers.pop()``) ↔ rejoin (``append``)
+- ``TpuEngine`` / ``HostStagePool`` construction ↔ ``shutdown``
+
+and checks three rule families:
+
+**RSL1601** — an acquired handle with a path to function exit (explicit
+``return``/``raise``, or fall-through) that skips the paired release and
+is not protected by ``try/finally`` or a with-adapter. The 1601 family
+also flags the PR-13 double-free shape: one handle released through TWO
+mechanisms (a direct/finally release AND a done-callback binding) — the
+two race, and the fix is an atomic zero-swap.
+
+**RSL1602** — cancellation leak in async code: a held handle crossing an
+``await`` with no ``finally`` (or ``except BaseException``-and-reraise)
+release discipline, or a held handle handed into a
+``create_task``/``ensure_future`` coroutine with no
+``add_done_callback`` that releases it — a task cancelled before its
+first step never enters the coroutine body, so an in-coroutine
+``finally`` cannot run (the exact PR-13 rpc-slot shape).
+
+**RSL1603** — an owner object storing a ``TpuEngine``/``HostStagePool``
+on ``self`` whose teardown methods (stop/shutdown/close) never reach the
+resource's ``shutdown()`` along any resolved call path.
+
+Recognized escape hatches (a handle stops being this function's
+responsibility): returned or yielded, stored to an attribute/subscript,
+appended into a collection, passed as a call argument (ownership
+transfer), bound into a lambda default or closure (done-callback
+discipline), or the refusal-guard branch (``if reserved == 0: return`` —
+nothing was held). The analysis is lexical and path-insensitive on the
+safe side: a release anywhere later in the function ends the hold, so
+false positives stay near zero at the cost of missed loop-carried
+shapes. Documented blind spots: handles referenced from nested defs /
+lambdas are assumed managed by the closure, and a rebound handle name
+ends tracking.
+
+The module also exports the static acquire-site model
+(:func:`model_sites`) that the runtime balance recorder
+(``redpanda_tpu/coproc/leakwatch.py``) is validated against: the chaos
+parity suite asserts every runtime-observed acquire site is a line of a
+statement this model knows about.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from tools.pandalint.affinity import Program, ProgFunc, dotted
+from tools.pandalint.checkers.base import Checker, RawFinding
+from tools.pandalint.lockgraph import LockGraph
+
+# ------------------------------------------------------------ vocabulary
+# receivers that are synchronization primitives, not payload resources —
+# lock.acquire() is the lockgraph's domain, and the qdc is a latency
+# controller whose acquire/release pair is unit-less
+_LOCKISH = re.compile(r"lock|mutex|sem|cond|qdc", re.I)
+_ARENA_RECV = re.compile(r"arena", re.I)
+_POOL_RECV = re.compile(r"free_worker")
+
+# helper releases resolve by NAME (`self._release(reserved)`): requiring
+# body resolution would miss one-line forwarding helpers
+_RELEASE_HELPER = re.compile(
+    r"release|leave|rejoin|shutdown|close|teardown|cleanup|free"
+)
+_TEARDOWN_METHOD = re.compile(
+    r"(^|_)(stop|shutdown|close|aclose|teardown)|__(a)?exit__"
+)
+_SPAWNS = {"create_task", "ensure_future"}
+
+
+@dataclass(frozen=True)
+class Kind:
+    key: str
+    releases: frozenset
+    noun: str
+
+
+KIND_ACCOUNT = Kind("account", frozenset({"release"}), "budget reservation")
+KIND_ADMISSION = Kind(
+    "admission", frozenset({"release"}), "admission reservation"
+)
+KIND_GATE = Kind("gate", frozenset({"leave"}), "inflight slot")
+KIND_ARENA = Kind("arena", frozenset({"release"}), "arena buffer")
+KIND_POOL = Kind("pool", frozenset({"append"}), "fetch-pool worker")
+KIND_ENGINE = Kind(
+    "engine", frozenset({"shutdown", "stop", "close"}), "engine/pool"
+)
+
+# owner-class constructors whose instances demand a teardown call
+OWNER_CTORS = {"TpuEngine": KIND_ENGINE, "HostStagePool": KIND_ENGINE}
+_OWNER_TEARDOWNS = ("shutdown", "stop", "close", "aclose")
+
+
+def acquire_kind(call: ast.Call) -> Kind | None:
+    """Classify one call node as a resource acquisition, or None."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return KIND_ENGINE if f.id in OWNER_CTORS else None
+    if not isinstance(f, ast.Attribute):
+        return None
+    attr = f.attr
+    if attr in OWNER_CTORS:  # module-aliased ctor: host_pool.HostStagePool
+        return KIND_ENGINE
+    if attr == "try_enter":
+        return KIND_GATE
+    if attr in ("try_admit", "admit"):
+        return KIND_ADMISSION
+    recv = dotted(f.value)
+    tail = recv.rsplit(".", 1)[-1] if recv else ""
+    if attr == "pop":
+        return KIND_POOL if _POOL_RECV.search(tail) else None
+    if attr in ("acquire", "try_acquire"):
+        if recv and _LOCKISH.search(recv):
+            return None
+        if _ARENA_RECV.search(tail):
+            return KIND_ARENA
+        return KIND_ACCOUNT
+    return None
+
+
+# ------------------------------------------------------------ events
+@dataclass
+class _Ev:
+    """One lexical occurrence the per-site state machine interprets."""
+
+    kind: str  # call|await|spawn|done_cb|lambda|closure|return|raise|
+    #            rebind|store|alias|yield
+    line: int
+    col: int
+    names: frozenset = frozenset()
+    attr: str = ""
+    recv: str = ""
+    outnames: frozenset = frozenset()
+    targets: frozenset = frozenset()
+    guards: tuple = ()  # ((test_node, polarity), ...) innermost last
+    tries: tuple = ()  # enclosing ast.Try nodes, innermost last
+
+
+def _names_in(node) -> frozenset:
+    if node is None:
+        return frozenset()
+    return frozenset(
+        n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+    )
+
+
+class _EventWalker:
+    """Flattens one function body into lexical events. Nested defs and
+    lambdas are NOT descended into (their Name references become one
+    closure/lambda escape event — the documented blind spot)."""
+
+    def __init__(self, fn_node) -> None:
+        self.out: list[_Ev] = []
+        for st in fn_node.body:
+            self._stmt(st, (), ())
+        self.out.sort(key=lambda e: (e.line, e.col))
+
+    def _ev(self, kind, node, guards, tries, *, at_end=False, **kw) -> None:
+        # at_end: sort the event AFTER the node's sub-expressions — a
+        # `return await io(), handle` must see the await happen BEFORE
+        # ownership transfers to the caller
+        line = (getattr(node, "end_lineno", None) or node.lineno) if at_end else node.lineno
+        col = (
+            (getattr(node, "end_col_offset", None) or node.col_offset)
+            if at_end
+            else node.col_offset
+        )
+        self.out.append(
+            _Ev(kind, line, col, guards=guards, tries=tries, **kw)
+        )
+
+    # ------------------------------------------------------------ statements
+    def _block(self, stmts, guards, tries) -> None:
+        for st in stmts:
+            self._stmt(st, guards, tries)
+
+    def _stmt(self, st, guards, tries) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._ev(
+                "closure", st, guards, tries, names=_names_in(st)
+            )
+            return
+        if isinstance(st, ast.ClassDef):
+            return
+        if isinstance(st, ast.If):
+            self._expr(st.test, guards, tries)
+            self._block(st.body, guards + ((st.test, True),), tries)
+            self._block(st.orelse, guards + ((st.test, False),), tries)
+            return
+        if isinstance(st, ast.Try):
+            inner = tries + (st,)
+            self._block(st.body, guards, inner)
+            for h in st.handlers:
+                self._block(h.body, guards, inner)
+            self._block(st.orelse, guards, inner)
+            self._block(st.finalbody, guards, tries)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._expr(st.iter, guards, tries)
+            self._block(st.body, guards, tries)
+            self._block(st.orelse, guards, tries)
+            return
+        if isinstance(st, ast.While):
+            self._expr(st.test, guards, tries)
+            self._block(st.body, guards, tries)
+            self._block(st.orelse, guards, tries)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._expr(item.context_expr, guards, tries)
+            self._block(st.body, guards, tries)
+            return
+        if isinstance(st, ast.Return):
+            self._expr(st.value, guards, tries)
+            self._ev(
+                "return",
+                st,
+                guards,
+                tries,
+                at_end=True,
+                names=_names_in(st.value),
+            )
+            return
+        if isinstance(st, ast.Raise):
+            self._expr(st.exc, guards, tries)
+            self._ev(
+                "raise",
+                st,
+                guards,
+                tries,
+                at_end=True,
+                names=_names_in(st.exc),
+            )
+            return
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._assign(st, guards, tries)
+            return
+        if isinstance(st, ast.Expr):
+            self._expr(st.value, guards, tries)
+            return
+        # generic compound fallback (match statements etc.): walk nested
+        # statement lists with the same context, scan loose expressions
+        for _name, value in ast.iter_fields(st):
+            if isinstance(value, list):
+                stmts = [v for v in value if isinstance(v, ast.stmt)]
+                if stmts:
+                    self._block(stmts, guards, tries)
+                    continue
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        self._expr(v, guards, tries)
+            elif isinstance(value, ast.expr):
+                self._expr(value, guards, tries)
+
+    def _assign(self, st, guards, tries) -> None:
+        value = getattr(st, "value", None)
+        self._expr(value, guards, tries)
+        targets = (
+            st.targets
+            if isinstance(st, ast.Assign)
+            else [st.target]
+        )
+        name_targets = frozenset(
+            t.id for t in targets if isinstance(t, ast.Name)
+        )
+        # grown-by-replacement: `dst, ... = lib.f(..., out=scratch)` makes
+        # the bound result an ALIAS of the out= buffer
+        call = value.value if isinstance(value, ast.Await) else value
+        if isinstance(call, ast.Call):
+            outnames = frozenset(
+                n
+                for kw in call.keywords
+                if kw.arg == "out"
+                for n in _names_in(kw.value)
+            )
+            if outnames:
+                # the replacement buffer is the FIRST element of a tuple
+                # binding (dst, off, ... = lib.f(..., out=scratch) — the
+                # batch_codec framing contract); the rest are counts
+                alias_targets = set(name_targets)
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Tuple)
+                        and t.elts
+                        and isinstance(t.elts[0], ast.Name)
+                    ):
+                        alias_targets.add(t.elts[0].id)
+                self._ev(
+                    "alias",
+                    st,
+                    guards,
+                    tries,
+                    names=outnames,
+                    targets=frozenset(alias_targets),
+                )
+        if name_targets:
+            self._ev("rebind", st, guards, tries, names=name_targets)
+        for t in targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)) or (
+                isinstance(t, ast.Tuple)
+                and any(
+                    isinstance(e, (ast.Attribute, ast.Subscript))
+                    for e in t.elts
+                )
+            ):
+                self._ev(
+                    "store", st, guards, tries, names=_names_in(value)
+                )
+                break
+
+    # ------------------------------------------------------------ expressions
+    def _expr(self, node, guards, tries) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Await):
+            self._ev(
+                "await",
+                node,
+                guards,
+                tries,
+                names=_names_in(node.value),
+            )
+            self._expr(node.value, guards, tries)
+            return
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            self._ev(
+                "yield", node, guards, tries, names=_names_in(node.value)
+            )
+            self._expr(node.value, guards, tries)
+            return
+        if isinstance(node, ast.Lambda):
+            names = _names_in(node.body)
+            for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                names |= _names_in(d)
+            self._ev("lambda", node, guards, tries, names=names)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, guards, tries)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, guards, tries)
+
+    def _call(self, node: ast.Call, guards, tries) -> None:
+        f = node.func
+        attr = (
+            f.attr
+            if isinstance(f, ast.Attribute)
+            else (f.id if isinstance(f, ast.Name) else "")
+        )
+        recv = dotted(f.value) if isinstance(f, ast.Attribute) else ""
+        if (
+            attr in _SPAWNS
+            and node.args
+            and isinstance(node.args[0], ast.Call)
+        ):
+            inner = node.args[0]
+            names = frozenset(
+                n for a in inner.args for n in _names_in(a)
+            ) | frozenset(
+                n for kw in inner.keywords for n in _names_in(kw.value)
+            )
+            self._ev("spawn", node, guards, tries, names=names)
+            for a in inner.args:
+                self._expr(a, guards, tries)
+            return
+        if attr == "add_done_callback":
+            names = frozenset(
+                n for a in node.args for n in _names_in(a)
+            )
+            self._ev("done_cb", node, guards, tries, names=names)
+            for a in node.args:
+                self._expr(a, guards, tries)
+            return
+        argnames = frozenset(
+            n for a in node.args for n in _names_in(a)
+        ) | frozenset(
+            n
+            for kw in node.keywords
+            if kw.arg != "out"
+            for n in _names_in(kw.value)
+        )
+        self._ev(
+            "call",
+            node,
+            guards,
+            tries,
+            attr=attr,
+            recv=recv,
+            names=argnames,
+        )
+        if isinstance(f, ast.Attribute):
+            self._expr(f.value, guards, tries)
+        for a in node.args:
+            self._expr(a, guards, tries)
+        for kw in node.keywords:
+            self._expr(kw.value, guards, tries)
+
+
+# ------------------------------------------------------------ sites
+@dataclass
+class _Site:
+    fn: ProgFunc
+    kind: Kind
+    handle: str
+    recv: str  # dotted receiver of the acquiring call ("" for ctors)
+    stmt: ast.stmt
+    call: ast.Call
+    aliases: set = field(default_factory=set)
+
+    @property
+    def line(self) -> int:
+        return self.stmt.lineno
+
+    @property
+    def end_line(self) -> int:
+        return getattr(self.stmt, "end_lineno", None) or self.stmt.lineno
+
+    def matches(self, name: str) -> bool:
+        return name == self.handle or name in self.aliases
+
+
+def _unwrap_calls(expr) -> list:
+    """The Call nodes an assignment RHS may produce a handle from —
+    sees through Await and the conditional-acquire IfExp shape
+    (``arena.acquire(...) if arena else None``)."""
+    if isinstance(expr, ast.Await):
+        return _unwrap_calls(expr.value)
+    if isinstance(expr, ast.IfExp):
+        return _unwrap_calls(expr.body) + _unwrap_calls(expr.orelse)
+    if isinstance(expr, ast.Call):
+        return [expr]
+    return []
+
+
+def _own_nodes(fn: ProgFunc) -> Iterator[ast.AST]:
+    """Walk fn's body WITHOUT descending into nested defs/lambdas —
+    those are their own ProgFuncs and judge their own sites."""
+    stack = list(ast.iter_child_nodes(fn.node))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _local_sites(fn: ProgFunc) -> list[_Site]:
+    out: list[_Site] = []
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        handle = None
+        if isinstance(target, ast.Name):
+            handle = target.id
+        elif (
+            isinstance(target, ast.Tuple)
+            and target.elts
+            and isinstance(target.elts[0], ast.Name)
+        ):
+            # `reserved, retry_ms = ctrl.try_admit(n)` — the reservation
+            # is the FIRST element by vocabulary contract
+            handle = target.elts[0].id
+        if handle is None:
+            continue
+        for call in _unwrap_calls(node.value):
+            kind = acquire_kind(call)
+            if kind is None:
+                continue
+            f = call.func
+            recv = (
+                dotted(f.value) if isinstance(f, ast.Attribute) else ""
+            )
+            out.append(_Site(fn, kind, handle, recv, node, call))
+            break
+    return out
+
+
+def _owner_sites(fn: ProgFunc) -> list[tuple[str, ast.stmt, str]]:
+    """(attr, stmt, ctor_name) for ``self.X = TpuEngine(...)`` shapes
+    (the ctor may be nested in an IfExp)."""
+    out = []
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not (
+            isinstance(t, ast.Attribute)
+            and isinstance(t.value, ast.Name)
+            and t.value.id == "self"
+        ):
+            continue
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                name = (
+                    f.attr
+                    if isinstance(f, ast.Attribute)
+                    else (f.id if isinstance(f, ast.Name) else "")
+                )
+                if name in OWNER_CTORS:
+                    out.append((t.attr, node, name))
+                    break
+    return out
+
+
+# ------------------------------------------------------------ guards
+def _guard_is_refusal(test, polarity: bool, site: _Site) -> bool:
+    """Does this branch imply the handle was REFUSED (0/None → nothing
+    held)? Truthy polarity checks the AND-leaves of the test; falsy
+    polarity only the bare test."""
+    h = site.handle
+
+    def leaves(t):
+        if isinstance(t, ast.BoolOp) and isinstance(t.op, ast.And):
+            for v in t.values:
+                yield from leaves(v)
+        else:
+            yield t
+
+    def is_name(n, name):
+        return isinstance(n, ast.Name) and n.id == name
+
+    if polarity:
+        for leaf in leaves(test):
+            if isinstance(leaf, ast.UnaryOp) and isinstance(
+                leaf.op, ast.Not
+            ):
+                if is_name(leaf.operand, h):
+                    return True
+            if (
+                isinstance(leaf, ast.Compare)
+                and len(leaf.ops) == 1
+                and is_name(leaf.left, h)
+            ):
+                op, right = leaf.ops[0], leaf.comparators[0]
+                if isinstance(op, (ast.Eq, ast.Is)) and (
+                    (
+                        isinstance(right, ast.Constant)
+                        and right.value in (0, None)
+                    )
+                ):
+                    return True
+        return False
+    # else-branch: `if reserved:` / `if reserved is not None:` / `> 0`
+    if is_name(test, h):
+        return True
+    if (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and is_name(test.left, h)
+    ):
+        op, right = test.ops[0], test.comparators[0]
+        if isinstance(op, (ast.IsNot, ast.NotEq, ast.Gt)) and (
+            isinstance(right, ast.Constant) and right.value in (0, None)
+        ):
+            return True
+    return False
+
+
+def _ev_refused(ev: _Ev, site: _Site) -> bool:
+    return any(
+        _guard_is_refusal(test, pol, site) for test, pol in ev.guards
+    )
+
+
+# ------------------------------------------------------------ release match
+def _is_release(site: _Site, ev: _Ev) -> bool:
+    if ev.kind != "call":
+        return False
+    named = any(site.matches(n) for n in ev.names)
+    if named and (
+        ev.attr in site.kind.releases
+        or _RELEASE_HELPER.search(ev.attr)
+    ):
+        return True
+    if ev.attr in site.kind.releases and ev.recv:
+        if site.recv and ev.recv == site.recv:
+            return True
+        # engine handles release via their own receiver: eng.shutdown()
+        base = ev.recv.split(".", 1)[0]
+        if site.matches(base):
+            return True
+    return False
+
+
+def _finally_releases(try_node: ast.Try, site: _Site) -> bool:
+    return _block_releases(try_node.finalbody, site)
+
+
+def _handler_releases(try_node: ast.Try, site: _Site) -> bool:
+    """A handler catching BaseException (or bare) that releases — the
+    `except BaseException: release; raise` cancellation discipline."""
+    for h in try_node.handlers:
+        names = set()
+        if h.type is None:
+            names.add("BaseException")
+        else:
+            for n in ast.walk(h.type):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+                elif isinstance(n, ast.Attribute):
+                    names.add(n.attr)
+        if "BaseException" not in names:
+            continue
+        if _block_releases(h.body, site):
+            return True
+    return False
+
+
+def _block_releases(stmts, site: _Site) -> bool:
+    for st in stmts:
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call):
+                f = node.func
+                attr = (
+                    f.attr
+                    if isinstance(f, ast.Attribute)
+                    else (f.id if isinstance(f, ast.Name) else "")
+                )
+                recv = (
+                    dotted(f.value)
+                    if isinstance(f, ast.Attribute)
+                    else ""
+                )
+                argnames = frozenset(
+                    n for a in node.args for n in _names_in(a)
+                )
+                ev = _Ev(
+                    "call",
+                    node.lineno,
+                    node.col_offset,
+                    names=argnames,
+                    attr=attr,
+                    recv=recv,
+                )
+                if _is_release(site, ev):
+                    return True
+    return False
+
+
+def _protected(ev: _Ev, site: _Site, *, cancellation: bool) -> bool:
+    """Is this exit/await event covered by an enclosing try whose
+    finally (or BaseException handler, for cancellation) releases?"""
+    for t in reversed(ev.tries):
+        if _finally_releases(t, site):
+            return True
+        if cancellation and _handler_releases(t, site):
+            return True
+    return False
+
+
+# ------------------------------------------------------------ the checker
+class LifecycleChecker(Checker):
+    name = "lifecycle"
+    program_level = True
+    rules = {
+        "RSL1601": (
+            "acquired resource with a path to function exit that skips "
+            "the paired release (or releases twice through racing "
+            "mechanisms)"
+        ),
+        "RSL1602": (
+            "cancellation leak: resource held across an await (or handed "
+            "to a spawned task) without finally/done-callback release "
+            "discipline"
+        ),
+        "RSL1603": (
+            "owner object stores an engine/pool whose teardown never "
+            "reaches its shutdown() along any resolved call path"
+        ),
+    }
+
+    def check_program(
+        self, program: Program, locks: LockGraph
+    ) -> Iterator[tuple[str, RawFinding]]:
+        findings: list[tuple[str, RawFinding]] = []
+        for fn in program.funcs.values():
+            if isinstance(fn.node, ast.Lambda):
+                continue
+            sites = _local_sites(fn)
+            if sites:
+                events = _EventWalker(fn.node).out
+                for site in sites:
+                    findings.extend(self._judge_site(site, events))
+            for attr, stmt, ctor in _owner_sites(fn):
+                f = self._judge_owner(program, fn, attr, stmt, ctor)
+                if f is not None:
+                    findings.append(f)
+        for item in sorted(
+            findings, key=lambda kv: (kv[0], kv[1].line, kv[1].rule)
+        ):
+            yield item
+
+    # ------------------------------------------------------------ RSL1601/02
+    def _judge_site(
+        self, site: _Site, events: list[_Ev]
+    ) -> Iterator[tuple[str, RawFinding]]:
+        fn = site.fn
+        is_async = isinstance(fn.node, ast.AsyncFunctionDef)
+        held = True
+        callback_bound = False  # handle escaped into a done-callback
+        spawn_pending: _Ev | None = None
+        for ev in events:
+            if ev.line <= site.end_line:
+                continue  # before/within the acquiring statement
+            if _ev_refused(ev, site):
+                continue  # refusal-guard branch: nothing is held there
+            if ev.kind == "alias" and any(
+                site.matches(n) for n in ev.names
+            ):
+                # grown-by-replacement: the out= result is ours to release
+                site.aliases |= set(ev.targets)
+                continue
+            if ev.kind == "done_cb" and any(
+                site.matches(n) for n in ev.names
+            ):
+                spawn_pending = None
+                callback_bound = True
+                held = False
+                continue
+            if _is_release(site, ev):
+                if not held and callback_bound:
+                    # PR-13 double-free: finally/direct release RACES the
+                    # done-callback release of the same handle
+                    yield (
+                        fn.relpath,
+                        RawFinding(
+                            "RSL1601",
+                            ev.line,
+                            ev.col,
+                            f"{fn.qualname}() releases the "
+                            f"{site.kind.noun} `{site.handle}` here AND "
+                            f"through a done-callback (both run on the "
+                            f"abandonment race — the PR-13 double-free); "
+                            f"keep ONE mechanism, or guard with an "
+                            f"atomic zero-swap of the held amount",
+                        ),
+                    )
+                    return
+                held = False
+                continue
+            if ev.kind in ("lambda", "closure") and any(
+                site.matches(n) for n in ev.names
+            ):
+                # closure/callback discipline: the closure owns it now
+                spawn_pending = None
+                callback_bound = ev.kind == "lambda"
+                held = False
+                continue
+            if not held:
+                continue
+            if ev.kind == "spawn" and any(
+                site.matches(n) for n in ev.names
+            ):
+                spawn_pending = ev
+                held = False
+                continue
+            if ev.kind in ("return", "yield") and any(
+                site.matches(n) for n in ev.names
+            ):
+                held = False  # ownership moves to the caller/consumer
+                continue
+            if ev.kind == "store" and any(
+                site.matches(n) for n in ev.names
+            ):
+                held = False  # published to an attribute/collection
+                continue
+            if ev.kind == "rebind" and site.matches(
+                tuple(ev.names)[0] if len(ev.names) == 1 else ""
+            ):
+                held = False  # handle name rebound: tracking ends
+                continue
+            if ev.kind == "call" and any(
+                site.matches(n) for n in ev.names
+            ):
+                held = False  # ownership transfer to the callee
+                continue
+            if ev.kind == "await" and is_async:
+                if any(site.matches(n) for n in ev.names):
+                    held = False  # handle passed INTO the awaited call
+                    continue
+                if not _protected(ev, site, cancellation=True):
+                    yield (
+                        fn.relpath,
+                        RawFinding(
+                            "RSL1602",
+                            site.line,
+                            site.stmt.col_offset,
+                            f"{fn.qualname}() holds the "
+                            f"{site.kind.noun} `{site.handle}` across "
+                            f"the await at line {ev.line} with no "
+                            f"finally (or except-BaseException-and-"
+                            f"reraise) release: a cancellation there "
+                            f"leaks it forever — wrap the awaited "
+                            f"region in try/finally releasing "
+                            f"`{site.handle}`",
+                        ),
+                    )
+                    return
+                continue
+            if ev.kind in ("return", "raise"):
+                if _protected(ev, site, cancellation=False):
+                    continue
+                yield (
+                    fn.relpath,
+                    RawFinding(
+                        "RSL1601",
+                        site.line,
+                        site.stmt.col_offset,
+                        f"{fn.qualname}() acquires the "
+                        f"{site.kind.noun} `{site.handle}` but the "
+                        f"{ev.kind} at line {ev.line} exits without "
+                        f"the paired "
+                        f"{'/'.join(sorted(site.kind.releases))} — "
+                        f"release in a finally, or guard the exit on "
+                        f"the refusal value",
+                    ),
+                )
+                return
+        if spawn_pending is not None:
+            yield (
+                fn.relpath,
+                RawFinding(
+                    "RSL1602",
+                    site.line,
+                    site.stmt.col_offset,
+                    f"{fn.qualname}() hands the {site.kind.noun} "
+                    f"`{site.handle}` to the task spawned at line "
+                    f"{spawn_pending.line} with no add_done_callback "
+                    f"releasing it: a task cancelled before its first "
+                    f"step never enters the coroutine body, so an "
+                    f"in-coroutine finally leaks the "
+                    f"{site.kind.noun} (the PR-13 rpc-slot shape) — "
+                    f"release via t.add_done_callback(lambda _t, "
+                    f"r={site.handle}: ...)",
+                ),
+            )
+            return
+        if held:
+            yield (
+                fn.relpath,
+                RawFinding(
+                    "RSL1601",
+                    site.line,
+                    site.stmt.col_offset,
+                    f"{fn.qualname}() acquires the {site.kind.noun} "
+                    f"`{site.handle}` and never releases, returns, or "
+                    f"hands it off on any path — every acquisition "
+                    f"needs a paired "
+                    f"{'/'.join(sorted(site.kind.releases))}",
+                ),
+            )
+
+    # ------------------------------------------------------------ RSL1603
+    def _judge_owner(
+        self,
+        program: Program,
+        fn: ProgFunc,
+        attr: str,
+        stmt: ast.stmt,
+        ctor: str,
+    ) -> tuple[str, RawFinding] | None:
+        if fn.cls is None:
+            return None
+        methods = [
+            m
+            for (cls, _name), fns in program._methods.items()
+            if cls == fn.cls
+            for m in fns
+            if m.modkey == fn.modkey
+        ]
+        teardowns = [
+            m for m in methods if _TEARDOWN_METHOD.search(m.name)
+        ]
+        reached = False
+        seen: set[int] = set()
+        frontier = list(teardowns)
+        for _depth in range(4):
+            if reached or not frontier:
+                break
+            nxt: list[ProgFunc] = []
+            for m in frontier:
+                if id(m.node) in seen:
+                    continue
+                seen.add(id(m.node))
+                if self._reaches_teardown(m, attr):
+                    reached = True
+                    break
+                for call in program.calls_in(m):
+                    callees, _amb = program.resolve_call(
+                        m, call, unique_methods=False
+                    )
+                    nxt.extend(callees)
+            frontier = nxt
+        if teardowns and reached:
+            return None
+        why = (
+            f"defines no stop/shutdown/close method at all"
+            if not teardowns
+            else f"has teardown methods "
+            f"({', '.join(sorted(m.name for m in teardowns))}) but none "
+            f"reaches self.{attr}.shutdown() along any resolved call "
+            f"path"
+        )
+        return (
+            fn.relpath,
+            RawFinding(
+                "RSL1603",
+                stmt.lineno,
+                stmt.col_offset,
+                f"{fn.cls} stores a {ctor} in self.{attr} but {why} — "
+                f"a daemon harvester/pool pins the whole engine for the "
+                f"process lifetime; tear it down from the owner's "
+                f"stop/shutdown",
+            ),
+        )
+
+    @staticmethod
+    def _reaches_teardown(m: ProgFunc, attr: str) -> bool:
+        want = {f"self.{attr}.{t}" for t in _OWNER_TEARDOWNS}
+        for node in ast.walk(m.node):
+            if isinstance(node, ast.Attribute) and dotted(node) in want:
+                return True
+        return False
+
+
+# ------------------------------------------------------------ runtime model
+def model_sites(
+    modules: list[tuple[str, ast.Module]],
+) -> dict[str, set[int]]:
+    """The static acquire-site model the leakwatch runtime recorder is
+    validated against: relpath -> every line of every statement that
+    performs a vocabulary acquisition (bound or not — the runtime
+    attributes a wrapped call to its caller's current line, which is
+    always within the acquiring statement)."""
+    out: dict[str, set[int]] = {}
+
+    def scan_stmt(relpath: str, st: ast.stmt) -> None:
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call) and acquire_kind(node):
+                end = getattr(st, "end_lineno", None) or st.lineno
+                out.setdefault(relpath, set()).update(
+                    range(st.lineno, end + 1)
+                )
+                return
+
+    for relpath, tree in modules:
+        for node in ast.walk(tree):
+            for field_ in ("body", "orelse", "finalbody"):
+                val = getattr(node, field_, None)
+                if not isinstance(val, list):  # Lambda.body is an expr
+                    continue
+                for st in val:
+                    if isinstance(st, ast.stmt):
+                        scan_stmt(relpath, st)
+            for h in getattr(node, "handlers", []) or []:
+                for st in h.body:
+                    scan_stmt(relpath, st)
+    return out
