@@ -427,6 +427,8 @@ def run_pulse_block() -> dict:
         engine.submit(req).result()
         engine.shutdown()
         tl = pulse.timeline()
+        global _LAST_PULSE_TIMELINE
+        _LAST_PULSE_TIMELINE = tl
         return {
             "recorder": pulse.recorder.summary(),
             "stage_totals_s": {
@@ -439,6 +441,49 @@ def run_pulse_block() -> dict:
     finally:
         pulse.configure(enabled=was_pulse)
         tracer.configure(enabled=was_tracing)
+
+
+# the pulse block's raw timeline, kept for --diff-against: a timeline
+# baseline diffs against THIS run's timeline through tools/pulsediff.py
+_LAST_PULSE_TIMELINE: dict | None = None
+
+
+def run_trend_block() -> dict:
+    """ISSUE 17: the pandatrend block every BENCH artifact carries — the
+    metrics-history recorder sampled around one columnar round, so the
+    artifact holds the same derived counter tracks `/v1/history` and
+    `rpk debug trend` serve on a live broker (occupancy, shed rate,
+    colcache, per-histogram p99.9) for the bench's launch shape. No
+    recorder thread runs here: two explicit ``sample_once()`` calls
+    bracket the round, exactly the delta one 5s window would carry."""
+    from redpanda_tpu.coproc import TpuEngine
+    from redpanda_tpu.observability.history import history
+
+    history.reset()
+    history.sample_once()  # anchors the delta baseline
+    req = _build_workload(8, topic="bench_trend")
+    engine = TpuEngine(row_stride=ROW_STRIDE)
+    codes = engine.enable_coprocessors(
+        [(1, _spec().to_json(), ("bench_trend",))]
+    )
+    assert codes[0] == 0
+    engine.submit(req).result()
+    engine.shutdown()
+    win = history.sample_once() or {}
+    snap = history.snapshot(limit=1)
+    return {
+        "tracks": win.get("tracks", {}),
+        "counter_deltas": {
+            k: v["delta"]
+            for k, v in sorted(win.get("counters", {}).items())
+        },
+        "hist_p999_us": {
+            k: v["p999"] for k, v in sorted(win.get("hists", {}).items())
+        },
+        "breaches_total": snap["breaches_total"],
+        "recorder_running": snap["recorder_running"],
+        "counter_events": len(history.counter_tracks(pid=0)),
+    }
 
 
 def run_config3_diagnosis(aa: dict) -> dict:
@@ -669,7 +714,36 @@ def run_link_profile() -> dict:
     return {"rtt_ms": round(rtt_ms, 1), "h2d_mb_s_consumed": round(h2d, 1)}
 
 
-def main():
+def _bench_diff_block(against_path: str, artifact: dict) -> dict:
+    """ISSUE 17 release-flow judgment on the BENCH side: diff this run
+    against a prior artifact through tools/pulsediff.py. A timeline
+    baseline (a saved ``rpk debug profile --perfetto`` / pulse block
+    export) judges against THIS run's pulse-round timeline stage by
+    stage; a BENCH/SLO baseline delegates to slodiff as before. The
+    bench's own measured A/A band rides as the noise band either way."""
+    from tools import pulsediff
+
+    try:
+        baseline = pulsediff._load(against_path)
+        if pulsediff.is_timeline(baseline):
+            tl = _LAST_PULSE_TIMELINE
+            if tl is None:
+                raise ValueError(
+                    "no pulse timeline captured this run to diff against"
+                )
+            tl = dict(tl)
+            tl.setdefault("aa_band_pct", artifact.get("aa_skew_pct"))
+            d = pulsediff.diff_artifacts(baseline, tl, None)
+        else:
+            d = pulsediff.diff_artifacts(baseline, artifact, None)
+        d["against"] = against_path
+        return d
+    except Exception as exc:  # the measured run must never sink on a diff
+        return {"against": against_path, "error": repr(exc),
+                "verdict": "NO_BASELINE"}
+
+
+def main(diff_against: str | None = None):
     tpu_ok = _probe_tpu()
     if not tpu_ok:
         _pin_cpu()
@@ -758,14 +832,17 @@ def main():
             "journal": gov_mod.journal.summary(),
             "journal_tail": gov_mod.journal.entries(limit=16),
         }
+        # ISSUE 17: the pandatrend block — history-recorder counter tracks
+        # for one columnar round, sampled FIRST so the pulse block's
+        # timeline below carries them as ph:"C" lanes on the span clock
+        extras["trend"] = run_trend_block()
         # ISSUE 14: the pandapulse block — flight-recorder stage totals +
         # timeline/journal event counts for one instrumented round
         extras["pulse"] = run_pulse_block()
     except Exception as exc:  # secondary metrics must never sink the bench
         extras["configs_error"] = repr(exc)
 
-    print(
-        json.dumps(
+    artifact = (
             {
                 "metric": "coproc_json_filter_record_batches_per_sec_64p",
                 "value": round(value, 1),
@@ -851,12 +928,20 @@ def main():
                 "vs_host_columnar": round(dev_rate / host_col_rate, 2),
                 **extras,
             }
-        )
     )
+    if diff_against:
+        artifact["diff"] = _bench_diff_block(diff_against, artifact)
+    print(json.dumps(artifact))
 
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "mesh":
         main_mesh()
     else:
-        main()
+        _da = None
+        if "--diff-against" in sys.argv:
+            _i = sys.argv.index("--diff-against")
+            if _i + 1 >= len(sys.argv):
+                sys.exit("--diff-against requires a path")
+            _da = sys.argv[_i + 1]
+        main(diff_against=_da)

@@ -167,6 +167,7 @@ class AdminServer:
             web.get("/v1/slo/exemplars", self._slo_exemplars),
             web.get("/v1/profile", self._profile),
             web.get("/v1/profile/timeline", self._profile_timeline),
+            web.get("/v1/history", self._history),
             web.get("/metrics", self._metrics),
             web.get("/v1/trace/recent", self._trace_recent),
             web.get("/v1/trace/slow", self._trace_slow),
@@ -820,6 +821,36 @@ class AdminServer:
             )
             return web.json_response(body)
         return web.json_response(pulse.timeline(launches=launches))
+
+    # ------------------------------------------------------------ history
+    async def _history(self, req: web.Request) -> web.Response:
+        """The pandatrend metrics-history ring (observability/history.py):
+        bounded per-interval delta windows with derived rates/quantiles,
+        the EWMA band state, and breach totals — `rpk debug trend` renders
+        this. ``?series=SUBSTR`` filters every per-series section,
+        ``?limit=N`` caps the window slice (newest last), ``?federated=1``
+        fans out to every broker's admin and returns the per-node rings
+        side by side (windows never merge across wall clocks)."""
+        from redpanda_tpu.observability.history import history
+
+        series = req.query.get("series") or None
+        try:
+            limit = max(0, int(req.query.get("limit", "0")))
+        except ValueError:
+            return web.json_response(
+                {"error": "limit must be an int"}, status=400
+            )
+        if req.query.get("federated", "").lower() in ("1", "true", "yes"):
+            from redpanda_tpu.observability import federation
+
+            body = await federation.assemble_cluster_history(
+                self._admin_targets(), series=series, limit=limit,
+                headers=self._peer_headers(),
+            )
+            return web.json_response(body)
+        body = history.snapshot(series=series, limit=limit)
+        body["node"] = self.broker.config.node_id
+        return web.json_response(body)
 
     # ------------------------------------------------------------ metrics
     async def _metrics(self, req: web.Request) -> web.Response:

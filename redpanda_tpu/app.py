@@ -124,6 +124,17 @@ class Application:
             ring_capacity=c.pulse_ring_capacity,
             profile_hz=float(c.profile_hz),
         )
+        # pandatrend: the bounded metrics-history ring (delta windows over
+        # the whole registry, EWMA breach journaling into the governor's
+        # trend domain, Perfetto counter tracks). interval 0 = off and NO
+        # recorder thread — the profile_hz=0 contract.
+        from redpanda_tpu.observability.history import history
+
+        history.configure(
+            interval_s=float(c.history_interval_s),
+            windows=c.history_windows,
+            max_bytes=c.history_max_bytes,
+        )
         # SLO engine: operator objectives (or the lenient broker defaults)
         # judged at GET /v1/slo; loading arms per-metric breach thresholds
         # so over-threshold observations record trace exemplars
@@ -518,6 +529,18 @@ class Application:
             "pulse_profile_samples",
             lambda: float(_pulse.profiler.samples),
             "Wall-profile sampling ticks taken (profile_hz > 0)",
+        )
+        from redpanda_tpu.observability.history import history as _history
+
+        registry.gauge(
+            "history_windows_retained",
+            lambda: float(len(_history.windows())),
+            "Delta windows currently held in the pandatrend history ring",
+        )
+        registry.gauge(
+            "history_breaches_total",
+            lambda: float(_history.breaches_total),
+            "EWMA-band breaches the trend judge has journaled since start",
         )
         from redpanda_tpu.observability.slo import slo as _slo
 

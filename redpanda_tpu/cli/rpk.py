@@ -474,10 +474,13 @@ async def cmd_debug(args) -> int:
             extra = ""
             if body.get("unreachable"):
                 extra = f" (PARTIAL: unreachable {body['unreachable']})"
+            n_counters = sum(1 for e in events if e.get("ph") == "C")
+            tracks = len({e["name"] for e in events if e.get("ph") == "C"})
             print(
                 f"wrote {args.perfetto}: {len(events)} events, "
                 f"{body.get('launches', 0)} launches, "
-                f"{body.get('journal_events', '?')} journal instants"
+                f"{body.get('journal_events', '?')} journal instants, "
+                f"{n_counters} counter samples on {tracks} trend tracks"
                 f"{extra} — load it at https://ui.perfetto.dev"
             )
             return 0
@@ -528,6 +531,66 @@ async def cmd_debug(args) -> int:
             ordered = sorted(totals.items(), key=lambda kv: -kv[1])
             for k, v in ordered[:16]:
                 print(f"  {k:<40}{v:>12.6f}")
+        return 0
+
+    if args.debug_cmd == "trend":
+        query = {}
+        if getattr(args, "series", None):
+            query["series"] = args.series
+        if getattr(args, "limit", 0):
+            query["limit"] = str(args.limit)
+        if getattr(args, "federated", False):
+            query["federated"] = "1"
+        status, body = await _admin_request(
+            args, "GET", "/v1/history", query=query or None
+        )
+        if status != 200:
+            print(f"admin api returned {status}: {body}")
+            return 1
+        if args.json:
+            print(json.dumps(body, indent=2, sort_keys=True))
+            return 0
+
+        def _render_node(doc: dict, indent: str = "") -> None:
+            wins = doc.get("windows") or []
+            print(
+                f"{indent}history: {doc.get('windows_retained', 0)} windows "
+                f"(interval {doc.get('interval_s', '?')}s, "
+                f"recorder {'on' if doc.get('recorder_running') else 'OFF'}, "
+                f"{doc.get('bytes', 0)}/{doc.get('bytes_max', 0)} bytes, "
+                f"evicted {doc.get('evicted_total', 0)})"
+            )
+            print(
+                f"{indent}breaches: {doc.get('breaches_total', 0)} journaled "
+                f"(governor trend domain; `rpk debug governor` shows them)"
+            )
+            ewma = doc.get("ewma") or {}
+            latest = wins[-1].get("tracks", {}) if wins else {}
+            names = sorted(set(latest) | set(ewma))
+            if names:
+                print(
+                    f"{indent}{'TRACK':<44}{'LATEST':>12}{'EWMA':>12}"
+                    f"{'BAND':>12}  STATE"
+                )
+            for name in names:
+                st = ewma.get(name) or {}
+                cur = latest.get(name)
+                print(
+                    f"{indent}{name:<44}"
+                    f"{cur if cur is not None else '-':>12}"
+                    f"{st.get('mean', '-'):>12}"
+                    f"{st.get('band', '-'):>12}  "
+                    f"{'BREACHED' if st.get('breached') else 'ok'}"
+                )
+
+        if args.federated:
+            if body.get("unreachable"):
+                print(f"PARTIAL: unreachable {body['unreachable']}")
+            for node in sorted(body.get("nodes") or {}, key=str):
+                print(f"node {node}:")
+                _render_node(body["nodes"][node], indent="  ")
+            return 0
+        _render_node(body)
         return 0
 
     if args.debug_cmd == "resources":
@@ -825,6 +888,9 @@ async def cmd_debug(args) -> int:
         # Perfetto-loadable artifact — open timeline.json at ui.perfetto.dev)
         ("profile.json", "/v1/profile"),
         ("timeline.json", "/v1/profile/timeline"),
+        # pandatrend: the metrics-history ring (per-window rates/quantiles
+        # + EWMA band state) — what `rpk debug trend` renders
+        ("history.json", "/v1/history"),
         ("slo.json", "/v1/slo"),
         ("failpoints.json", "/v1/failure-probes"),
     ]:
@@ -1069,6 +1135,25 @@ def build_parser() -> argparse.ArgumentParser:
         "--federated", action="store_true",
         help="with --perfetto: assemble the cluster timeline across "
              "every broker (like rpk debug trace --cluster)",
+    )
+    dtrend = dsub.add_parser(
+        "trend",
+        help="pandatrend metrics history: per-window rates/quantiles, "
+             "EWMA bands + breach state (admin api GET /v1/history)",
+    )
+    dtrend.add_argument("--json", action="store_true", help="raw JSON, no rendering")
+    dtrend.add_argument(
+        "--series", default=None,
+        help="substring filter over series keys (counters/gauges/hists/tracks)",
+    )
+    dtrend.add_argument(
+        "--limit", type=int, default=0,
+        help="newest N windows only (0 = the whole retained ring)",
+    )
+    dtrend.add_argument(
+        "--federated", action="store_true",
+        help="fan out to every broker's admin: per-node window rings "
+             "side by side (windows never merge across wall clocks)",
     )
     dgov = dsub.add_parser(
         "governor",

@@ -141,6 +141,29 @@ PROPERTIES: list[Property] = [
         "with nothing periodic)",
         0.0, float, _non_negative,
     ),
+    # pandatrend (observability/history.py): the bounded metrics-history
+    # ring behind GET /v1/history, `rpk debug trend` and the Perfetto
+    # counter tracks. interval 0 = off AND no recorder thread (the
+    # profile_hz=0 contract); the ring is bounded both by window count
+    # and by history_max_bytes, evicting oldest-first.
+    Property(
+        "history_interval_s",
+        "Metrics-history sampling cadence in seconds (pandatrend delta "
+        "windows; 0 = off, no recorder thread)",
+        5.0, float, _non_negative,
+    ),
+    Property(
+        "history_windows",
+        "Maximum retained metrics-history delta windows (oldest evicted "
+        "first; the byte budget below also bounds the ring)",
+        240, int, _positive,
+    ),
+    Property(
+        "history_max_bytes",
+        "Estimated byte budget for the metrics-history ring (label-"
+        "cardinality growth evicts history, never grows the process)",
+        4 * 1024 * 1024, int, _positive,
+    ),
     Property(
         "slo_objectives_file",
         "YAML/JSON SLO objective spec judged at GET /v1/slo (empty = the "

@@ -237,12 +237,19 @@ class CoprocApi:
                         logger.warning("ignoring malformed coproc event")
                 next_offset = b.last_offset + 1
         # dispatch BEFORE advancing the cursor, but isolate per event: a
-        # TRANSIENT infrastructure failure retries the chunk on the next
-        # poll (re-raise), while a poison event (enable itself blowing up
-        # on pathological input) is logged and skipped — otherwise one bad
-        # deploy would wedge every later deploy/remove on every broker
-        # forever. enable/disable report expected failures via codes; an
-        # exception from them is the poison case.
+        # POISON event — the script itself is bad (SandboxViolation from
+        # validation, ValueError from a malformed event body) — is logged
+        # and skipped, otherwise one bad deploy would wedge every later
+        # deploy/remove on every broker forever. Anything else is a
+        # TRANSIENT infrastructure failure (partition moving, engine
+        # mid-restart): re-raise WITHOUT advancing the cursor so the whole
+        # chunk retries on the next poll — swallowing it would silently
+        # diverge script state across the cluster (this broker skips a
+        # deploy its peers applied). Retried events are idempotent:
+        # _enable dedupes unchanged redeploys by checksum and _disable of
+        # an inactive name is a no-op.
+        from redpanda_tpu.coproc.sandbox import SandboxViolation
+
         for name, ev in wasm_event.reconcile(events).items():
             try:
                 if ev.action == wasm_event.DEPLOY:
@@ -251,7 +258,7 @@ class CoprocApi:
                     await self._disable(name)
             except asyncio.CancelledError:
                 raise
-            except Exception as exc:
+            except (SandboxViolation, ValueError) as exc:
                 faults.note_failure("wasm_event", exc)
                 logger.exception("poison coproc event %r skipped", name)
         self._listen_offset = next_offset

@@ -1385,7 +1385,7 @@ class TpuEngine:
         if not topics:
             return EnableResponseCode.script_contains_no_topics
         try:
-            fn = compile_transform(source)
+            fn = compile_transform(source, script_id=script_id)
         except SandboxViolation as exc:
             faults.note_failure(faults.SANDBOX_COMPILE, exc)
             return EnableResponseCode.internal_error

@@ -90,11 +90,17 @@ MESH = "mesh"
 # group_ticks_per_launch / launch_depth autotune verdicts all journal
 # here — the overload gate reconstructs every shed/resize from this domain
 ADMISSION = "admission"
+# pandatrend (observability/history.py): EWMA-band breaches over the
+# metrics-history ring — tail latency, shed rate, occupancy, colcache hit
+# rate leaving their measured band journal here, plus the sandbox
+# watchdog's wall-clock kills (a runaway deployed transform is a trend
+# incident: the containment model itself fired)
+TREND = "trend"
 
 DOMAINS = (
     HOST_POOL, COLUMNAR_BACKEND, DEVICE_LZ4, BREAKER, HARVEST_PATH,
     SHARDED_SEAL, DEADLINE, PARSE_PATH, COLUMN_CACHE, DIAGNOSIS, LOCKWATCH,
-    LEAKWATCH, MESH, ADMISSION,
+    LEAKWATCH, MESH, ADMISSION, TREND,
 )
 
 # fault domains that get their own breaker + adaptive deadline. Each
@@ -257,7 +263,7 @@ def _decision_counter(domain: str, verdict: str) -> Counter:
         with _decision_lock:
             c = _decision_counters.get(key)
             if c is None:
-                c = registry.counter(
+                c = registry.counter(  # pandalint: disable=MET1701 -- memoized check-then-create: the lookup runs once per (domain,verdict) key under _decision_lock, hot calls hit the dict; the label set is open-ended so probes.py cannot pre-bind it
                     "coproc_governor_decisions_total",
                     "Adaptive decisions routed through the coproc governor",
                     domain=domain,
@@ -403,6 +409,18 @@ class Governor:
                 "coproc/governor.py encoding; -1 undecided)",
                 domain=domain,
             )
+        for knob in ("group_ticks", "launch_depth"):
+            # the autotune knobs as live gauges: the pandatrend history
+            # ring samples these into `knob:*` counter tracks so a knob
+            # resize is visible ON the launch timeline, not only as a
+            # journal instant
+            registry.gauge(
+                "coproc_autotune_knob",
+                self._knob_gauge_fn(ref, knob),
+                "Current dynamic launch knob value (ADMISSION autotune; "
+                "-1 when autotune is unarmed)",
+                knob=knob,
+            )
 
     @staticmethod
     def _breaker_gauge_fn(ref, domain):
@@ -432,6 +450,20 @@ class Governor:
                 return -1.0
             verdict = gov._posture_modes.get(domain)
             return _STATE_ENCODING[domain].get(verdict, -1.0)
+
+        return fn
+
+    @staticmethod
+    def _knob_gauge_fn(ref, knob):
+        def fn() -> float:
+            gov = ref()
+            if gov is None:
+                return -1.0
+            auto = gov._auto
+            if auto is None:
+                return -1.0
+            with gov._lock:
+                return float(auto[knob])
 
         return fn
 

@@ -19,12 +19,31 @@ script is registered):
   `().__class__.__mro__` escape runs through dunder attributes);
 - NO global/nonlocal, no lambda/def nesting, no decorators, no yield;
 - executed with empty __builtins__ and a curated safe-globals table;
-- bounded runtime: a line-trace budget aborts a record that executes more
-  than EXEC_LINE_BUDGET traced lines (while-loop containment).
+- bounded runtime: a wall-clock WATCHDOG enforces a per-record deadline
+  (EXEC_WALL_DEADLINE_S) through three layers, because CPython cannot
+  preempt a thread mid-opcode (a bigint ``10**10**8`` holds the GIL for
+  minutes and no trace event, signal, or async exception lands until the
+  opcode completes):
+  1. the line tracer checks the deadline on every traced line — any LOOP
+     is killed at the deadline, and a line-event budget
+     (EXEC_LINE_BUDGET) additionally caps trace volume;
+  2. operand guards injected at compile time around ``**``, ``<<``, ``*``
+     and ``range(...)`` refuse, BEFORE entering the opcode, operations
+     whose operands guarantee an uninterruptible overrun (result size
+     bounds sized so every permitted op completes orders of magnitude
+     inside the deadline) — the only sound kill for single-opcode burns;
+  3. a post-completion elapsed check fails the record even when a
+     residual single call (a large allocation) slipped past both.
+  The line budget is therefore NOT the hard bound — the deadline is; the
+  budget only bounds tracer work for hot tight loops.
 
 Runtime failures surface through the engine's ErrorPolicy exactly like any
 script failure: skip_on_failure drops the record, deregister unloads the
-script (wasm_event.h policy semantics).
+script (wasm_event.h policy semantics). Watchdog kills additionally
+journal one entry into the governor TREND domain — a deployed transform
+hitting its deadline is an operational trend event, visible in
+`rpk debug governor` and on the Perfetto timeline next to the launch it
+failed.
 """
 
 from __future__ import annotations
@@ -32,9 +51,16 @@ from __future__ import annotations
 import ast
 import json
 import sys
+import time
 
-EXEC_LINE_BUDGET = 100_000  # traced line events per record
+EXEC_LINE_BUDGET = 100_000  # traced line events per record (tracer-work cap)
+EXEC_WALL_DEADLINE_S = 1.0  # per-record wall-clock deadline (the hard bound)
 MAX_SOURCE_BYTES = 64 * 1024
+# operand-guard bounds: sized so any permitted single op completes orders
+# of magnitude inside EXEC_WALL_DEADLINE_S on commodity hardware — a 2M-bit
+# int multiply is ~ms; 10**10**8 (a 332M-bit result) is refused outright
+MAX_INT_BITS = 1 << 21  # ~2M bits (~256 KiB integer)
+MAX_SEQ_ELEMS = 1 << 24  # 16M elements for seq*int / range(...)
 
 
 class SandboxViolation(Exception):
@@ -55,9 +81,19 @@ class SandboxBudgetExceeded(BaseException):
     ErrorPolicy machinery handles it like any script failure."""
 
 
+class SandboxDeadlineExceeded(BaseException):
+    """The wall-clock watchdog killed a record (deadline passed, or an
+    operand guard refused an op that guarantees an uninterruptible
+    overrun). BaseException for the same reason as SandboxBudgetExceeded:
+    user code must not be able to catch the kill."""
+
+    layer = "guard"  # overridden to "deadline" by the tracer's raise
+
+
 class SandboxRuntimeError(Exception):
-    """A record's execution was killed (budget overrun), reported at the
-    sandbox boundary for the engine's ErrorPolicy to handle."""
+    """A record's execution was killed (line-budget overrun or watchdog
+    deadline), reported at the sandbox boundary for the engine's
+    ErrorPolicy to handle."""
 
 
 _ALLOWED_NODES = (
@@ -100,11 +136,132 @@ _SAFE_METHODS = frozenset({
     "copy", "add", "discard", "union", "intersection", "difference",
 })
 
+# ---- watchdog operand guards -------------------------------------------
+# CPython cannot preempt mid-opcode: once `10**10**8` starts, the GIL is
+# held and NO trace event, async exception, or signal lands until the
+# (minutes-long) opcode completes. The only sound kill for these burns is
+# refusing the operation before it starts. compile_transform rewrites the
+# (already validated) AST so `**`, `<<`, `*` route through these guards,
+# and the `range` builtin is bounded at creation (a >16M-element range is
+# only dangerous when materialized — sorted/list/sum of it is another
+# uninterruptible C loop; a for-loop that large dies at the line budget
+# long before, so legitimate transforms lose nothing).
+
+
+def _guard_pow(a, b):
+    if isinstance(a, int) and isinstance(b, int) and b > 0:
+        if b * max(a.bit_length(), 1) > MAX_INT_BITS:
+            raise SandboxDeadlineExceeded(
+                f"watchdog: ** operands guarantee a deadline overrun "
+                f"(result would exceed {MAX_INT_BITS} bits)"
+            )
+    return a ** b
+
+
+def _guard_lshift(a, b):
+    if isinstance(a, int) and isinstance(b, int) and b > 0:
+        if b + a.bit_length() > MAX_INT_BITS:
+            raise SandboxDeadlineExceeded(
+                f"watchdog: << operands guarantee a deadline overrun "
+                f"(result would exceed {MAX_INT_BITS} bits)"
+            )
+    return a << b
+
+
+def _guard_mult(a, b):
+    if isinstance(a, int) and isinstance(b, int):
+        if a.bit_length() + b.bit_length() > MAX_INT_BITS:
+            raise SandboxDeadlineExceeded(
+                f"watchdog: * operands guarantee a deadline overrun "
+                f"(result would exceed {MAX_INT_BITS} bits)"
+            )
+    else:
+        seq, n = (a, b) if isinstance(b, int) else (b, a)
+        if (
+            isinstance(n, int)
+            and isinstance(seq, (str, bytes, bytearray, list, tuple))
+            and n > 0
+            and len(seq) * n > MAX_SEQ_ELEMS
+        ):
+            raise SandboxDeadlineExceeded(
+                f"watchdog: sequence * {n} would exceed "
+                f"{MAX_SEQ_ELEMS} elements"
+            )
+    return a * b
+
+
+def _guard_range(*args):
+    r = range(*args)
+    if len(r) > MAX_SEQ_ELEMS:
+        raise SandboxDeadlineExceeded(
+            f"watchdog: range of {len(r)} elements exceeds "
+            f"{MAX_SEQ_ELEMS} (materializing it is an uninterruptible burn)"
+        )
+    return r
+
+
+# injected under dunder-reserved names: validation rejects any dunder Name
+# in USER source, so a transform can neither call nor rebind the guards —
+# only compile_transform's post-validation rewrite references them
+_GUARD_GLOBALS = {
+    "__sbx_pow__": _guard_pow,
+    "__sbx_lshift__": _guard_lshift,
+    "__sbx_mult__": _guard_mult,
+}
+
+
+class _GuardInjector(ast.NodeTransformer):
+    """Post-validation rewrite: `a ** b` -> `__sbx_pow__(a, b)` (likewise
+    `<<`, `*`, and the augmented forms). Runs on the validated tree only —
+    user source never names the guards (dunder names are rejected)."""
+
+    _OPS = {
+        ast.Pow: "__sbx_pow__",
+        ast.LShift: "__sbx_lshift__",
+        ast.Mult: "__sbx_mult__",
+    }
+
+    def _call(self, name: str, left, right, at):
+        return ast.copy_location(
+            ast.Call(
+                func=ast.Name(id=name, ctx=ast.Load()),
+                args=[left, right], keywords=[],
+            ),
+            at,
+        )
+
+    def visit_BinOp(self, node):
+        self.generic_visit(node)
+        name = self._OPS.get(type(node.op))
+        if name is None:
+            return node
+        return self._call(name, node.left, node.right, node)
+
+    def visit_AugAssign(self, node):
+        self.generic_visit(node)
+        name = self._OPS.get(type(node.op))
+        if name is None:
+            return node
+        # `x **= y` -> `x = __sbx_pow__(x, y)`; a Subscript target's key
+        # evaluates twice, acceptable in a side-effect-light sandbox
+        import copy as _copy
+
+        load_target = _copy.deepcopy(node.target)
+        load_target.ctx = ast.Load()
+        return ast.copy_location(
+            ast.Assign(
+                targets=[node.target],
+                value=self._call(name, load_target, node.value, node),
+            ),
+            node,
+        )
+
+
 _SAFE_BUILTINS = {
     "len": len, "int": int, "float": float, "str": str, "bytes": bytes,
     "bool": bool, "dict": dict, "list": list, "tuple": tuple, "set": set,
     "min": min, "max": max, "sum": sum, "abs": abs, "round": round,
-    "sorted": sorted, "reversed": reversed, "range": range,
+    "sorted": sorted, "reversed": reversed, "range": _guard_range,
     "enumerate": enumerate, "zip": zip, "map": map, "filter": filter,
     "any": any, "all": all, "ord": ord, "chr": chr, "repr": repr,
     "isinstance": isinstance, "divmod": divmod, "hash": hash,
@@ -195,25 +352,74 @@ def validate_source(source: str) -> ast.Module:
     return tree
 
 
-def compile_transform(source: str):
-    """validate + compile -> callable(value: bytes) -> bytes | None.
+def _journal_watchdog_kill(script_id, layer: str, elapsed_s: float, reason: str):
+    """One governor TREND entry per incident (the caller dedupes): a
+    deployed transform hitting its wall-clock deadline is an operational
+    trend event, not per-record noise."""
+    try:
+        from redpanda_tpu.coproc.governor import TREND, journal_record
 
-    Each call runs under a line-budget trace; the returned callable raises
-    SandboxBudgetExceeded when a record overruns EXEC_LINE_BUDGET."""
+        journal_record(
+            TREND,
+            "watchdog_kill",
+            f"sandbox watchdog killed script "
+            f"{script_id if script_id is not None else '?'} ({layer}): {reason}",
+            inputs={
+                "script_id": script_id,
+                "layer": layer,
+                "elapsed_s": round(elapsed_s, 6),
+            },
+            config={
+                "deadline_s": EXEC_WALL_DEADLINE_S,
+                "line_budget": EXEC_LINE_BUDGET,
+                "max_int_bits": MAX_INT_BITS,
+            },
+        )
+    except Exception:
+        # journaling is best-effort; a kill must surface through
+        # ErrorPolicy even if the governor import is unavailable
+        # mid-shutdown (EXC901's import-probe exemption applies)
+        pass
+
+
+def compile_transform(source: str, script_id: int | None = None):
+    """validate + guard-inject + compile -> callable(value) -> bytes | None.
+
+    Each call runs under the three-layer watchdog (module docstring): a
+    line tracer that enforces both EXEC_LINE_BUDGET and the wall-clock
+    deadline, operand guards compiled around `**`/`<<`/`*`/`range`, and a
+    post-completion elapsed check. Kills surface as SandboxRuntimeError
+    for the engine's ErrorPolicy; watchdog kills additionally journal one
+    governor TREND entry per incident."""
     from redpanda_tpu.coproc import faults
 
     # fault domain: a poisoned compile must refuse registration, not take
     # the broker down — the chaos suite drives this via the armed probe
     faults.inject(faults.SANDBOX_COMPILE)
     tree = validate_source(source)
+    # post-validation rewrite: user source can neither name nor shadow the
+    # dunder guard bindings (validation rejects dunder names)
+    tree = _GuardInjector().visit(tree)
+    ast.fix_missing_locations(tree)
     code = compile(tree, "<coproc-sandbox>", "exec")
     glb: dict = {"__builtins__": {}}
     glb.update(_SAFE_BUILTINS)
+    glb.update(_GUARD_GLOBALS)
     exec(code, glb)  # defines transform in glb; body is whitelisted
     fn = glb["transform"]
+    incident_journaled = False  # once per compiled transform, not per record
+
+    def _kill(layer: str, elapsed_s: float, reason: str):
+        nonlocal incident_journaled
+        if not incident_journaled:
+            incident_journaled = True
+            _journal_watchdog_kill(script_id, layer, elapsed_s, reason)
+        raise SandboxRuntimeError(reason) from None
 
     def run(value: bytes):
         budget = EXEC_LINE_BUDGET
+        t0 = time.monotonic()
+        deadline = t0 + EXEC_WALL_DEADLINE_S
 
         def tracer(frame, event, arg):
             nonlocal budget
@@ -223,6 +429,13 @@ def compile_transform(source: str):
                     raise SandboxBudgetExceeded(
                         f"transform exceeded {EXEC_LINE_BUDGET} lines"
                     )
+                if time.monotonic() > deadline:
+                    exc = SandboxDeadlineExceeded(
+                        f"watchdog: record exceeded the "
+                        f"{EXEC_WALL_DEADLINE_S}s wall-clock deadline"
+                    )
+                    exc.layer = "deadline"
+                    raise exc
             return tracer
 
         old = sys.gettrace()
@@ -233,8 +446,19 @@ def compile_transform(source: str):
             # escaped every user frame (validation forbids catching it);
             # convert to a plain Exception for the ErrorPolicy machinery
             raise SandboxRuntimeError(str(e)) from None
+        except SandboxDeadlineExceeded as e:
+            _kill(e.layer, time.monotonic() - t0, str(e))  # pandalint: disable=PRF1501 -- the delta is the incident's elapsed_s journal payload (governor TREND entry), not a stage latency; launch timing is the engine's _stat_stage job
         finally:
             sys.settrace(old)
+        elapsed = time.monotonic() - t0
+        if elapsed > EXEC_WALL_DEADLINE_S:
+            # layer 3: a residual single call (large allocation, big join)
+            # slipped past tracer and guards — the record still fails
+            _kill(
+                "post_hoc", elapsed,
+                f"watchdog: record took {elapsed:.3f}s "
+                f"(> {EXEC_WALL_DEADLINE_S}s deadline)",
+            )
         if out is None:
             return None
         if isinstance(out, str):

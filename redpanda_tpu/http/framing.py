@@ -21,7 +21,12 @@ class FramingError(Exception):
 
 class Headers(dict):
     """Case-insensitive header mapping (stored lower-cased; callers may
-    look up 'Authorization' or 'authorization' interchangeably)."""
+    look up 'Authorization' or 'authorization' interchangeably).
+
+    The MUTATORS normalize too: a mixed-case write must land on the same
+    key the readers resolve, or `h['Content-Length'] = n` next to a parsed
+    'content-length' creates an unreachable duplicate that serializes as
+    two conflicting wire headers."""
 
     def __getitem__(self, key: str) -> str:
         return super().__getitem__(key.lower())
@@ -31,6 +36,30 @@ class Headers(dict):
 
     def __contains__(self, key) -> bool:
         return super().__contains__(str(key).lower())
+
+    def __setitem__(self, key: str, value) -> None:
+        super().__setitem__(key.lower(), value)
+
+    def __delitem__(self, key: str) -> None:
+        super().__delitem__(key.lower())
+
+    def setdefault(self, key: str, default=None):
+        return super().setdefault(key.lower(), default)
+
+    _POP_MISSING = object()
+
+    def pop(self, key: str, default=_POP_MISSING):
+        if default is self._POP_MISSING:
+            return super().pop(key.lower())
+        return super().pop(key.lower(), default)
+
+    def update(self, other=(), **kw):
+        # route every entry through __setitem__ (dict.update bypasses it)
+        items = other.items() if hasattr(other, "items") else other
+        for k, v in items:
+            self[k] = v
+        for k, v in kw.items():
+            self[k] = v
 
 
 async def read_header_block(

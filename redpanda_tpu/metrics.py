@@ -115,8 +115,15 @@ class MetricsRegistry:
     def histograms(self) -> dict[str, Histogram]:
         """Live histogram series keyed like snapshot() (series_key form).
         The SLO engine quantile-interpolates straight off these buckets;
-        callers must treat the Histogram objects as read-only."""
-        return {series_key(h.name, h.labels): h for h in self._hists.values()}
+        callers must treat the Histogram objects as read-only. The dict
+        is materialized with one GIL-atomic ``list()`` first: scrapers
+        (SLO engine, history recorder) run off-thread from registration,
+        and a plain ``.values()`` walk races a concurrent first-label
+        registration with "dict changed size during iteration"."""
+        return {
+            series_key(h.name, h.labels): h
+            for h in list(self._hists.values())
+        }
 
     # ------------------------------------------------------------ exposition
     def render_prometheus(self) -> str:
@@ -129,17 +136,19 @@ class MetricsRegistry:
                 lines.append(f"# TYPE {PREFIX}_{name} {typ}")
                 seen_help.add(name)
 
-        for c in self._counters.values():
+        # GIL-atomic materializations: the scrape runs on the admin loop
+        # while worker threads lazily register new labeled series
+        for c in list(self._counters.values()):
             _head(c.name, c.help, "counter")
             lines.append(f"{PREFIX}_{c.name}{_labelstr(c.labels)} {c.value}")
-        for g in self._gauges.values():
+        for g in list(self._gauges.values()):
             _head(g.name, g.help, "gauge")
             try:
                 v = g.fn()
             except Exception:
                 v = float("nan")
             lines.append(f"{PREFIX}_{g.name}{_labelstr(g.labels)} {v}")
-        for h in self._hists.values():
+        for h in list(self._hists.values()):
             _head(h.name, h.help, "histogram")
             for upper, cum in h.hist.cumulative_buckets():
                 le = 'le="%s"' % upper
@@ -160,15 +169,17 @@ class MetricsRegistry:
         prefix) — the before/after anchor tools/microbench.py emits so a
         bench run can be diffed against the counters it moved."""
         out: dict[str, object] = {}
-        for c in self._counters.values():
+        # same list() materialization as render_prometheus: snapshot is
+        # called from the history recorder thread under live registration
+        for c in list(self._counters.values()):
             out[f"{c.name}{_labelstr(c.labels)}"] = c.value
-        for g in self._gauges.values():
+        for g in list(self._gauges.values()):
             try:
                 v = g.fn()
             except Exception:
                 v = None
             out[f"{g.name}{_labelstr(g.labels)}"] = v
-        for h in self._hists.values():
+        for h in list(self._hists.values()):
             out[f"{h.name}{_labelstr(h.labels)}"] = {
                 "count": h.hist.count,
                 "sum": h.hist.sum,

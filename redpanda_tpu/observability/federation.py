@@ -633,6 +633,13 @@ async def assemble_cluster_timeline(
             elif ph == "i":
                 key = ("i", (ev.get("args") or {}).get("seq"),
                        ev.get("name"), ev.get("ts"))
+            elif ph == "C":
+                # counter samples have no span/seq identity: pid + track
+                # name + sample time IS the identity (in-process stacks
+                # share one history ring, so every node's fetch returns
+                # the same samples — same dedup rationale as span ids;
+                # real multi-process nodes differ by pid and all survive)
+                key = ("C", ev.get("pid"), ev.get("name"), ev.get("ts"))
             else:
                 sid = (ev.get("args") or {}).get("span_id")
                 key = (
@@ -657,6 +664,48 @@ async def assemble_cluster_timeline(
         "unreachable": unreachable,
         "partial": bool(unreachable),
         "launches": n_launches,
+    }
+
+
+# ================================================================ history
+async def assemble_cluster_history(
+    targets: list[tuple],
+    series: str | None = None,
+    limit: int = 0,
+    timeout_s: float = SCRAPE_TIMEOUT_S,
+    headers: dict[str, str] | None = None,
+) -> dict:
+    """The cluster trend view: fan ``GET /v1/history`` out to every
+    node's admin and return the per-node window rings side by side —
+    windows are NOT merged across nodes (each ring rides its own wall
+    clock and cadence; a cluster question is "which node's trend broke",
+    not a cluster-average that hides the culprit). Per-node EWMA state
+    and breach counts ride along; unreachable nodes are reported, never
+    fatal — the `rpk debug trend --federated` posture."""
+    path = "/v1/history"
+    q = []
+    if series:
+        from urllib.parse import quote
+
+        q.append(f"series={quote(series)}")
+    if limit:
+        q.append(f"limit={int(limit)}")
+    if q:
+        path = f"{path}?{'&'.join(q)}"
+    docs, unreachable = await _fan_out_json(targets, path, timeout_s, headers)
+    nodes = {}
+    breaches_total = 0
+    for node, body in sorted(docs):
+        nodes[str(node)] = body
+        breaches_total += int(body.get("breaches_total") or 0)
+    return {
+        "federated": True,
+        "nodes": nodes,
+        "node_ids": sorted(n for n, _d in docs),
+        "unreachable": unreachable,
+        "partial": bool(unreachable),
+        "breaches_total": breaches_total,
+        **({"series_filter": series} if series else {}),
     }
 
 
@@ -720,6 +769,7 @@ async def assemble_cluster_resources(
 
 __all__ = [
     "FederatedSlo",
+    "assemble_cluster_history",
     "assemble_cluster_resources",
     "assemble_cluster_timeline",
     "assemble_cluster_trace",

@@ -1,0 +1,485 @@
+"""pandatrend: the always-on, bounded metrics-history ring.
+
+ROADMAP 7c/7d's missing substrate: every signal the repo already emits
+(registry counters/gauges/histograms, budget-plane occupancy, governor
+knobs, colcache hits) is point-in-time — a scrape says where the broker
+IS, never where it has BEEN. This module keeps a short, byte-bounded ring
+of time-bucketed DELTA windows over the whole registry so that:
+
+- ``GET /v1/history`` / ``rpk debug trend`` answer "what changed in the
+  last N minutes" without an external prometheus;
+- ``Pulse.timeline()`` renders the windows as Perfetto counter tracks
+  (``ph:"C"``) on the SAME clock as launch slices (ROADMAP 7c);
+- EWMA-band breaches (tail latency, shed rate, occupancy, colcache hit
+  rate) journal into the governor's ``trend`` domain — a regression is an
+  incident entry with measured inputs, not folklore.
+
+Sampling discipline mirrors the pulse ring: ``history_interval_s=0``
+means OFF and spawns NO thread (pinned by the ``history_overhead``
+microbench); the recorder thread holds no lock while snapshotting (the
+registry's snapshot paths are GIL-atomic materializations, PR-6 round 4
+discipline), and the ring is bounded BOTH by window count and by an
+estimated byte budget — a label-cardinality explosion evicts history, it
+never grows the process.
+
+Derivations reuse the SLO engine's machinery verbatim: histogram windows
+are ``slo._hist_window`` snapshots diffed with ``slo.window_delta`` and
+quantile-interpolated with ``slo.interpolate_quantile(hdr_layout=True)``
+— one bucket-math implementation across SLO verdicts, federation merges
+and trend windows.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+from redpanda_tpu.metrics import _labelstr
+from redpanda_tpu.metrics import registry as default_registry
+
+DEFAULT_INTERVAL_S = 5.0
+DEFAULT_WINDOWS = 240            # 20 min at the 5s default cadence
+DEFAULT_MAX_BYTES = 4 * 1024 * 1024
+
+# EWMA band parameters (trend breach detection). Warmup gates the band:
+# the first few windows of a fresh process are all "anomalous" relative
+# to nothing; a band needs history before it may accuse.
+EWMA_ALPHA = 0.3
+EWMA_BAND_SIGMA = 3.0
+EWMA_WARMUP_WINDOWS = 8
+
+_SHED_SUFFIX = "_admission_shed_total"
+
+
+def _estimate_bytes(win: dict) -> int:
+    """Cheap, stable size estimate for the byte budget: key lengths plus
+    a flat per-entry cost. json.dumps-per-window would dominate the very
+    overhead this recorder is gated on."""
+    n = 64
+    for section in ("counters", "gauges", "hists", "tracks"):
+        for k, v in win.get(section, {}).items():
+            n += len(k) + 16
+            if isinstance(v, dict):
+                n += 16 * len(v)
+    return n
+
+
+class HistoryRecorder:
+    """Bounded ring of per-interval registry delta windows.
+
+    One instance per process (``history`` below), configured from broker
+    config at app start. Tests and the microbench drive private
+    instances; ``sample_once()`` is the whole hot path."""
+
+    def __init__(self, registry=None) -> None:
+        self.registry = registry if registry is not None else default_registry
+        self._lock = threading.Lock()
+        self._ring: list[dict] = []
+        self._ring_bytes = 0
+        self._interval_s = 0.0
+        self._max_windows = DEFAULT_WINDOWS
+        self._max_bytes = DEFAULT_MAX_BYTES
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+        self._stop = False
+        # previous cumulative snapshots (recorder-thread-private in the
+        # steady state; guarded by _lock for reset()/sample_once races)
+        self._prev_counters: dict[str, float] | None = None
+        self._prev_hists: dict[str, dict] | None = None
+        self._prev_ts: float | None = None
+        # EWMA state per watched series: {name: (mean, var, n, breached)}
+        self._ewma: dict[str, list] = {}
+        self._samples_total = 0
+        self._breaches_total = 0
+        self._evicted_total = 0
+
+    # ------------------------------------------------------------ config
+    @property
+    def interval_s(self) -> float:
+        return self._interval_s
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    @property
+    def breaches_total(self) -> int:
+        return self._breaches_total
+
+    @property
+    def samples_total(self) -> int:
+        return self._samples_total
+
+    def configure(
+        self,
+        *,
+        interval_s: float | None = None,
+        windows: int | None = None,
+        max_bytes: int | None = None,
+    ) -> None:
+        """Apply knobs; start/stop the recorder thread to match.
+        ``interval_s=0`` is the documented OFF posture: no thread exists
+        afterwards (not a parked one — NONE, the pulse profiler_hz=0
+        contract)."""
+        if windows is not None:
+            self._max_windows = max(1, int(windows))
+        if max_bytes is not None:
+            self._max_bytes = max(1024, int(max_bytes))
+        if interval_s is not None:
+            self._interval_s = max(0.0, float(interval_s))
+        with self._lock:
+            self._trim_locked()
+        want_thread = self._interval_s > 0
+        if want_thread and not self.running:
+            self._stop = False
+            self._wake.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="rptpu-history-recorder", daemon=True
+            )
+            self._thread.start()
+        elif not want_thread and self.running:
+            self.stop()
+
+    def stop(self) -> None:
+        t = self._thread
+        if t is None:
+            return
+        self._stop = True
+        self._wake.set()
+        t.join(timeout=5.0)
+        self._thread = None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._ring_bytes = 0
+            self._prev_counters = None
+            self._prev_hists = None
+            self._prev_ts = None
+            self._ewma.clear()
+
+    # ------------------------------------------------------------ sampling
+    def _loop(self) -> None:
+        while not self._stop:
+            self._wake.wait(timeout=self._interval_s or DEFAULT_INTERVAL_S)
+            if self._stop:
+                return
+            try:
+                self.sample_once()
+            except Exception:
+                # the recorder must outlive any single bad scrape; a
+                # throwing gauge fn or a mid-registration race costs one
+                # window, never the thread
+                pass
+
+    def _cumulative(self) -> tuple[dict, dict, dict]:
+        """(counters, gauges, hist_windows) cumulative snapshot.
+
+        GIL-atomic discipline (PR-6 round 4): materialize the registry
+        dicts with one C-level ``list()`` call each, then iterate the
+        private lists — the live dicts keep growing under load and a
+        plain ``.values()`` walk races registration with
+        "dict changed size during iteration"."""
+        from redpanda_tpu.observability.slo import _hist_window
+
+        reg = self.registry
+        counters: dict[str, float] = {}
+        for c in list(reg._counters.values()):
+            counters[f"{c.name}{_labelstr(c.labels)}"] = float(c.value)
+        gauges: dict[str, float] = {}
+        for g in list(reg._gauges.values()):
+            try:
+                v = g.fn()
+            except Exception:
+                # gauge fns are caller-supplied closures; render_prometheus
+                # makes the same trade (NaN, not a dead scrape)
+                v = None
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                gauges[f"{g.name}{_labelstr(g.labels)}"] = float(v)
+        hists: dict[str, dict] = {}
+        for h in list(reg._hists.values()):
+            hists[f"{h.name}{_labelstr(h.labels)}"] = _hist_window(h)
+        return counters, gauges, hists
+
+    def sample_once(self) -> dict | None:
+        """Take one delta window NOW and append it to the ring. Returns
+        the stored window (None for the very first call, which only
+        anchors the cumulative baseline)."""
+        from redpanda_tpu.observability.slo import (
+            interpolate_quantile, window_delta,
+        )
+
+        now = time.time()
+        counters, gauges, hists = self._cumulative()
+        with self._lock:
+            prev_c, prev_h, prev_ts = (
+                self._prev_counters, self._prev_hists, self._prev_ts,
+            )
+            self._prev_counters, self._prev_hists = counters, hists
+            self._prev_ts = now
+            self._samples_total += 1
+        if prev_ts is None:
+            return None
+        dt = max(now - prev_ts, 1e-9)
+        win: dict = {"ts": now, "dur_s": round(dt, 3)}
+        wc: dict[str, dict] = {}
+        for key, val in counters.items():
+            delta = val - (prev_c or {}).get(key, 0.0)
+            if delta:
+                wc[key] = {"delta": delta, "rate": round(delta / dt, 3)}
+        wh: dict[str, dict] = {}
+        for key, after in hists.items():
+            before = (prev_h or {}).get(key)
+            d = window_delta(after, before)
+            if d["count"] <= 0:
+                continue
+            row = {"count": d["count"], "rate": round(d["count"] / dt, 3)}
+            for q, label in ((50.0, "p50"), (99.0, "p99"), (99.9, "p999")):
+                v = interpolate_quantile(
+                    d["buckets"], d["count"], q,
+                    observed_max=d["max"], hdr_layout=True,
+                )
+                if v is not None:
+                    row[label] = round(v, 1)
+            row["max"] = d["max"]
+            wh[key] = row
+        win["counters"] = wc
+        win["gauges"] = gauges
+        win["hists"] = wh
+        win["tracks"] = self._derive_tracks(wc, gauges, wh, dt)
+        win["bytes"] = _estimate_bytes(win)
+        with self._lock:
+            self._ring.append(win)
+            self._ring_bytes += win["bytes"]
+            self._trim_locked()
+        self._judge_window(win)
+        return win
+
+    def _trim_locked(self) -> None:
+        evicted = 0
+        while self._ring and (
+            len(self._ring) > self._max_windows
+            or self._ring_bytes > self._max_bytes
+        ):
+            old = self._ring.pop(0)
+            self._ring_bytes -= old.get("bytes", 0)
+            evicted += 1
+        if not self._ring:
+            self._ring_bytes = 0
+        self._evicted_total += evicted
+
+    # ------------------------------------------------------------ derived tracks
+    def _derive_tracks(
+        self, wc: dict, gauges: dict, wh: dict, dt: float
+    ) -> dict[str, float]:
+        """The named trend series: what the EWMA judge watches and what
+        the timeline renders as counter tracks. Derived from whole-window
+        deltas, so one slow scrape can't alias a rate."""
+        tracks: dict[str, float] = {}
+        # per-account occupancy off the budget-plane held/limit gauges
+        for key, held in gauges.items():
+            if not key.startswith("resource_account_held_bytes{"):
+                continue
+            acct = key.split('account="', 1)[-1].split('"', 1)[0]
+            limit = gauges.get(
+                f'resource_account_limit_bytes{{account="{acct}"}}', 0.0
+            )
+            if limit and limit > 0:
+                tracks[f"occupancy:{acct}"] = round(held / limit, 4)
+        if "resource_pressure_state" in gauges:
+            tracks["pressure"] = gauges["resource_pressure_state"]
+        # shed rate per subsystem + aggregate
+        shed_total = 0.0
+        for key, row in wc.items():
+            name = key.split("{", 1)[0]
+            if name.endswith(_SHED_SUFFIX):
+                sub = name[: -len(_SHED_SUFFIX)]
+                tracks[f"shed_rate:{sub}"] = row["rate"]
+                shed_total += row["rate"]
+        tracks["shed_rate"] = round(shed_total, 3)
+        # colcache hit rate over THIS window's delta, not the lifetime
+        hits = wc.get('coproc_colcache_total{outcome="hit"}', {}).get("delta", 0.0)
+        miss = wc.get('coproc_colcache_total{outcome="miss"}', {}).get("delta", 0.0)
+        if hits + miss > 0:
+            tracks["colcache_hit_rate"] = round(hits / (hits + miss), 4)
+            tracks["colcache_hits_per_s"] = round(hits / dt, 3)
+        # governor launch knobs + the rpc inflight gate (live gauges)
+        for key, val in gauges.items():
+            if key.startswith("coproc_autotune_knob{"):
+                knob = key.split('knob="', 1)[-1].split('"', 1)[0]
+                tracks[f"knob:{knob}"] = val
+            elif key.startswith("rpc_inflight_requests"):
+                tracks["inflight:rpc"] = val
+        # tail latency per histogram family (EWMA watch input)
+        for key, row in wh.items():
+            if "p999" in row:
+                name = key.split("{", 1)[0]
+                prev = tracks.get(f"p999_us:{name}")
+                v = float(row["p999"])
+                tracks[f"p999_us:{name}"] = max(prev, v) if prev else v
+        return tracks
+
+    # ------------------------------------------------------------ EWMA judge
+    # direction per watched-series prefix: +1 = breach when ABOVE band
+    # (latency, sheds, occupancy, pressure), -1 = breach when BELOW
+    # (hit rates — a cold cache is the regression)
+    _WATCH_DIRECTION = (
+        ("p999_us:", +1), ("shed_rate", +1), ("occupancy:", +1),
+        ("pressure", +1), ("colcache_hit_rate", -1),
+    )
+
+    def _judge_window(self, win: dict) -> None:
+        """EWMA band check over the derived tracks; breaches journal into
+        the governor's TREND domain once per excursion (episode posture —
+        re-arms when the series returns inside the band)."""
+        for name, value in win["tracks"].items():
+            direction = 0
+            for prefix, d in self._WATCH_DIRECTION:
+                if name.startswith(prefix):
+                    direction = d
+                    break
+            if direction == 0:
+                continue
+            with self._lock:
+                st = self._ewma.get(name)
+                if st is None:
+                    st = self._ewma[name] = [float(value), 0.0, 1, False]
+                    continue
+                mean, var, n, breached = st
+                band = EWMA_BAND_SIGMA * math.sqrt(max(var, 0.0))
+                dev = (value - mean) * direction
+                is_breach = (
+                    n >= EWMA_WARMUP_WINDOWS
+                    and dev > band
+                    and dev > abs(mean) * 0.05 + 1e-9
+                )
+                fire = is_breach and not breached
+                # breach windows do NOT update the band: an excursion must
+                # not teach the band that the excursion is normal
+                if not is_breach:
+                    delta = value - mean
+                    st[0] = mean + EWMA_ALPHA * delta
+                    st[1] = (1 - EWMA_ALPHA) * (var + EWMA_ALPHA * delta * delta)
+                st[2] = n + 1
+                st[3] = is_breach
+                if fire:
+                    self._breaches_total += 1
+            if fire:
+                self._journal_breach(name, value, mean, band, win)
+
+    def _journal_breach(
+        self, name: str, value: float, mean: float, band: float, win: dict
+    ) -> None:
+        # lazy: observability must stay importable without coproc
+        from redpanda_tpu.coproc.governor import TREND, journal_record
+
+        journal_record(
+            TREND, "breach",
+            f"{name} left its EWMA band: {value:.4g} vs mean "
+            f"{mean:.4g} ± {band:.4g} ({EWMA_BAND_SIGMA}σ)",
+            inputs={
+                "series": name, "value": value,
+                "ewma_mean": round(mean, 4), "band": round(band, 4),
+                "window_ts": win["ts"], "window_dur_s": win["dur_s"],
+            },
+            config={
+                "interval_s": self._interval_s,
+                "alpha": EWMA_ALPHA, "sigma": EWMA_BAND_SIGMA,
+            },
+        )
+
+    # ------------------------------------------------------------ views
+    def windows(self, limit: int = 0) -> list[dict]:
+        """Newest-last windows (chronological — the timeline order)."""
+        with self._lock:
+            items = list(self._ring)
+        return items[-limit:] if limit else items
+
+    def snapshot(self, series: str | None = None, limit: int = 0) -> dict:
+        """The ``GET /v1/history`` body. ``series`` substring-filters
+        every per-series section (counters/gauges/hists/tracks) so a
+        narrow question doesn't ship the whole registry history."""
+        wins = self.windows(limit)
+        if series:
+            needle = series
+            filtered = []
+            for w in wins:
+                fw = {"ts": w["ts"], "dur_s": w["dur_s"]}
+                for section in ("counters", "gauges", "hists", "tracks"):
+                    fw[section] = {
+                        k: v for k, v in w.get(section, {}).items()
+                        if needle in k
+                    }
+                filtered.append(fw)
+            wins = filtered
+        with self._lock:
+            meta = {
+                "interval_s": self._interval_s,
+                "recorder_running": self.running,
+                "windows_retained": len(self._ring),
+                "windows_max": self._max_windows,
+                "bytes": self._ring_bytes,
+                "bytes_max": self._max_bytes,
+                "samples_total": self._samples_total,
+                "breaches_total": self._breaches_total,
+                "evicted_total": self._evicted_total,
+                "ewma": {
+                    name: {
+                        "mean": round(st[0], 4),
+                        "band": round(
+                            EWMA_BAND_SIGMA * math.sqrt(max(st[1], 0.0)), 4
+                        ),
+                        "n": st[2],
+                        "breached": st[3],
+                    }
+                    for name, st in sorted(self._ewma.items())
+                },
+            }
+        meta["windows"] = wins
+        if series:
+            meta["series_filter"] = series
+        return meta
+
+    def counter_tracks(
+        self,
+        pid: int,
+        tid: int = 0,
+        t_min_us: float | None = None,
+        t_max_us: float | None = None,
+        margin_us: float = 2e6,
+    ) -> list[dict]:
+        """Perfetto ``ph:"C"`` counter events for every derived track,
+        re-anchored on the span clock (wall ts minus the tracer's wall
+        epoch — the exact journal-instant math in ``Pulse.timeline``).
+        With a launch window in view only in-window samples (± margin)
+        emit; without one the whole ring renders (ROADMAP 7c: an idle
+        broker's timeline still shows its recent trend)."""
+        from redpanda_tpu.observability.trace import tracer
+
+        events: list[dict] = []
+        for w in self.windows():
+            ts_us = (w["ts"] - tracer.epoch_wall) * 1e6
+            if t_min_us is not None and not (
+                t_min_us - margin_us <= ts_us <= (t_max_us or ts_us) + margin_us
+            ):
+                continue
+            for name, value in sorted(w.get("tracks", {}).items()):
+                events.append({
+                    "name": f"trend:{name}",
+                    "ph": "C",
+                    "ts": max(ts_us, 0.0),
+                    "pid": pid,
+                    "tid": tid,
+                    "cat": "trend",
+                    "args": {"value": value},
+                })
+        return events
+
+
+# Process-wide instance, like tracer/registry/slo/pulse: subsystems import
+# this; app startup configures it from broker config.
+history = HistoryRecorder()
+
+__all__ = ["HistoryRecorder", "history"]

@@ -532,6 +532,22 @@ class Pulse:
             }
             events.append(ev)
             injected += 1
+        # pandatrend counter tracks (ROADMAP 7c): the metrics-history
+        # ring's derived series as ph:"C" events on the SAME span clock —
+        # occupancy, pressure, shed rate, launch knobs, colcache, inflight
+        # gate render as counter lanes under the launch slices. Window
+        # filtering matches the journal instants: with launches in view
+        # only in-window samples (± margin) emit; an idle broker's
+        # timeline still shows its whole retained trend.
+        from redpanda_tpu.observability.history import history
+
+        counter_events = history.counter_tracks(
+            pid=pid_default,
+            t_min_us=t_min,
+            t_max_us=t_max,
+            margin_us=margin_us,
+        )
+        events.extend(counter_events)
         return {
             "displayTimeUnit": "ms",
             "traceEvents": events,
@@ -539,6 +555,7 @@ class Pulse:
             "epoch": tracer.epoch_wall,
             "launches": len(groups),
             "journal_events": injected,
+            "counter_events": len(counter_events),
         }
 
 

@@ -23,6 +23,7 @@ open-loop flood converges to the knee instead of retry-storming it.
 from __future__ import annotations
 
 import threading
+import weakref
 
 from redpanda_tpu.metrics import Counter, registry
 from redpanda_tpu.resource_mgmt.budgets import MemoryAccount
@@ -196,6 +197,17 @@ class InflightGate:
         self._on_episode = on_episode
         self._episode_open = False
         self._subsystem = subsystem
+        # live inflight depth as a gauge (weakref posture, like the
+        # budget-plane account gauges): the pandatrend history ring
+        # samples it into the `inflight:rpc` counter track
+        ref = weakref.ref(self)
+        registry.gauge(
+            "rpc_inflight_requests",
+            lambda: float(g._inflight) if (g := ref()) is not None else -1.0,
+            "Requests currently inside the rpc dispatch inflight gate "
+            "(-1 when the gate has been collected)",
+            subsystem=subsystem,
+        )
 
     def _shed(self, why: str) -> None:
         with self._lock:

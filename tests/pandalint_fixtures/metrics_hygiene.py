@@ -1,0 +1,56 @@
+"""MET17xx fixture: ad-hoc registry series lookups vs the bind-once idiom."""
+from redpanda_tpu.metrics import registry
+from redpanda_tpu.observability import probes
+
+# module-level bind-once: the sanctioned idiom, NOT a finding
+produce_hist = registry.histogram("kafka_produce_latency_us")
+shed_total = registry.counter("kafka_produce_admission_shed_total")
+
+
+def hot_lookup_histogram(v):
+    registry.histogram("kafka_produce_latency_us").record(v)
+
+
+def hot_lookup_counter(n):
+    registry.counter("rpc_requests_total").inc(n)
+
+
+def dotted_receiver(metrics, v):
+    metrics.registry.histogram("storage_append_latency_us").record(v)
+
+
+def keyword_name(v):
+    registry.histogram(name="raft_replicate_latency_us").record(v)
+
+
+def constructed_fstring(subsystem, n):
+    registry.counter(f"{subsystem}_admission_shed_total").inc(n)
+
+
+def constructed_concat(stage, v):
+    registry.histogram("coproc_" + stage + "_latency_us").record(v)
+
+
+# constructed names are a finding even at module level — no binding can
+# single-source a spelling that does not exist until runtime
+_PREFIX = "coproc"
+module_level_constructed = registry.counter(_PREFIX + "_launches_total")
+
+
+def variable_name_ok(v):
+    # the literal lives in the binding's owner; a variable lookup is fine
+    registry.histogram(probes.PRODUCE_SERIES).record(v)
+
+
+def bound_import_ok(v):
+    # using the imported binding is the contract
+    produce_hist.record(v)
+
+
+def not_the_registry(cache, v):
+    # .histogram on a non-registry receiver is out of scope
+    cache.histogram("whatever").record(v)
+
+
+def suppressed_memoized(n):
+    registry.counter("coproc_governor_decisions_total").inc(n)  # pandalint: disable=MET1701 -- fixture: memoized check-then-create, lookup runs once per key

@@ -203,3 +203,66 @@ def test_deploy_validation(tmp_path):
         await _stop(storage, server, api)
 
     run(main())
+
+
+def test_ingest_poison_skipped_transient_retried(tmp_path):
+    """Dispatch isolation contract (_ingest_once): a POISON event
+    (SandboxViolation/ValueError — the script itself is bad) is skipped
+    and the cursor advances past it; any OTHER exception is a TRANSIENT
+    infrastructure failure that re-raises WITHOUT advancing, so the chunk
+    retries on the next poll instead of silently diverging script state."""
+
+    async def main():
+        storage, broker, server, api = await _start(tmp_path)
+        await broker.create_topic(TopicConfig("src", 1))
+        await wait_until(
+            lambda: api._listen_offset > 0 or broker.get_partition(
+                wasm_event.COPROC_INTERNAL_TOPIC
+                if hasattr(wasm_event, "COPROC_INTERNAL_TOPIC") else
+                "coprocessor_internal_topic", 0) is not None,
+            msg="listener up",
+        )
+
+        real_enable = api._enable
+        calls = {"n": 0}
+
+        async def flaky_enable(ev):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("partition moving")  # transient
+            await real_enable(ev)
+
+        api._enable = flaky_enable
+        await api.deploy("t1", identity().to_json(), ["src"])
+
+        # the transient raise is classified by _listen_loop (survives to
+        # retry) and the cursor did NOT advance: the SAME event re-runs
+        # and succeeds on the second poll
+        await wait_until(lambda: api.active_scripts() == ["t1"], msg="retried")
+        assert calls["n"] >= 2
+        cursor_after_t1 = api._listen_offset
+
+        async def poison_enable(ev):
+            calls["n"] += 1
+            raise ValueError("malformed event body")
+
+        api._enable = poison_enable
+        await api.deploy("t2", identity().to_json(), ["src"])
+        # poison: skipped, cursor advances, listener keeps ingesting
+        await wait_until(
+            lambda: api._listen_offset > cursor_after_t1, msg="cursor advanced"
+        )
+        assert api.active_scripts() == ["t1"]  # t2 never registered
+        n_after_poison = calls["n"]
+        await asyncio.sleep(0.1)
+        assert calls["n"] == n_after_poison  # not retried forever
+
+        # the loop is still healthy: a later good deploy lands
+        api._enable = real_enable
+        await api.deploy("t3", identity().to_json(), ["src"])
+        await wait_until(
+            lambda: sorted(api.active_scripts()) == ["t1", "t3"], msg="recovered"
+        )
+        await _stop(storage, server, api)
+
+    run(main())

@@ -1,0 +1,344 @@
+"""pandatrend history ring (observability/history.py).
+
+The contracts these tests pin are the ones the ISSUE names as
+load-bearing: interval=0 spawns NO recorder thread (not a parked one),
+the ring is bounded by BOTH window count and byte budget (cardinality
+explosions evict history, never grow the process), snapshotting survives
+concurrent registration/reset without "dict changed size", derived
+tracks render as Perfetto ``ph:"C"`` counter events on the span clock,
+and EWMA-band breaches journal exactly one governor TREND entry per
+excursion episode.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from redpanda_tpu.metrics import MetricsRegistry
+from redpanda_tpu.observability.history import (
+    EWMA_WARMUP_WINDOWS,
+    HistoryRecorder,
+    history,
+)
+
+RECORDER_THREAD = "rptpu-history-recorder"
+
+
+def _recorder_threads():
+    return [t for t in threading.enumerate() if t.name == RECORDER_THREAD]
+
+
+# -------------------------------------------------------------- lifecycle
+def test_interval_zero_means_no_thread():
+    rec = HistoryRecorder(MetricsRegistry())
+    baseline = len(_recorder_threads())
+    rec.configure(interval_s=0)
+    assert not rec.running
+    assert len(_recorder_threads()) == baseline  # NONE, not parked
+
+    rec.configure(interval_s=0.02)
+    assert rec.running
+    assert len(_recorder_threads()) == baseline + 1
+
+    # reconfiguring back to 0 tears the thread down again
+    rec.configure(interval_s=0)
+    assert not rec.running
+    assert len(_recorder_threads()) == baseline
+
+
+def test_configure_is_idempotent_one_thread():
+    rec = HistoryRecorder(MetricsRegistry())
+    baseline = len(_recorder_threads())
+    try:
+        rec.configure(interval_s=0.02)
+        rec.configure(interval_s=0.02)
+        rec.configure(interval_s=0.05)
+        assert len(_recorder_threads()) == baseline + 1
+    finally:
+        rec.stop()
+    assert len(_recorder_threads()) == baseline
+
+
+# -------------------------------------------------------------- sampling
+def test_first_sample_anchors_then_windows_are_deltas():
+    reg = MetricsRegistry()
+    c = reg.counter("trend_test_ops_total")
+    h = reg.histogram("trend_test_latency_us")
+    reg.gauge("trend_test_depth", lambda: 7.0)
+    rec = HistoryRecorder(reg)
+
+    assert rec.sample_once() is None  # baseline anchor only
+    c.inc(10)
+    for v in (100, 200, 300, 400):
+        h.record(v)
+    win = rec.sample_once()
+    assert win is not None
+    assert win["counters"]["trend_test_ops_total"]["delta"] == 10
+    assert win["counters"]["trend_test_ops_total"]["rate"] > 0
+    assert win["gauges"]["trend_test_depth"] == 7.0
+    row = win["hists"]["trend_test_latency_us"]
+    assert row["count"] == 4
+    assert 100 <= row["p50"] <= 300
+    assert row["max"] >= 400
+
+    # an idle window carries no counter/hist rows (delta shipping)
+    win2 = rec.sample_once()
+    assert win2["counters"] == {}
+    assert win2["hists"] == {}
+
+
+def test_throwing_gauge_costs_the_value_not_the_window():
+    reg = MetricsRegistry()
+    reg.gauge("trend_bad", lambda: 1 / 0)
+    reg.gauge("trend_good", lambda: 3.0)
+    rec = HistoryRecorder(reg)
+    rec.sample_once()
+    win = rec.sample_once()
+    assert "trend_bad" not in win["gauges"]
+    assert win["gauges"]["trend_good"] == 3.0
+
+
+# -------------------------------------------------------------- bounds
+def test_window_count_bound():
+    reg = MetricsRegistry()
+    rec = HistoryRecorder(reg)
+    rec.configure(windows=3, interval_s=0)
+    for _ in range(10):
+        rec.sample_once()
+    assert len(rec.windows()) == 3
+
+
+def test_byte_budget_evicts_oldest():
+    """A label-cardinality explosion must evict history, not grow the
+    process: the ring honors max_bytes even when the window count is
+    nowhere near its cap."""
+    reg = MetricsRegistry()
+    rec = HistoryRecorder(reg)
+    rec.configure(windows=10_000, max_bytes=4096, interval_s=0)
+    for i in range(60):
+        reg.counter("trend_cardinality_total", shard=str(i)).inc(1 + i)
+        rec.sample_once()
+    snap = rec.snapshot()
+    assert snap["bytes"] <= 4096
+    assert snap["evicted_total"] > 0
+    assert snap["windows_retained"] < 60
+    # the ring keeps the NEWEST windows: the last sampled shard is present
+    last = rec.windows()[-1]
+    assert any("shard=\"59\"" in k for k in last["counters"])
+
+
+def test_reconfigure_smaller_trims_immediately():
+    reg = MetricsRegistry()
+    c = reg.counter("trend_trim_total")
+    rec = HistoryRecorder(reg)
+    rec.configure(windows=50, interval_s=0)
+    for _ in range(12):
+        c.inc()
+        rec.sample_once()
+    assert len(rec.windows()) == 11
+    rec.configure(windows=4)
+    assert len(rec.windows()) == 4
+
+
+# -------------------------------------------------------------- concurrency
+def test_snapshot_survives_concurrent_registration_and_reset():
+    """The scrape races live registration: sample_once materializes the
+    registry dicts GIL-atomically, so a registering/recording writer and
+    a reset() caller must never produce 'dict changed size' or corrupt
+    the ring accounting."""
+    reg = MetricsRegistry()
+    rec = HistoryRecorder(reg)
+    rec.configure(windows=64, interval_s=0)
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            reg.counter("trend_churn_total", k=str(i % 97)).inc()
+            reg.gauge("trend_churn_depth", lambda: 1.0, k=str(i % 53))
+            reg.histogram("trend_churn_us", k=str(i % 31)).record(i % 1000)
+
+    def resetter():
+        while not stop.is_set():
+            rec.reset()
+
+    def guard(t):
+        def run():
+            try:
+                t()
+            except BaseException as e:  # noqa: BLE001 - the assertion payload
+                errors.append(e)
+        return run
+
+    threads = [
+        threading.Thread(target=guard(churn)),
+        threading.Thread(target=guard(churn)),
+        threading.Thread(target=guard(resetter)),
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(300):
+            rec.sample_once()
+            rec.snapshot(limit=5)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert errors == []
+    # accounting stayed coherent after all the resets
+    snap = rec.snapshot()
+    assert snap["windows_retained"] == len(rec.windows())
+    assert snap["bytes"] >= 0
+
+
+def test_recorder_thread_samples_against_live_registry():
+    reg = MetricsRegistry()
+    c = reg.counter("trend_live_total")
+    rec = HistoryRecorder(reg)
+    rec.configure(interval_s=0.01, windows=100)
+    try:
+        done = threading.Event()
+
+        def produce():
+            for _ in range(200):
+                c.inc()
+                done.wait(0.001)
+
+        t = threading.Thread(target=produce)
+        t.start()
+        t.join()
+        deadline = threading.Event()
+        for _ in range(200):
+            if rec.samples_total >= 3 and rec.windows():
+                break
+            deadline.wait(0.01)
+        assert rec.samples_total >= 3
+    finally:
+        rec.stop()
+    total = sum(
+        w["counters"].get("trend_live_total", {}).get("delta", 0)
+        for w in rec.windows()
+    )
+    assert 0 < total <= 200
+
+
+# -------------------------------------------------------------- views
+def test_snapshot_series_filter():
+    reg = MetricsRegistry()
+    reg.counter("trend_alpha_total").inc()
+    reg.counter("trend_beta_total").inc()
+    rec = HistoryRecorder(reg)
+    rec.sample_once()
+    reg.counter("trend_alpha_total").inc(5)
+    reg.counter("trend_beta_total").inc(5)
+    rec.sample_once()
+    snap = rec.snapshot(series="alpha")
+    assert snap["series_filter"] == "alpha"
+    for w in snap["windows"]:
+        assert all("alpha" in k for k in w["counters"])
+        assert not any("beta" in k for k in w["counters"])
+
+
+def test_derived_tracks_and_counter_track_events():
+    reg = MetricsRegistry()
+    held = {"v": 512.0}
+    reg.gauge("resource_account_held_bytes", lambda: held["v"], account="produce")
+    reg.gauge("resource_account_limit_bytes", lambda: 1024.0, account="produce")
+    reg.gauge("resource_pressure_state", lambda: 1.0)
+    shed = reg.counter("rpc_admission_shed_total")
+    hit = reg.counter("coproc_colcache_total", outcome="hit")
+    miss = reg.counter("coproc_colcache_total", outcome="miss")
+    rec = HistoryRecorder(reg)
+    rec.sample_once()
+    shed.inc(4)
+    hit.inc(9)
+    miss.inc(1)
+    win = rec.sample_once()
+    tracks = win["tracks"]
+    assert tracks["occupancy:produce"] == 0.5
+    assert tracks["pressure"] == 1.0
+    assert tracks["shed_rate:rpc"] > 0
+    assert tracks["shed_rate"] >= tracks["shed_rate:rpc"]
+    assert tracks["colcache_hit_rate"] == 0.9
+
+    # Perfetto counter events: ph:"C", trend: prefix, span-clock anchored
+    events = rec.counter_tracks(pid=77, tid=3)
+    assert events, "idle view renders the whole ring"
+    assert {e["ph"] for e in events} == {"C"}
+    assert all(e["name"].startswith("trend:") for e in events)
+    assert all(e["pid"] == 77 and e["tid"] == 3 for e in events)
+    assert all(e["ts"] >= 0.0 for e in events)
+    names = {e["name"] for e in events}
+    assert "trend:occupancy:produce" in names
+    assert "trend:shed_rate" in names
+
+    # a launch window far in the past filters everything out
+    assert rec.counter_tracks(pid=1, t_min_us=-9e9, t_max_us=-8e9, margin_us=0) == []
+
+
+# -------------------------------------------------------------- EWMA judge
+def test_ewma_breach_journals_once_per_episode():
+    from redpanda_tpu.coproc.governor import TREND, journal, reset_journal
+
+    reset_journal()
+    reg = MetricsRegistry()
+    shed = reg.counter("rpc_admission_shed_total")
+    rec = HistoryRecorder(reg)
+    rec.sample_once()
+    # warmup: a steady shed rate teaches the band
+    for _ in range(EWMA_WARMUP_WINDOWS + 4):
+        shed.inc(2)
+        rec.sample_once()
+    assert rec.breaches_total == 0
+
+    # excursion: an order-of-magnitude spike, sustained for 3 windows —
+    # episode posture journals ONE breach PER SERIES (the per-subsystem
+    # shed_rate:rpc track and the aggregate shed_rate both watch), not
+    # one per window
+    for _ in range(3):
+        shed.inc(500)
+        rec.sample_once()
+    assert rec.breaches_total == 2
+    breaches = [
+        e for e in journal.entries(domain=TREND) if e["verdict"] == "breach"
+    ]
+    series = sorted(e["inputs"]["series"] for e in breaches)
+    assert series == ["shed_rate", "shed_rate:rpc"]
+    assert all(e["inputs"]["value"] > 0 for e in breaches)
+
+    # recovery re-arms the episodes; a second spike fires again
+    for _ in range(6):
+        shed.inc(2)
+        rec.sample_once()
+    for _ in range(2):
+        shed.inc(500)
+        rec.sample_once()
+    assert rec.breaches_total == 4
+
+
+def test_warmup_gates_the_band():
+    """A fresh process's first windows are all 'anomalous' relative to
+    nothing; the band must not accuse before EWMA_WARMUP_WINDOWS."""
+    from redpanda_tpu.coproc.governor import reset_journal
+
+    reset_journal()
+    reg = MetricsRegistry()
+    shed = reg.counter("kafka_admission_shed_total")
+    rec = HistoryRecorder(reg)
+    rec.sample_once()
+    for i in range(EWMA_WARMUP_WINDOWS - 2):
+        shed.inc(1 + 100 * (i % 2))  # wildly bimodal from the start
+        rec.sample_once()
+    assert rec.breaches_total == 0
+
+
+# -------------------------------------------------------------- singleton
+def test_process_singleton_defaults_off():
+    # the module-level instance exists but is OFF until app.configure —
+    # importing observability must never spawn a thread by itself
+    assert isinstance(history, HistoryRecorder)
+    if not history.running:
+        assert history.interval_s == 0.0

@@ -318,3 +318,43 @@ def test_tls_serving(tmp_path):
         await srv.stop()
 
     asyncio.run(go())
+
+
+def test_headers_mutators_normalize_case():
+    """Regression: dict.update/__setitem__/setdefault/pop used to bypass
+    lower-casing, so `h['Content-Length'] = n` next to a parsed
+    'content-length' created an unreachable duplicate that serialized as
+    two conflicting wire headers."""
+    from redpanda_tpu.http.framing import Headers
+
+    h = Headers()
+    h["Content-Length"] = "5"
+    assert h["content-length"] == "5"
+    assert dict(h) == {"content-length": "5"}
+
+    # overwrite through a different casing lands on the SAME key
+    h["CONTENT-LENGTH"] = "9"
+    assert len(h) == 1 and h["Content-Length"] == "9"
+
+    # update() routes through __setitem__ for mappings, pair-iterables, kw
+    h.update({"X-Request-ID": "a"})
+    h.update([("Accept-Encoding", "gzip")])
+    h.update(User_Agent="rp")
+    assert h["x-request-id"] == "a"
+    assert h["accept-encoding"] == "gzip"
+    assert h["user_agent"] == "rp"
+
+    # setdefault: first write normalizes, second read resolves it
+    assert h.setdefault("Retry-After", "1") == "1"
+    assert h.setdefault("retry-after", "2") == "1"
+    assert "RETRY-AFTER" in h
+
+    # pop: mixed-case removal, default passthrough, KeyError w/o default
+    assert h.pop("Retry-After") == "1"
+    assert h.pop("Retry-After", "gone") == "gone"
+    with pytest.raises(KeyError):
+        h.pop("Retry-After")
+
+    # del through mixed casing
+    del h["X-REQUEST-ID"]
+    assert "x-request-id" not in h

@@ -331,6 +331,76 @@ def test_perf_timing_in_tree_clean():
     assert active == [], active
 
 
+def test_metrics_hygiene_rules_exact_lines():
+    got = _active(
+        _lint(
+            os.path.join(FIXTURES, "metrics_hygiene.py"),
+            relpath="redpanda_tpu/coproc/metrics_hygiene.py",
+        )
+    )
+    met = sorted(f for f in got if f[0].startswith("MET"))
+    assert met == [
+        ("MET1701", 11),  # histogram looked up by literal in a function
+        ("MET1701", 15),  # counter looked up by literal in a function
+        ("MET1701", 19),  # dotted receiver metrics.registry counts too
+        ("MET1701", 23),  # name= keyword form
+        ("MET1702", 27),  # f-string name
+        ("MET1702", 31),  # concatenated name
+        ("MET1702", 37),  # constructed even at module level
+    ], met
+
+
+def test_metrics_hygiene_clean_shapes_stay_clean():
+    """Module-level bind-once, variable names, imported bindings and
+    non-registry receivers must not fire — the checker targets duplicated
+    literals, not metric use."""
+    findings = _lint(
+        os.path.join(FIXTURES, "metrics_hygiene.py"),
+        relpath="redpanda_tpu/coproc/metrics_hygiene.py",
+    )
+    met_lines = {f.line for f in findings if f.rule.startswith("MET")}
+    for clean_line in (6, 7, 43, 48, 53):
+        assert clean_line not in met_lines, clean_line
+    # the memoized check-then-create shape carries a reasoned pragma:
+    # suppressed, not invisible
+    sup = [
+        f for f in findings
+        if f.rule == "MET1701" and f.suppressed and f.line == 56
+    ]
+    assert sup, [(f.rule, f.line, f.suppressed) for f in findings]
+
+
+def test_metrics_hygiene_scoped_to_hot_packages(tmp_path):
+    """probes.py and the observability/resource_mgmt planes OWN their
+    registrations — the registration site is the single source there, so
+    the rule only applies in the data-path packages."""
+    cfg = Config()
+    for sub, expect in (
+        ("coproc", True), ("kafka", True), ("storage", True),
+        ("observability", False), ("resource_mgmt", False),
+    ):
+        pkg = tmp_path / "redpanda_tpu" / sub
+        pkg.mkdir(parents=True)
+        dst = pkg / "mh.py"
+        shutil.copyfile(os.path.join(FIXTURES, "metrics_hygiene.py"), dst)
+        report = LintEngine(cfg).lint_file(str(dst), f"redpanda_tpu/{sub}/mh.py")
+        assert any(f.rule.startswith("MET") for f in report.findings) is expect, sub
+
+
+def test_metrics_hygiene_in_tree_single_pragma():
+    """Exactly one sanctioned in-tree lazy-lookup site (the governor's
+    memoized per-label-set decision counters) — anything else is drift."""
+    eng = LintEngine(rules={"MET1701", "MET1702"}, cache_path=None)
+    reports = eng.lint_paths([os.path.join(REPO, "redpanda_tpu")])
+    active = [
+        (f.path, f.line) for r in reports
+        for f in r.findings if not f.suppressed
+    ]
+    assert active == [], active
+    suppressed = [f.path for r in reports for f in r.findings if f.suppressed]
+    assert suppressed == ["redpanda_tpu/coproc/governor.py"], suppressed
+
+
 def test_mesh_ctx_rules_exact_lines():
     got = _active(
         _lint(

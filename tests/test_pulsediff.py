@@ -1,0 +1,219 @@
+"""pulsediff (tools/pulsediff.py): the timeline-aware release judge.
+
+Pins the ROADMAP-7d contract: stage-by-stage wall splits judged inside
+the artifacts' own embedded same-session band, queue-wait separated from
+compute so a REGRESS names the right culprit, counter-track posture
+flips (shed appearing where there was none) read REGRESS, and
+non-timeline artifacts delegate to slodiff through the same entry point.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tools.pulsediff import (
+    diff_artifacts,
+    diff_timelines,
+    is_timeline,
+    main,
+    stage_profile,
+)
+from tools.slodiff import NO_DATA, PASS, REGRESS, WEATHER
+
+
+def _span(name, ts, dur, trace_id=None, cat="coproc"):
+    ev = {"ph": "X", "name": name, "ts": ts, "dur": dur, "pid": 1, "tid": 0,
+          "cat": cat, "args": {}}
+    if trace_id is not None:
+        ev["args"]["trace_id"] = trace_id
+    return ev
+
+
+def _counter(name, ts, value):
+    return {"ph": "C", "name": name, "ts": ts, "pid": 1, "tid": 0,
+            "cat": "trend", "args": {"value": value}}
+
+
+def _timeline(stage_us, launches=4, queue_wait_us=50.0, counters=(),
+              aa_band_pct=None):
+    """Build a timeline doc: per launch, one ingest span at t0 and one
+    dispatch span queue_wait_us later, plus any extra named stages."""
+    events = []
+    for i in range(launches):
+        t0 = i * 10_000.0
+        tid = f"t{i}"
+        events.append(_span("coproc.ingest", t0, stage_us.get("coproc.ingest", 100.0), tid))
+        events.append(
+            _span("coproc.dispatch", t0 + queue_wait_us,
+                  stage_us.get("coproc.dispatch", 200.0), tid)
+        )
+        for name, dur in stage_us.items():
+            if name in ("coproc.ingest", "coproc.dispatch"):
+                continue
+            events.append(_span(name, t0 + 500.0, dur, tid))
+    events.extend(counters)
+    doc = {"traceEvents": events, "launches": launches}
+    if aa_band_pct is not None:
+        doc["aa_band_pct"] = aa_band_pct
+    return doc
+
+
+# ------------------------------------------------------------ extraction
+def test_stage_profile_normalizes_per_launch():
+    doc = _timeline({"coproc.ingest": 100.0, "gemm": 300.0}, launches=4)
+    prof = stage_profile(doc)
+    assert prof["launches"] == 4
+    assert prof["stages"]["gemm"]["per_launch_us"] == 300.0
+    assert prof["stages"]["gemm"]["total_us"] == 1200.0
+    assert prof["stages"]["gemm"]["count"] == 4
+    assert prof["queue_wait_us"]["mean"] == 50.0
+    assert prof["queue_wait_us"]["n"] == 4
+
+
+def test_stage_profile_counter_envelopes_and_derived_exclusion():
+    doc = _timeline(
+        {}, launches=1,
+        counters=[_counter("trend:pressure", 0, 0.0),
+                  _counter("trend:pressure", 10, 2.0),
+                  _counter("trend:pressure", 20, 1.0)],
+    )
+    # derived spans re-cover the same wall: excluded from queue-wait groups
+    doc["traceEvents"].append(
+        _span("queue.wait", -500.0, 400.0, "t0", cat="derived")
+    )
+    prof = stage_profile(doc)
+    env = prof["counters"]["trend:pressure"]
+    assert (env["min"], env["max"], env["n"]) == (0.0, 2.0, 3)
+    assert env["mean"] == 1.0
+    assert prof["queue_wait_us"]["mean"] == 50.0  # derived span ignored
+
+
+# ------------------------------------------------------------ verdicts
+def test_aa_pass_inside_embedded_band():
+    old = _timeline({"gemm": 300.0}, aa_band_pct=10.0)
+    new = _timeline({"gemm": 310.0}, aa_band_pct=8.0)
+    d = diff_timelines(old, new, band_pct=None)
+    assert d["band_pct"] == 10.0  # larger of the two embedded bands
+    gemm = next(s for s in d["stages"] if s["name"] == "gemm")
+    assert gemm["verdict"] in (PASS, WEATHER)
+    assert d["verdict"] in (PASS, WEATHER)
+
+
+def test_regress_names_the_culprit_stage():
+    old = _timeline({"gemm": 300.0, "colcache": 80.0}, aa_band_pct=5.0)
+    new = _timeline({"gemm": 900.0, "colcache": 80.0}, aa_band_pct=5.0)
+    d = diff_timelines(old, new, band_pct=None)
+    verdicts = {s["name"]: s["verdict"] for s in d["stages"]}
+    assert verdicts["gemm"] == REGRESS
+    assert verdicts["colcache"] == PASS
+    assert d["verdict"] == REGRESS
+
+
+def test_queue_wait_regression_is_not_blamed_on_compute():
+    """The 7d disambiguation: the SAME headline slowdown in queue-wait
+    alone must leave every compute stage clean."""
+    old = _timeline({"gemm": 300.0}, queue_wait_us=50.0, aa_band_pct=5.0)
+    new = _timeline({"gemm": 300.0}, queue_wait_us=4000.0, aa_band_pct=5.0)
+    d = diff_timelines(old, new, band_pct=None)
+    assert all(s["verdict"] == PASS for s in d["stages"])
+    assert d["queue_wait"]["verdict"] == REGRESS
+    assert d["verdict"] == REGRESS
+
+
+def test_counter_posture_flip_reads_regress():
+    quiet = _timeline({}, counters=[_counter("trend:shed_rate", 0, 0.0)])
+    shedding = _timeline({}, counters=[_counter("trend:shed_rate", 0, 12.5)])
+    d = diff_timelines(quiet, shedding, band_pct=25.0)
+    shed = next(c for c in d["counters"] if c["name"] == "trend:shed_rate")
+    assert shed["verdict"] == REGRESS
+    assert shed["detail"] == "track flipped idle -> active"
+    assert d["verdict"] == REGRESS
+    # drill-down-only tracks never judge
+    occ_old = _timeline({}, counters=[_counter("trend:occupancy:p", 0, 0.1)])
+    occ_new = _timeline({}, counters=[_counter("trend:occupancy:p", 0, 0.9)])
+    d2 = diff_timelines(occ_old, occ_new, band_pct=25.0)
+    occ = next(c for c in d2["counters"] if c["name"] == "trend:occupancy:p")
+    assert occ["verdict"] == NO_DATA
+
+
+def test_micro_stage_below_resolution_floor_is_weather():
+    """A 40us stage doubling is +100% but +40us/launch — below any shared
+    box's scheduler jitter and unable to explain a headline move. The
+    absolute floor clamps it to WEATHER (named on the row), while the
+    same percentage on a stage that moved real wall still REGRESSes, and
+    --min-delta-us 0 restores the pure-percentage judge."""
+    old = _timeline({"micro": 40.0, "gemm": 300.0}, aa_band_pct=5.0)
+    new = _timeline({"micro": 80.0, "gemm": 600.0}, aa_band_pct=5.0)
+    d = diff_timelines(old, new, band_pct=None)
+    rows = {s["name"]: s for s in d["stages"]}
+    assert rows["micro"]["verdict"] == WEATHER
+    assert "below resolution floor" in rows["micro"]["detail"]
+    assert rows["gemm"]["verdict"] == REGRESS  # +300us/launch is real
+    assert d["verdict"] == REGRESS
+
+    d0 = diff_timelines(old, new, band_pct=None, min_delta_us=0.0)
+    assert {s["name"]: s["verdict"] for s in d0["stages"]}["micro"] == REGRESS
+
+    # queue-wait honors the same floor
+    qo = _timeline({}, queue_wait_us=20.0, aa_band_pct=5.0)
+    qn = _timeline({}, queue_wait_us=60.0, aa_band_pct=5.0)
+    dq = diff_timelines(qo, qn, band_pct=None)
+    assert dq["queue_wait"]["verdict"] == WEATHER
+
+
+def test_stage_appearing_or_vanishing_is_no_data():
+    old = _timeline({"gemm": 300.0})
+    new = _timeline({"attn": 300.0})
+    d = diff_timelines(old, new, band_pct=25.0)
+    verdicts = {s["name"]: (s["verdict"], s.get("detail")) for s in d["stages"]}
+    assert verdicts["attn"] == (NO_DATA, "stage absent in baseline")
+    assert verdicts["gemm"] == (NO_DATA, "stage no longer runs")
+
+
+def test_launch_normalization_compares_unequal_rings():
+    """Two rings of different depth: per-launch stage cost identical, so
+    the 3x total wall must NOT read as a regression."""
+    old = _timeline({"gemm": 300.0}, launches=2, aa_band_pct=5.0)
+    new = _timeline({"gemm": 300.0}, launches=6, aa_band_pct=5.0)
+    d = diff_timelines(old, new, band_pct=None)
+    gemm = next(s for s in d["stages"] if s["name"] == "gemm")
+    assert gemm["verdict"] == PASS
+    assert (d["old_launches"], d["new_launches"]) == (2, 6)
+
+
+# ------------------------------------------------------------ dispatch
+def test_mixed_artifact_pair_refused():
+    with pytest.raises(ValueError, match="kinds differ"):
+        diff_artifacts(_timeline({}), {"meta": {}, "objectives": []})
+
+
+def test_non_timeline_pair_delegates_to_slodiff():
+    slo = {
+        "meta": {"run": "r"}, "workloads": {},
+        "objectives": [
+            {"name": "o", "metric": "m", "objective_us": 100,
+             "observed_p99_us": 50, "ok": True},
+        ],
+    }
+    assert not is_timeline(slo)
+    d = diff_artifacts(slo, json.loads(json.dumps(slo)))
+    assert d.get("kind") != "timeline"
+    assert "verdict" in d
+
+
+# ------------------------------------------------------------ CLI
+def test_cli_exit_codes(tmp_path, capsys):
+    old_p = tmp_path / "old.json"
+    new_p = tmp_path / "new.json"
+    old_p.write_text(json.dumps(_timeline({"gemm": 300.0}, aa_band_pct=5.0)))
+    new_p.write_text(json.dumps(_timeline({"gemm": 306.0}, aa_band_pct=5.0)))
+    assert main([str(old_p), str(new_p)]) == 0
+    out = capsys.readouterr().out
+    assert "verdict:" in out and "gemm" in out
+
+    new_p.write_text(json.dumps(_timeline({"gemm": 900.0}, aa_band_pct=5.0)))
+    assert main([str(old_p), str(new_p), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["verdict"] == REGRESS

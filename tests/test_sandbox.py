@@ -12,6 +12,7 @@ execution budget, so these tests are the security contract.
 from __future__ import annotations
 
 import json
+import time
 
 import pytest
 
@@ -151,9 +152,14 @@ def test_pathological_source_is_violation_not_crash():
 def test_builtins_are_empty_in_sandbox():
     # the compiled function's globals must not expose real builtins
     fn = compile_transform(GOOD)
-    glb = fn.__closure__[0].cell_contents.__globals__ if fn.__closure__ else None
-    # reach the inner transform through the wrapper's closure
-    inner = [c.cell_contents for c in fn.__closure__ if callable(c.cell_contents)][0]
+    # reach the inner transform through the wrapper's closure (the
+    # watchdog's _kill helper shares the closure; select by name)
+    inner = [
+        c.cell_contents
+        for c in fn.__closure__
+        if callable(c.cell_contents)
+        and getattr(c.cell_contents, "__name__", "") == "transform"
+    ][0]
     assert inner.__globals__["__builtins__"] == {}
     assert "open" not in inner.__globals__
     assert "getattr" not in inner.__globals__
@@ -216,3 +222,149 @@ def test_engine_enable_sandboxed_and_policies():
     assert engine2.heartbeat() == 0
     engine.shutdown()
     engine2.shutdown()
+
+
+# ---------------------------------------------------- wall-clock watchdog
+def _trend_kills():
+    from redpanda_tpu.coproc.governor import TREND, journal
+
+    return [
+        e for e in journal.entries(domain=TREND)
+        if e["verdict"] == "watchdog_kill"
+    ]
+
+
+def test_guard_kills_single_opcode_bigint_before_entry():
+    """The canonical uninterruptible burn: ``10**10**8`` is ONE opcode
+    holding the GIL for minutes — no tracer line event can interrupt it.
+    The compile-time operand guard must refuse it BEFORE entry, fast,
+    and journal exactly one governor TREND entry for the incident."""
+    from redpanda_tpu.coproc.governor import reset_journal
+
+    reset_journal()
+    fn = compile_transform(
+        "def transform(value):\n    x = 10 ** 10 ** 8\n    return value\n",
+        script_id=901,
+    )
+    t0 = time.monotonic()
+    with pytest.raises(SandboxRuntimeError, match="bits"):
+        fn(b"x")
+    assert time.monotonic() - t0 < 0.5  # refused pre-entry, not after a burn
+    kills = _trend_kills()
+    assert len(kills) == 1
+    assert kills[0]["inputs"]["script_id"] == 901
+    assert kills[0]["inputs"]["layer"] == "guard"
+    # the incident journals once per compiled transform, not per record
+    with pytest.raises(SandboxRuntimeError):
+        fn(b"x")
+    assert len(_trend_kills()) == 1
+
+
+@pytest.mark.parametrize(
+    "src",
+    [
+        "def transform(value):\n    x = 1 << (1 << 30)\n    return value\n",
+        "def transform(value):\n    x = 'ab' * (1 << 30)\n    return value\n",
+        "def transform(value):\n    x = (1 << 30) * [0]\n    return value\n",
+        "def transform(value):\n    x = 2\n    x **= 10 ** 7\n    return value\n",
+        "def transform(value):\n"
+        "    for i in range(1 << 40):\n        pass\n    return value\n",
+    ],
+    ids=["lshift", "str-repeat", "list-repeat", "augassign-pow", "range"],
+)
+def test_guards_refuse_oversized_operands(src):
+    fn = compile_transform(src)
+    with pytest.raises(SandboxRuntimeError, match="watchdog"):
+        fn(b"x")
+
+
+def test_guards_transparent_for_legit_arithmetic():
+    fn = compile_transform(
+        "def transform(value):\n"
+        "    n = int(value.decode())\n"
+        "    out = {'n': n * 3 ** 2, 'pad': 'x' * 4, 'r': [i for i in range(3)]}\n"
+        "    return json_dumps(out)\n"
+    )
+    assert json.loads(fn(b"5")) == {"n": 45, "pad": "xxxx", "r": [0, 1, 2]}
+
+
+def test_deadline_layer_kills_slow_loop(monkeypatch):
+    """Layer 1: a loop that stays under the line budget but over the wall
+    deadline is cut by the tracer's deadline check (layer='deadline')."""
+    from redpanda_tpu.coproc import sandbox
+    from redpanda_tpu.coproc.governor import reset_journal
+
+    reset_journal()
+    monkeypatch.setattr(sandbox, "EXEC_WALL_DEADLINE_S", 0.05)
+    fn = compile_transform(
+        # each iteration sleeps via a modest str*int (guard-permitted) so
+        # few line events burn real time: deadline trips before budget
+        "def transform(value):\n"
+        "    n = 0\n"
+        "    while n < 50000:\n"
+        "        s = 'x' * 65536\n"
+        "        n = n + 1\n"
+        "    return value\n"
+    )
+    with pytest.raises(SandboxRuntimeError, match="wall-clock deadline"):
+        fn(b"x")
+    kills = _trend_kills()
+    assert len(kills) == 1
+    assert kills[0]["inputs"]["layer"] == "deadline"
+
+
+def test_post_hoc_layer_catches_residual_overrun(monkeypatch):
+    """Layer 3: a single guard-permitted call that overruns the (shrunk)
+    deadline finishes — no line event lands mid-call — and the
+    post-completion elapsed check still fails the record."""
+    from redpanda_tpu.coproc import sandbox
+    from redpanda_tpu.coproc.governor import reset_journal
+
+    reset_journal()
+    monkeypatch.setattr(sandbox, "EXEC_WALL_DEADLINE_S", 0.01)
+    # the slow guard-permitted call sits ON the return line: the tracer's
+    # only line event fires before it starts (under deadline), and after
+    # it only a "return" event follows — no line event lands to kill it
+    fn = compile_transform(
+        "def transform(value):\n    return str(sum(range(10000000)))\n"
+    )
+    with pytest.raises(SandboxRuntimeError, match="deadline"):
+        fn(b"x")
+    kills = _trend_kills()
+    assert len(kills) == 1
+    assert kills[0]["inputs"]["layer"] == "post_hoc"
+
+
+def test_engine_deregisters_on_watchdog_kill():
+    """End-to-end policy wiring: a deployed transform that trips the
+    operand guard surfaces as a script failure, and deregister policy
+    unloads it like any other crash."""
+    from redpanda_tpu.coproc import (
+        EnableResponseCode,
+        ProcessBatchRequest,
+        TpuEngine,
+    )
+    from redpanda_tpu.coproc.engine import ErrorPolicy, ProcessBatchItem
+    from redpanda_tpu.coproc.governor import reset_journal
+    from redpanda_tpu.models import NTP, Record, RecordBatch
+
+    reset_journal()
+    engine = TpuEngine()
+    burn = "def transform(value):\n    x = 10 ** 10 ** 8\n    return value\n"
+    assert (
+        engine.enable_py_sandboxed(7, burn, ("t",), ErrorPolicy.deregister)
+        == EnableResponseCode.success
+    )
+    req = ProcessBatchRequest(
+        [ProcessBatchItem(
+            7, NTP.kafka("t", 0),
+            [RecordBatch.build([Record(offset_delta=0, value=b"x")])],
+        )]
+    )
+    reply = engine.process_batch(req)
+    assert reply.deregistered == [7]
+    assert engine.heartbeat() == 0
+    kills = _trend_kills()
+    assert len(kills) == 1
+    assert kills[0]["inputs"]["script_id"] == 7
+    engine.shutdown()

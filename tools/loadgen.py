@@ -1601,17 +1601,78 @@ def _diff_block(against_path: str, report: dict, band_pct) -> dict:
     diffed against a prior SLO artifact, objective-by-objective, with
     noise-band verdicts. Embedded in the written artifact so the verdict
     travels WITH the evidence; a broken baseline degrades to an error
-    block, never a sunk run."""
-    from tools import slodiff
+    block, never a sunk run. Routed through tools/pulsediff.py (which
+    delegates SLO/BENCH shapes to slodiff) so a timeline baseline judges
+    too — one judge entry point for whatever the release flow hands it."""
+    from tools import pulsediff
 
     try:
-        baseline = slodiff._load(against_path)
-        d = slodiff.diff_artifacts(baseline, report, band_pct)
+        baseline = pulsediff._load(against_path)
+        d = pulsediff.diff_artifacts(baseline, report, band_pct)
         d["against"] = against_path
         return d
     except Exception as exc:  # noqa: BLE001 - the run itself succeeded
         return {"against": against_path, "error": repr(exc),
                 "verdict": "NO_BASELINE"}
+
+
+# latency objectives are noisier than the throughput skew the A/A bracket
+# measures; a same-box band below this floor would misfire REGRESS on
+# ordinary jitter, so the embedded judgments never judge tighter than this
+AA_BAND_FLOOR_PCT = 5.0
+
+
+def _aa_bracket(scenario: str, rounds: int, **run_kw) -> dict:
+    """ROADMAP 7d same-session A/A bracket: run the scenario ``rounds``
+    times back-to-back on the same code BEFORE the measured run, so the
+    artifact carries its OWN noise band (max pairwise throughput skew,
+    ``aa_band_pct``) instead of borrowing one measured on a different box
+    on a different day — the exact aa_skew discipline BENCH artifacts
+    already follow. The bracket also judges ITSELF (first vs last round
+    through slodiff at the measured band): a bracket that cannot read
+    PASS/WEATHER on its own same-code rounds has no business judging a
+    release, and the embedded judgment says so on the artifact's face."""
+    from tools import slodiff
+
+    reports = [run_scenario(scenario, **run_kw) for _ in range(rounds)]
+    rates = [
+        r["throughput"]["produced_records_per_s"] for r in reports
+    ]
+    lo = min(rates)
+    thr_skew = (max(rates) - lo) / lo * 100.0 if lo > 0 else 0.0
+    # latency skew measured the same way, per objective across rounds:
+    # same-code p99s on short windows jitter far more than throughput, and
+    # a band that only priced throughput would misfire REGRESS on every
+    # latency objective (observed live: 0.55% rate skew vs >5% p99 moves)
+    by_name: dict[str, list[float]] = {}
+    for r in reports:
+        for o in r.get("objectives", []):
+            v = o.get("observed_ms")
+            if isinstance(v, (int, float)):
+                by_name.setdefault(o["name"], []).append(float(v))
+    lat_skews = [
+        (max(vals) - min(vals)) / min(vals) * 100.0
+        for vals in by_name.values()
+        if len(vals) >= 2 and min(vals) > 0
+    ]
+    lat_skew = max(lat_skews) if lat_skews else 0.0
+    band = max(thr_skew, lat_skew)
+    block = {
+        "rounds": rounds,
+        "round_rates": [round(r, 1) for r in rates],
+        "throughput_skew_pct": round(thr_skew, 2),
+        "latency_skew_pct": round(lat_skew, 2),
+        "aa_band_pct": round(band, 2),
+        "band_floor_pct": AA_BAND_FLOOR_PCT,
+    }
+    if rounds >= 2:
+        try:
+            block["judgment"] = slodiff.diff_artifacts(
+                reports[0], reports[-1], max(band, AA_BAND_FLOOR_PCT)
+            )
+        except Exception as exc:  # noqa: BLE001 - bracket stays advisory
+            block["judgment"] = {"error": repr(exc), "verdict": "NO_DATA"}
+    return block
 
 
 def main(argv=None) -> int:
@@ -1644,7 +1705,17 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--diff-band-pct", type=float, default=None, metavar="PCT",
-        help="noise band for --diff-against (default: slodiff's)",
+        help="noise band for --diff-against (default: the --ab-rounds "
+             "measured band when bracketed, else slodiff's)",
+    )
+    p.add_argument(
+        "--ab-rounds", type=int, default=0, metavar="K",
+        help="same-session A/A bracket (ROADMAP 7d): run the scenario K "
+             "extra times back-to-back BEFORE the measured run; the "
+             "artifact then carries its OWN noise band (max pairwise "
+             "throughput skew, 'aa_band_pct') plus the bracket's slodiff "
+             "self-judgment, and --diff-against judges at that measured "
+             "band instead of a borrowed default",
     )
     args = p.parse_args(argv)
     if args.list:
@@ -1657,6 +1728,9 @@ def main(argv=None) -> int:
                   f"duration={s['duration_s']}s producers={s['producers']} "
                   f"open-loop x{s['overload_factor']} (overload gate)")
         return 0
+    if args.ab_rounds and args.scenario in OVERLOAD_SCENARIOS:
+        p.error("--ab-rounds brackets closed-loop scenarios only (the "
+                "overload gate is judged against its own calibration run)")
     if args.scenario in OVERLOAD_SCENARIOS:
         report = run_overload(
             args.scenario, backend=args.backend, duration_s=args.duration,
@@ -1683,15 +1757,29 @@ def main(argv=None) -> int:
         return 0 if report["pass"] else 1
     if args.scenario not in SCENARIOS:
         p.error(f"unknown scenario {args.scenario!r}; --list shows them")
+    aa_block = None
+    if args.ab_rounds:
+        # A/A rounds run WITHOUT chaos even when the measured run arms it:
+        # the band prices same-code weather, not the probe's damage
+        aa_block = _aa_bracket(
+            args.scenario, args.ab_rounds, chaos=False,
+            duration_s=args.duration, clients_scale=args.clients_scale,
+            backend=args.backend,
+        )
     report = run_scenario(
         args.scenario, chaos=args.chaos, duration_s=args.duration,
         clients_scale=args.clients_scale, backend=args.backend,
     )
     out = args.report or f"SLO_{args.scenario}.json"
+    if aa_block is not None:
+        report["aa"] = aa_block
+        # top-level so pulsediff/slodiff sniff it as the artifact's band
+        report["aa_band_pct"] = aa_block["aa_band_pct"]
     if args.diff_against:
-        report["slodiff"] = _diff_block(
-            args.diff_against, report, args.diff_band_pct
-        )
+        band = args.diff_band_pct
+        if band is None and aa_block is not None:
+            band = max(aa_block["aa_band_pct"], AA_BAND_FLOOR_PCT)
+        report["slodiff"] = _diff_block(args.diff_against, report, band)
     with open(out, "w") as f:
         json.dump(report, f, indent=2, sort_keys=True)
     verdict = "PASS" if report["pass"] else "FAIL"
@@ -1702,6 +1790,11 @@ def main(argv=None) -> int:
             {"slodiff": report["slodiff"]["verdict"],
              "slodiff_against": args.diff_against}
             if args.diff_against else {}
+        ),
+        **(
+            {"aa_band_pct": aa_block["aa_band_pct"],
+             "aa_judgment": (aa_block.get("judgment") or {}).get("verdict")}
+            if aa_block is not None else {}
         ),
         "failed_objectives": report["failed"],
         "chaos": bool(report.get("chaos")),

@@ -1118,6 +1118,114 @@ def bench_pulse_overhead(secs: float) -> dict:
     return out
 
 
+def bench_history_overhead(secs: float) -> dict:
+    """Cost of the pandatrend metrics-history recorder vs a real launch.
+
+    The recorder never rides the launch path: it is one background thread
+    calling ``sample_once()`` every ``history_interval_s``. Its steady-
+    state tax on a running broker is therefore a duty cycle — per-sample
+    cost over the sampling interval — and that is what the gate judges:
+    during a launch of any length the recorder is expected to steal
+    ``sample_ns / interval_ns`` of it. The per-sample cost is dominated
+    by ``_cumulative()`` (one full registry scan + ``_hist_window`` per
+    histogram), which is paid whether or not any series moved, so a quiet
+    registry prices the scan honestly; the registry is first warmed by a
+    real columnar launch so the scan walks the series a live broker has.
+
+    Also pins the ISSUE 17 off posture: ``interval_s=0`` must run NO
+    recorder thread (the violation-only ``history_recorder_off_threads``
+    key, same contract as ``pulse_profiler_off_threads``)."""
+    import json as _json
+    import threading as _threading
+
+    from redpanda_tpu.coproc import TpuEngine, ProcessBatchRequest
+    from redpanda_tpu.coproc.engine import ProcessBatchItem
+    from redpanda_tpu.models import NTP, Record, RecordBatch
+    from redpanda_tpu.observability.history import (
+        DEFAULT_INTERVAL_S, HistoryRecorder,
+    )
+    from redpanda_tpu.ops.exprs import field
+    from redpanda_tpu.ops.transforms import Int, Str, map_project, where
+
+    engine = TpuEngine(
+        row_stride=256, compress_threshold=10**9,
+        force_mode="columnar_host", host_workers=0,
+    )
+    spec = where(field("level") == "error") | map_project(
+        Int("code"), Str("msg", 16)
+    )
+    engine.enable_coprocessors([(1, spec.to_json(), ("orders",))])
+    recs = [
+        Record(
+            offset_delta=i, timestamp_delta=i,
+            value=_json.dumps(
+                {"level": ["error", "info"][i % 2], "code": i, "msg": f"m{i}"},
+                separators=(",", ":"),
+            ).encode(),
+        )
+        for i in range(512)
+    ]
+    batch = RecordBatch.build(recs, base_offset=0, first_timestamp=1000)
+    req = ProcessBatchRequest(
+        [ProcessBatchItem(1, NTP.kafka("orders", 0), [batch])]
+    )
+
+    def op():
+        engine.process_batch(req)
+
+    def timed_block(fn, k: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(k):
+            fn()
+        return time.perf_counter() - t0
+
+    op()  # warmup (and: populates the live registry the recorder scans)
+    per_op = min(timed_block(op, 2) / 2 for _ in range(3))
+    k = max(2, int(0.01 / per_op))
+    rounds = max(12, int(secs / (k * per_op)))
+    best_op = min(timed_block(op, k) / k for _ in range(rounds))
+    engine.shutdown()
+
+    # per-sample cost on a PRIVATE recorder against the PROCESS registry
+    # (reads only — sample_once never mutates the registry; a private ring
+    # keeps bench windows out of any live /v1/history)
+    rec = HistoryRecorder()
+    rec.configure(windows=64)
+    rec.sample_once()  # anchors the delta baseline; first call is free
+    sample_ns = float("inf")
+    n_raw = 200
+    for _ in range(8):
+        t0 = time.perf_counter()
+        for _ in range(n_raw):
+            rec.sample_once()
+        sample_ns = min(sample_ns, (time.perf_counter() - t0) / n_raw * 1e9)
+    series = len(rec.windows()[-1]["gauges"]) if rec.windows() else 0
+
+    launch_ns = best_op * 1e9
+    interval_ns = DEFAULT_INTERVAL_S * 1e9
+    pct = sample_ns / interval_ns * 100.0
+    # interval=0 posture: configure() with 0 must leave NO recorder thread
+    rec.configure(interval_s=0.0)
+    off_threads = sum(
+        1 for t in _threading.enumerate()
+        if t.name == "rptpu-history-recorder"
+    )
+    out = {
+        "history_sample_ns": round(sample_ns, 1),
+        "history_sample_cost_us": round(sample_ns / 1e3, 2),
+        "history_gauge_series_scanned": series,
+        "history_launch_cost_us": round(best_op * 1e6, 1),
+        "history_sample_vs_launch_pct": round(
+            sample_ns / launch_ns * 100.0, 3
+        ) if launch_ns else 0.0,
+        "history_overhead_pct": round(pct, 4),
+    }
+    if off_threads:
+        # violation-only key, same contract as pulse_profiler_off_threads
+        out["history_recorder_off_threads"] = off_threads
+    return out
+
+
 def bench_trace_propagation_overhead(secs: float) -> dict:
     """Cost of pandascope trace propagation on an rpc round trip.
 
@@ -1284,6 +1392,7 @@ BENCHES = {
     "governor_overhead": bench_governor_overhead,
     "admission_overhead": bench_admission_overhead,
     "pulse_overhead": bench_pulse_overhead,
+    "history_overhead": bench_history_overhead,
 }
 
 
@@ -1365,6 +1474,15 @@ def main(argv=None) -> int:
         "pulse_overhead bench",
     )
     p.add_argument(
+        "--assert-history-overhead",
+        type=float,
+        metavar="PCT",
+        help="fail (exit 1) if the pandatrend history recorder's steady-"
+        "state duty cycle (per-sample cost over history_interval_s) "
+        "exceeds PCT (e.g. 1 = 1%%), or if a recorder thread exists with "
+        "history_interval_s=0; implies the history_overhead bench",
+    )
+    p.add_argument(
         "--assert-harvest-speedup",
         type=float,
         metavar="RATIO",
@@ -1420,6 +1538,8 @@ def main(argv=None) -> int:
         names.append("slo_eval_overhead")
     if args.assert_pulse_overhead is not None and "pulse_overhead" not in names:
         names.append("pulse_overhead")
+    if args.assert_history_overhead is not None and "history_overhead" not in names:
+        names.append("history_overhead")
     if args.assert_governor_overhead is not None and "governor_overhead" not in names:
         names.append("governor_overhead")
     if args.assert_admission_overhead is not None and "admission_overhead" not in names:
@@ -1527,6 +1647,22 @@ def main(argv=None) -> int:
             print(
                 "pulse profiler thread running with profile_hz=0 "
                 "(disabled profiler must add ZERO hot-path work)",
+                file=sys.stderr,
+            )
+            return 1
+    if args.assert_history_overhead is not None:
+        pct = out.get("history_overhead_pct", 0.0)
+        if pct > args.assert_history_overhead:
+            print(
+                f"history recorder duty cycle {pct}% exceeds budget "
+                f"{args.assert_history_overhead}%",
+                file=sys.stderr,
+            )
+            return 1
+        if out.get("history_recorder_off_threads", 0) != 0:
+            print(
+                "history recorder thread running with history_interval_s=0 "
+                "(0 = off must mean NO thread)",
                 file=sys.stderr,
             )
             return 1

@@ -23,6 +23,7 @@ from tools.pandalint.checkers.tracectx import TraceCtxChecker
 from tools.pandalint.checkers.meshctx import MeshCtxChecker
 from tools.pandalint.checkers.backpressure import BackpressureChecker
 from tools.pandalint.checkers.perftiming import PerfTimingChecker
+from tools.pandalint.checkers.metricshygiene import MetricsHygieneChecker
 from tools.pandalint.lifecycle import LifecycleChecker
 
 ALL_CHECKERS: tuple[type[Checker], ...] = (
@@ -44,6 +45,7 @@ ALL_CHECKERS: tuple[type[Checker], ...] = (
     MeshCtxChecker,
     BackpressureChecker,
     PerfTimingChecker,
+    MetricsHygieneChecker,
     LifecycleChecker,
 )
 

@@ -1,0 +1,120 @@
+"""Metrics hygiene: hot paths must not look series up by name literal.
+
+Every latency histogram and counter the SLO/trend planes judge is
+single-sourced in ``redpanda_tpu/observability/probes.py`` (PR-2's
+dispatch-layer contract): one module owns each series name, hot paths
+import the binding. An ad-hoc ``registry.histogram("kafka_produce_…")``
+inline in a hot function re-states the name as a string literal — and the
+second spelling is where drift starts. PR-14's slodiff caught exactly this
+shape at runtime (an SLO objective judging ``explode``, a lane the engine
+no longer ran); this checker makes it static.
+
+Heuristic scope (no type inference), confined to the hot-path packages
+(``redpanda_tpu/{coproc,kafka,rpc,raft,storage}``) — probes.py itself and
+the observability plane own their registrations and are outside the scope:
+
+- MET1701: ``registry.histogram("literal", …)`` / ``registry.counter(
+  "literal", …)`` INSIDE a function body — a per-call name-literal lookup
+  in hot code. Module-level ``x = registry.counter("…")`` bind-once is the
+  sanctioned idiom and does not count; neither does a lookup whose name is
+  a variable (the binding owns the literal elsewhere).
+- MET1702: the same lookup shape with a CONSTRUCTED name (f-string,
+  concatenation, %-format, ``.format``/``join`` call) anywhere in the
+  file — a name no grep or static tool can pin, so drift there is
+  undetectable until a dashboard goes flat.
+
+A deliberate lazy check-then-create (memoized per-label-set counters à la
+``governor._decision_counter``) carries a reasoned
+``# pandalint: disable=MET1701 -- …`` pragma, which doubles as the
+documentation of why the per-call lookup is actually once-per-key.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.pandalint.checkers.base import (
+    Checker,
+    FileContext,
+    RawFinding,
+    dotted,
+)
+
+_LOOKUP_ATTRS = frozenset({"histogram", "counter"})
+
+# name-argument shapes that CONSTRUCT the series name at the call site
+_CONSTRUCTED = (ast.JoinedStr, ast.BinOp, ast.Call)
+
+
+def _registry_lookup(call: ast.Call) -> str | None:
+    """'histogram'|'counter' when this call is a registry series lookup."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _LOOKUP_ATTRS):
+        return None
+    recv = dotted(f.value)
+    if recv == "registry" or recv.endswith(".registry"):
+        return f.attr
+    return None
+
+
+def _name_arg(call: ast.Call) -> ast.expr | None:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+class MetricsHygieneChecker(Checker):
+    name = "metrics-hygiene"
+    rules = {
+        "MET1701": "per-call registry.histogram()/counter() name-literal "
+                   "lookup in a hot path — bind the series once at module "
+                   "level or in observability/probes.py and import it",
+        "MET1702": "registry series lookup with a CONSTRUCTED name "
+                   "(f-string/concat/format) — undetectable name drift",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[RawFinding]:
+        # module-level statements: bind-once is the idiom; only flag
+        # constructed names there (MET1702 applies everywhere)
+        yield from self._walk(ctx.tree.body, in_function=False)
+
+    def _walk(self, body, in_function: bool) -> Iterator[RawFinding]:
+        for node in body:
+            yield from self._visit(node, in_function)
+
+    def _visit(self, node: ast.AST, in_function: bool) -> Iterator[RawFinding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            inner = node.body if isinstance(node.body, list) else [node.body]
+            yield from self._walk(inner, in_function=True)
+            return
+        if isinstance(node, ast.Call):
+            kind = _registry_lookup(node)
+            if kind is not None:
+                arg = _name_arg(node)
+                if isinstance(arg, _CONSTRUCTED):
+                    yield RawFinding(
+                        "MET1702",
+                        node.lineno,
+                        node.col_offset,
+                        f"registry.{kind}() with a constructed series name "
+                        f"— no grep can pin this spelling against "
+                        f"probes.py; pass the literal through a named "
+                        f"binding instead",
+                    )
+                elif in_function and isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    yield RawFinding(
+                        "MET1701",
+                        node.lineno,
+                        node.col_offset,
+                        f"registry.{kind}({arg.value!r}) looked up by name "
+                        f"literal inside a hot-path function — bind the "
+                        f"series once (module level or observability/"
+                        f"probes.py) and import the binding; duplicated "
+                        f"name literals are where series drift starts",
+                    )
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(child, in_function)

@@ -1,0 +1,337 @@
+"""pulsediff: slodiff for timelines — stage-by-stage, inside noise bands.
+
+ROADMAP 7d: SLO_r14's three judgment blocks proved that artifact-level
+diffs (headline rb/s, objective p99s) can move for reasons a stage-level
+view immediately disambiguates — a 10% headline drop that is ALL in
+queue-wait is backpressure weather, the same drop concentrated in one
+device leg is a code regression with a name. This module diffs two
+pandapulse timeline artifacts (``rpk debug profile --perfetto`` output /
+``timeline.json`` from a debug bundle) stage by stage:
+
+- **per-stage wall split**: total duration per span name, normalized per
+  launch, judged lower-is-better inside the noise band;
+- **queue-wait**: the gap between a launch group's first span start and
+  its dispatch leg — backpressure shows up HERE, not in compute stages;
+- **counter-track envelopes**: min/mean/max per ``ph:"C"`` trend track
+  (occupancy, shed rate, pressure...), reported for drill-down and judged
+  only for hard posture flips (shed rate appearing where there was none).
+
+Verdict vocabulary is slodiff's, verbatim: PASS / WEATHER / REGRESS with
+the band from ``--noise-band-pct`` or the artifacts' own embedded
+``aa_band_pct`` (what ``loadgen --ab-rounds`` measures same-session —
+the only honest band, per SLO_r14). Percentage bands alone misjudge
+tiny stages: a 40us extract leg doubling is +100% but +40us/launch — it
+cannot explain any headline move and sits below a shared box's scheduler
+jitter, so an A/A pair would read REGRESS on a different micro-stage
+every rerun. Stages (and queue-wait) whose ABSOLUTE per-launch delta is
+under ``--min-delta-us`` (default 100us) therefore clamp REGRESS ->
+WEATHER with the floor named on the row's face — loud, never fatal.
+Non-timeline artifacts (SLO reports, BENCH files) delegate to
+tools/slodiff.py unchanged, so one entry point judges whatever pair the
+release flow hands it::
+
+    python -m tools.pulsediff old_timeline.json new_timeline.json
+    python -m tools.pulsediff SLO_r14.json SLO_r17.json   # -> slodiff
+
+Exit code 0 for PASS/WEATHER, 1 for REGRESS — WEATHER is loud but does
+not fail a release (failing on weather teaches people to rerun until
+green).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.slodiff import (
+    DEFAULT_BAND_PCT, NO_DATA, PASS, REGRESS, WEATHER,
+    _verdict_lower_better, _worst, diff_artifacts as _slodiff_artifacts,
+)
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        doc = doc["parsed"]
+    return doc
+
+
+def is_timeline(doc: dict) -> bool:
+    return isinstance(doc.get("traceEvents"), list)
+
+
+# ================================================================ extraction
+def stage_profile(doc: dict) -> dict:
+    """Per-stage wall totals + queue-wait + counter envelopes from one
+    timeline document. Durations are normalized per launch when the
+    artifact says how many launches it covers — two rings of different
+    depth must still compare."""
+    events = doc.get("traceEvents") or []
+    launches = max(1, int(doc.get("launches") or 1))
+    stages: dict[str, dict] = {}
+    group_start: dict = {}
+    dispatch_start: dict = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "?")
+        dur = float(ev.get("dur") or 0.0)
+        st = stages.setdefault(name, {"total_us": 0.0, "count": 0})
+        st["total_us"] += dur
+        st["count"] += 1
+        # queue-wait: first span of the trace -> the dispatch-family leg.
+        # trace_id groups a launch lifecycle; derived spans excluded (they
+        # re-cover the same wall).
+        args = ev.get("args") or {}
+        tid = args.get("trace_id")
+        if tid is None or ev.get("cat") == "derived":
+            continue
+        ts = float(ev.get("ts") or 0.0)
+        if tid not in group_start or ts < group_start[tid]:
+            group_start[tid] = ts
+        if "dispatch" in name and (
+            tid not in dispatch_start or ts < dispatch_start[tid]
+        ):
+            dispatch_start[tid] = ts
+    waits = [
+        max(0.0, dispatch_start[t] - group_start[t])
+        for t in dispatch_start
+        if t in group_start
+    ]
+    counters: dict[str, dict] = {}
+    for ev in events:
+        if ev.get("ph") != "C":
+            continue
+        v = (ev.get("args") or {}).get("value")
+        if not isinstance(v, (int, float)):
+            continue
+        c = counters.setdefault(
+            ev.get("name", "?"),
+            {"min": v, "max": v, "sum": 0.0, "n": 0},
+        )
+        c["min"] = min(c["min"], v)
+        c["max"] = max(c["max"], v)
+        c["sum"] += v
+        c["n"] += 1
+    return {
+        "launches": launches,
+        "stages": {
+            name: {
+                "per_launch_us": round(st["total_us"] / launches, 2),
+                "total_us": round(st["total_us"], 1),
+                "count": st["count"],
+            }
+            for name, st in stages.items()
+        },
+        "queue_wait_us": {
+            "mean": round(sum(waits) / len(waits), 2) if waits else None,
+            "max": round(max(waits), 2) if waits else None,
+            "n": len(waits),
+        },
+        "counters": {
+            name: {
+                "min": round(c["min"], 4),
+                "mean": round(c["sum"] / c["n"], 4),
+                "max": round(c["max"], 4),
+                "n": c["n"],
+            }
+            for name, c in counters.items()
+        },
+    }
+
+
+# ================================================================ diff
+#: REGRESS requires the stage to have moved by at least this much wall
+#: per launch, not just by a percentage — micro-stages under the floor
+#: are below the judge's resolution and clamp to WEATHER.
+MIN_DELTA_US = 100.0
+
+
+def diff_timelines(
+    old: dict, new: dict, band_pct: float | None,
+    min_delta_us: float = MIN_DELTA_US,
+) -> dict:
+    """Stage-by-stage diff of two timeline artifacts. The band defaults
+    to the LARGER of the two artifacts' embedded same-session A/A bands
+    (``aa_band_pct``, what loadgen --ab-rounds stamps), else slodiff's
+    default — cross-session timelines with no measured band get the
+    honest wide one."""
+    aa = [
+        float(d["aa_band_pct"])
+        for d in (old, new)
+        if isinstance(d.get("aa_band_pct"), (int, float))
+    ]
+    band = band_pct if band_pct is not None else (
+        max(aa) if aa else DEFAULT_BAND_PCT
+    )
+    po, pn = stage_profile(old), stage_profile(new)
+    items = []
+    for name in sorted(set(po["stages"]) | set(pn["stages"])):
+        o = po["stages"].get(name)
+        n = pn["stages"].get(name)
+        entry = {
+            "name": name,
+            "old_per_launch_us": (o or {}).get("per_launch_us"),
+            "new_per_launch_us": (n or {}).get("per_launch_us"),
+        }
+        if o is None or n is None:
+            entry["verdict"] = NO_DATA
+            entry["detail"] = (
+                "stage absent in baseline" if o is None
+                else "stage no longer runs"
+            )
+        else:
+            v, delta = _verdict_lower_better(
+                o["per_launch_us"], n["per_launch_us"], band
+            )
+            abs_delta = n["per_launch_us"] - o["per_launch_us"]
+            if v == REGRESS and abs_delta < min_delta_us:
+                v = WEATHER
+                entry["detail"] = (
+                    f"below resolution floor (+{abs_delta:.1f}us/launch "
+                    f"< {min_delta_us:g}us)"
+                )
+            entry["verdict"] = v
+            entry["delta_pct"] = round(delta, 2)
+        items.append(entry)
+    # queue-wait judged like a stage (lower is better): the backpressure
+    # component separated from compute so a REGRESS names the right culprit
+    qo, qn = po["queue_wait_us"]["mean"], pn["queue_wait_us"]["mean"]
+    qv, qdelta = _verdict_lower_better(qo, qn, band)
+    if qv == REGRESS and qo is not None and qn is not None \
+            and (qn - qo) < min_delta_us:
+        qv = WEATHER
+    queue_item = {
+        "name": "queue_wait",
+        "verdict": qv,
+        "delta_pct": round(qdelta, 2),
+        "old_mean_us": qo,
+        "new_mean_us": qn,
+    }
+    # counter envelopes: drill-down rows; judged only on hard flips (a
+    # shed/pressure track going 0 -> nonzero is an incident, not weather)
+    counter_items = []
+    for name in sorted(set(po["counters"]) | set(pn["counters"])):
+        co = po["counters"].get(name)
+        cn = pn["counters"].get(name)
+        entry = {"name": name, "old": co, "new": cn, "verdict": NO_DATA}
+        if (
+            co is not None and cn is not None
+            and name.startswith(("trend:shed_rate", "trend:pressure"))
+        ):
+            if co["max"] <= 0 and cn["max"] > 0:
+                entry["verdict"] = REGRESS
+                entry["detail"] = "track flipped idle -> active"
+            else:
+                entry["verdict"] = PASS
+        counter_items.append(entry)
+    verdict = _worst(
+        [i["verdict"] for i in items]
+        + [queue_item["verdict"]]
+        + [i["verdict"] for i in counter_items]
+    )
+    return {
+        "kind": "timeline",
+        "band_pct": round(band, 2),
+        "min_delta_us": min_delta_us,
+        "aa_band_pcts": aa,
+        "stages": items,
+        "queue_wait": queue_item,
+        "counters": counter_items,
+        "old_launches": po["launches"],
+        "new_launches": pn["launches"],
+        "verdict": verdict,
+    }
+
+
+def diff_artifacts(
+    old: dict, new: dict, band_pct: float | None = None,
+    min_delta_us: float = MIN_DELTA_US,
+) -> dict:
+    """Sniff the pair: two timelines diff here, anything else delegates
+    to slodiff (one judge entry point for the whole release flow). A
+    mixed pair is an error — apples to oranges, never a verdict."""
+    ot, nt = is_timeline(old), is_timeline(new)
+    if ot and nt:
+        return diff_timelines(old, new, band_pct, min_delta_us)
+    if ot or nt:
+        raise ValueError(
+            "artifact kinds differ: one is a timeline, the other is not"
+        )
+    return _slodiff_artifacts(old, new, band_pct)
+
+
+def render(diff: dict, old_path: str, new_path: str) -> str:
+    if diff.get("kind") != "timeline":
+        from tools.slodiff import render as slodiff_render
+
+        return slodiff_render(diff, old_path, new_path)
+    lines = [
+        f"pulsediff {old_path} -> {new_path}  "
+        f"[band {diff['band_pct']}%; "
+        f"{diff['old_launches']} -> {diff['new_launches']} launches]",
+    ]
+    for r in diff["stages"]:
+        if r["verdict"] == NO_DATA:
+            lines.append(
+                f"  {r['verdict']:<8}{r['name']:<40}{r.get('detail', '')}"
+            )
+            continue
+        lines.append(
+            f"  {r['verdict']:<8}{r['name']:<40}"
+            f"{r['old_per_launch_us']}us -> {r['new_per_launch_us']}us "
+            f"/launch ({r.get('delta_pct', 0):+.1f}%)"
+            + (f"  [{r['detail']}]" if r.get("detail") else "")
+        )
+    q = diff["queue_wait"]
+    lines.append(
+        f"  {q['verdict']:<8}{'queue_wait':<40}"
+        f"{q['old_mean_us']}us -> {q['new_mean_us']}us mean "
+        f"({q.get('delta_pct', 0):+.1f}%)"
+    )
+    for r in diff["counters"]:
+        o, n = r.get("old") or {}, r.get("new") or {}
+        lines.append(
+            f"  {r['verdict']:<8}{r['name']:<40}"
+            f"env [{o.get('min')}..{o.get('max')}] -> "
+            f"[{n.get('min')}..{n.get('max')}]"
+            + (f"  [{r['detail']}]" if r.get("detail") else "")
+        )
+    lines.append(f"verdict: {diff['verdict']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("old", help="baseline artifact (timeline/SLO/BENCH)")
+    p.add_argument("new", help="candidate artifact")
+    p.add_argument(
+        "--noise-band-pct", type=float, default=None, metavar="PCT",
+        help="worse-but-within-this-band reads WEATHER, beyond it REGRESS "
+             "(default: the artifacts' own embedded same-session band, "
+             f"else {DEFAULT_BAND_PCT}%%)",
+    )
+    p.add_argument(
+        "--min-delta-us", type=float, default=MIN_DELTA_US, metavar="US",
+        help="timeline stages must move at least this much wall per "
+             "launch to REGRESS — smaller absolute deltas are below the "
+             "judge's resolution and clamp to WEATHER "
+             f"(default {MIN_DELTA_US:g}us; 0 disables the floor)",
+    )
+    p.add_argument("--json", action="store_true", help="raw JSON diff")
+    args = p.parse_args(argv)
+    diff = diff_artifacts(
+        _load(args.old), _load(args.new), args.noise_band_pct,
+        args.min_delta_us,
+    )
+    if args.json:
+        print(json.dumps(diff, indent=2, sort_keys=True))
+    else:
+        print(render(diff, args.old, args.new))
+    return 1 if diff["verdict"] == REGRESS else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
